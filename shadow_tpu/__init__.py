@@ -1,0 +1,48 @@
+"""shadow_tpu — a TPU-native conservative-PDES network simulation framework.
+
+Capability target: the Shadow discrete-event network simulator
+(reference: /root/reference, see SURVEY.md). The conservative
+parallel-discrete-event core — per-host event queues, deterministic total event
+ordering, safe-time (runahead) round barriers, and the packet relay plane
+(latency / loss / bandwidth token buckets / CoDel) — runs on TPU as batched
+JAX/XLA kernels sharded over a device mesh. Host models (timers, PHOLD,
+tgen-style traffic, gossip) execute as vectorized handlers over all simulated
+hosts at once.
+
+Design notes (vs reference architecture, cited per SURVEY.md):
+  - reference unit of parallelism: one OS thread per core with host work
+    stealing (src/lib/scheduler/src/thread_per_core.rs). Here: the host axis is
+    a sharded array dimension over a `jax.sharding.Mesh`; a "scheduling round"
+    is one trace of `round_step` and the cross-thread min-reduction
+    (src/main/core/manager.rs:459-464) is a `lax.pmin` over ICI.
+  - reference event ordering (src/main/core/work/event.rs:102-155): total order
+    by (time, packets-before-local, src host, per-src seqno). Here the same
+    key is packed into (t:i64, order:i64) and used by every pop/merge kernel,
+    which is what makes the simulation bit-deterministic under any sharding.
+"""
+
+import jax as _jax
+
+# Simulated time is int64 nanoseconds (reference SimulationTime,
+# src/lib/shadow-shim-helper-rs/src/simulation_time.rs). TPU emulates i64; the
+# precision is required for deterministic event ordering.
+_jax.config.update("jax_enable_x64", True)
+
+from shadow_tpu.simtime import (  # noqa: E402
+    NS_PER_SEC,
+    NS_PER_MSEC,
+    NS_PER_USEC,
+    TIME_MAX,
+    EMUTIME_EPOCH_UNIX_SEC,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NS_PER_SEC",
+    "NS_PER_MSEC",
+    "NS_PER_USEC",
+    "TIME_MAX",
+    "EMUTIME_EPOCH_UNIX_SEC",
+    "__version__",
+]

@@ -1,0 +1,43 @@
+"""Config / flag system (reference: src/main/core/configuration.rs).
+
+One schema serves both the YAML file and the CLI: every option dataclass field
+is a YAML key and a `--kebab-case` flag, with CLI overriding file (reference
+configuration.rs:19-24). Units strings ("10 Mbit", "50 ms") are accepted
+everywhere a quantity is expected (reference utility/units.rs).
+"""
+
+from shadow_tpu.config.units import (
+    parse_time_ns,
+    parse_bits_per_sec,
+    parse_bytes,
+    TimeUnit,
+)
+from shadow_tpu.config.options import (
+    ConfigOptions,
+    GeneralOptions,
+    NetworkOptions,
+    ExperimentalOptions,
+    HostOptions,
+    HostDefaultOptions,
+    ProcessOptions,
+    GraphOptions,
+    load_config,
+    merge_cli_overrides,
+)
+
+__all__ = [
+    "parse_time_ns",
+    "parse_bits_per_sec",
+    "parse_bytes",
+    "TimeUnit",
+    "ConfigOptions",
+    "GeneralOptions",
+    "NetworkOptions",
+    "ExperimentalOptions",
+    "HostOptions",
+    "HostDefaultOptions",
+    "ProcessOptions",
+    "GraphOptions",
+    "load_config",
+    "merge_cli_overrides",
+]

@@ -1,0 +1,367 @@
+"""Config schema: YAML file + CLI flags over one set of dataclasses.
+
+Reference: src/main/core/configuration.rs — `GeneralOptions` (:197),
+`NetworkOptions` (:282), `ExperimentalOptions` (:314), `HostDefaultOptions`
+(:550), `ProcessOptions` (:643), `HostOptions` (:674). The reference derives
+both serde (YAML) and clap (CLI) from the same structs; here `from_dict`
+consumes YAML and `merge_cli_overrides` applies `--dotted.key=value` overrides
+on top, CLI winning (configuration.rs:19-24).
+
+Differences from the reference, by design:
+  - `HostOptions.processes` may carry either a managed-process spec
+    (path/args/environment — the CPU co-optation plane) or a *device model*
+    spec (`model:`/`model_args:`) executed as vectorized handlers on TPU.
+  - `ExperimentalOptions` carries the TPU engine's static-shape knobs (event
+    queue capacity, outbox capacity, rounds per jit chunk) in place of the
+    reference's CPU-scheduler knobs (`use_cpu_pinning`, `use_worker_spinning`),
+    which have no TPU meaning.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+from shadow_tpu.config.units import parse_bits_per_sec, parse_time_ns, TimeUnit
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class GraphOptions:
+    """reference: GraphOptions/GraphSource (configuration.rs, graph/mod.rs:495-530)."""
+
+    # "gml" | "1_gbit_switch" (reference's built-in one-node graph)
+    type: str = "1_gbit_switch"
+    path: str | None = None  # GML file path
+    inline: str | None = None  # GML text inline
+    # direct edge weights vs shortest-path routing (graph/mod.rs:183-253)
+    use_shortest_path: bool = True
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "GraphOptions":
+        d = dict(d or {})
+        g = GraphOptions(
+            type=d.pop("type", "1_gbit_switch"),
+            path=(d.pop("file", {}) or {}).get("path") if "file" in d else d.pop("path", None),
+            inline=d.pop("inline", None),
+            use_shortest_path=d.pop("use_shortest_path", True),
+        )
+        if d:
+            raise ConfigError(f"unknown graph options: {sorted(d)}")
+        return g
+
+
+@dataclass
+class GeneralOptions:
+    """reference: GeneralOptions (configuration.rs:197)."""
+
+    stop_time: int = 0  # ns (required)
+    bootstrap_end_time: int = 0  # ns; loss disabled before this time
+    seed: int = 1
+    parallelism: int = 0  # 0 = all devices (reference: 0 = all cores)
+    data_directory: str = "shadow.data"
+    template_directory: str | None = None
+    log_level: str = "info"
+    heartbeat_interval: int | None = parse_time_ns("1 s")
+    progress: bool = False
+    model_unblocked_syscall_latency: bool = False
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "GeneralOptions":
+        d = dict(d)
+        if "stop_time" not in d:
+            raise ConfigError("general.stop_time is required")
+        heartbeat = d.pop("heartbeat_interval", "1 s")
+        g = GeneralOptions(
+            stop_time=parse_time_ns(d.pop("stop_time"), TimeUnit.SEC),
+            bootstrap_end_time=parse_time_ns(d.pop("bootstrap_end_time", 0), TimeUnit.SEC),
+            seed=int(d.pop("seed", 1)),
+            parallelism=int(d.pop("parallelism", 0)),
+            data_directory=d.pop("data_directory", "shadow.data"),
+            template_directory=d.pop("template_directory", None),
+            log_level=d.pop("log_level", "info"),
+            heartbeat_interval=(
+                parse_time_ns(heartbeat, TimeUnit.SEC) if heartbeat is not None else None
+            ),
+            progress=bool(d.pop("progress", False)),
+            model_unblocked_syscall_latency=bool(
+                d.pop("model_unblocked_syscall_latency", False)
+            ),
+        )
+        if d:
+            raise ConfigError(f"unknown general options: {sorted(d)}")
+        return g
+
+
+@dataclass
+class NetworkOptions:
+    """reference: NetworkOptions (configuration.rs:282)."""
+
+    graph: GraphOptions = field(default_factory=GraphOptions)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "NetworkOptions":
+        d = dict(d or {})
+        n = NetworkOptions(graph=GraphOptions.from_dict(d.pop("graph", None)))
+        if d:
+            raise ConfigError(f"unknown network options: {sorted(d)}")
+        return n
+
+
+@dataclass
+class ExperimentalOptions:
+    """reference: ExperimentalOptions (configuration.rs:314), TPU-adapted.
+
+    Kept from the reference: `scheduler`, `runahead`, `use_dynamic_runahead`,
+    `interface_qdisc`. New (static-shape knobs the TPU engine needs):
+    `event_queue_capacity`, `outbox_capacity`, `max_round_inserts`,
+    `rounds_per_chunk`, `microstep_limit`.
+    """
+
+    scheduler: str = "tpu"  # "tpu" | "cpu-reference" (pure-numpy oracle)
+    runahead: int = parse_time_ns("1 ms")  # floor (reference default 1ms, runahead.rs)
+    use_dynamic_runahead: bool = False
+    interface_qdisc: str = "fifo"  # "fifo" | "round-robin" (QDiscMode, configuration.rs:960)
+    use_codel: bool = True
+    # --- TPU engine static shapes ---
+    event_queue_capacity: int = 64  # per-host pending-event slots
+    outbox_capacity: int = 0  # per-shard per-round packet buffer; 0 = auto
+    max_round_inserts: int = 0  # max packets merged into one host per round; 0 = auto
+    rounds_per_chunk: int = 64  # rounds per jit'd chunk between host syncs
+    microstep_limit: int = 0  # safety bound on events/host/round; 0 = capacity
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "ExperimentalOptions":
+        d = dict(d or {})
+        e = ExperimentalOptions()
+        if "runahead" in d:
+            e.runahead = parse_time_ns(d.pop("runahead"), TimeUnit.MS)
+        for f in (
+            "scheduler",
+            "interface_qdisc",
+        ):
+            if f in d:
+                setattr(e, f, str(d.pop(f)))
+        for f in ("use_dynamic_runahead", "use_codel"):
+            if f in d:
+                setattr(e, f, bool(d.pop(f)))
+        for f in (
+            "event_queue_capacity",
+            "outbox_capacity",
+            "max_round_inserts",
+            "rounds_per_chunk",
+            "microstep_limit",
+        ):
+            if f in d:
+                setattr(e, f, int(d.pop(f)))
+        if d:
+            raise ConfigError(f"unknown experimental options: {sorted(d)}")
+        return e
+
+
+@dataclass
+class ProcessOptions:
+    """reference: ProcessOptions (configuration.rs:643).
+
+    Either a managed process (path/args) or a device model (model/model_args).
+    """
+
+    path: str | None = None
+    args: list[str] = field(default_factory=list)
+    environment: dict[str, str] = field(default_factory=dict)
+    start_time: int = 0  # ns
+    shutdown_time: int | None = None
+    expected_final_state: Any = "running"  # "running" | {"exited": code} | {"signaled": sig}
+    model: str | None = None  # device-model name, e.g. "udp_echo_client"
+    model_args: dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ProcessOptions":
+        d = dict(d)
+        p = ProcessOptions(
+            path=d.pop("path", None),
+            args=_split_args(d.pop("args", [])),
+            environment=dict(d.pop("environment", {}) or {}),
+            start_time=parse_time_ns(d.pop("start_time", 0), TimeUnit.SEC),
+            shutdown_time=(
+                parse_time_ns(d["shutdown_time"], TimeUnit.SEC)
+                if d.get("shutdown_time") is not None
+                else None
+            ),
+            expected_final_state=d.pop("expected_final_state", "running"),
+            model=d.pop("model", None),
+            model_args=dict(d.pop("model_args", {}) or {}),
+        )
+        d.pop("shutdown_time", None)
+        if p.path is None and p.model is None:
+            raise ConfigError("process needs either `path` (managed) or `model` (device)")
+        if d:
+            raise ConfigError(f"unknown process options: {sorted(d)}")
+        return p
+
+
+def _split_args(args: Any) -> list[str]:
+    if isinstance(args, str):
+        return args.split()
+    return [str(a) for a in (args or [])]
+
+
+@dataclass
+class HostDefaultOptions:
+    """reference: HostDefaultOptions (configuration.rs:550), cascaded per host."""
+
+    log_level: str | None = None
+    pcap_enabled: bool = False
+    pcap_capture_size: int = 65535
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "HostDefaultOptions":
+        d = dict(d or {})
+        h = HostDefaultOptions(
+            log_level=d.pop("log_level", None),
+            pcap_enabled=bool(d.pop("pcap_enabled", False)),
+            pcap_capture_size=int(d.pop("pcap_capture_size", 65535)),
+        )
+        if d:
+            raise ConfigError(f"unknown host default options: {sorted(d)}")
+        return h
+
+
+@dataclass
+class HostOptions:
+    """reference: HostOptions (configuration.rs:674)."""
+
+    name: str = ""
+    network_node_id: int = 0
+    count: int = 1  # expand into name1..nameN (tooling convenience; tgen-style)
+    ip_addr: str | None = None
+    bandwidth_down: int | None = None  # bits/sec; falls back to graph node
+    bandwidth_up: int | None = None
+    processes: list[ProcessOptions] = field(default_factory=list)
+    host_options: HostDefaultOptions = field(default_factory=HostDefaultOptions)
+
+    @staticmethod
+    def from_dict(name: str, d: dict[str, Any], defaults: HostDefaultOptions) -> "HostOptions":
+        d = dict(d)
+        merged_defaults = copy.deepcopy(defaults)
+        for k, v in (d.pop("host_options", {}) or {}).items():
+            if not hasattr(merged_defaults, k):
+                raise ConfigError(f"unknown host option {k!r}")
+            setattr(merged_defaults, k, v)
+        bw_down = d.pop("bandwidth_down", None)
+        bw_up = d.pop("bandwidth_up", None)
+        h = HostOptions(
+            name=name,
+            network_node_id=int(d.pop("network_node_id", 0)),
+            count=int(d.pop("count", 1)),
+            ip_addr=d.pop("ip_addr", None),
+            bandwidth_down=parse_bits_per_sec(bw_down) if bw_down is not None else None,
+            bandwidth_up=parse_bits_per_sec(bw_up) if bw_up is not None else None,
+            processes=[ProcessOptions.from_dict(p) for p in d.pop("processes", [])],
+            host_options=merged_defaults,
+        )
+        if d:
+            raise ConfigError(f"unknown host options for {name!r}: {sorted(d)}")
+        return h
+
+
+@dataclass
+class ConfigOptions:
+    """Top-level config (reference: ConfigOptions, configuration.rs:112)."""
+
+    general: GeneralOptions = field(default_factory=GeneralOptions)
+    network: NetworkOptions = field(default_factory=NetworkOptions)
+    experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
+    host_option_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
+    hosts: list[HostOptions] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ConfigOptions":
+        d = dict(d)
+        if "general" not in d:
+            raise ConfigError("`general` section is required")
+        defaults = HostDefaultOptions.from_dict(d.pop("host_option_defaults", None))
+        hosts_raw = d.pop("hosts", {}) or {}
+        hosts: list[HostOptions] = []
+        for name, hd in hosts_raw.items():
+            h = HostOptions.from_dict(name, hd or {}, defaults)
+            if h.count == 1:
+                hosts.append(h)
+            else:
+                for i in range(1, h.count + 1):
+                    hi = copy.deepcopy(h)
+                    hi.name = f"{name}{i}"
+                    hi.count = 1
+                    hosts.append(hi)
+        cfg = ConfigOptions(
+            general=GeneralOptions.from_dict(d.pop("general")),
+            network=NetworkOptions.from_dict(d.pop("network", None)),
+            experimental=ExperimentalOptions.from_dict(d.pop("experimental", None)),
+            host_option_defaults=defaults,
+            hosts=hosts,
+        )
+        if d:
+            raise ConfigError(f"unknown top-level sections: {sorted(d)}")
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        """Re-serializable form, written to data-dir/processed-config.yaml for
+        provenance (reference manager.rs:182-193)."""
+        return dataclasses.asdict(self)
+
+
+def load_config(path_or_text: str, *, is_text: bool = False) -> ConfigOptions:
+    """Load a YAML config from a path (or inline text / '-' for stdin)."""
+    if is_text:
+        text = path_or_text
+    elif path_or_text == "-":
+        import sys
+
+        text = sys.stdin.read()
+    else:
+        with open(path_or_text) as f:
+            text = f.read()
+    data = yaml.safe_load(text)
+    if not isinstance(data, dict):
+        raise ConfigError("config must be a YAML mapping")
+    return ConfigOptions.from_dict(data)
+
+
+def merge_cli_overrides(cfg: ConfigOptions, overrides: dict[str, str]) -> ConfigOptions:
+    """Apply `--section.key=value` CLI overrides; CLI wins over file
+    (reference configuration.rs:19-24)."""
+    cfg = copy.deepcopy(cfg)
+    for dotted, raw in overrides.items():
+        parts = dotted.split(".")
+        obj: Any = cfg
+        for p in parts[:-1]:
+            if not hasattr(obj, p):
+                raise ConfigError(f"unknown config path {dotted!r}")
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        if not hasattr(obj, leaf):
+            raise ConfigError(f"unknown config path {dotted!r}")
+        cur = getattr(obj, leaf)
+        val: Any = yaml.safe_load(raw)
+        try:
+            if leaf.endswith("_time") or leaf in ("heartbeat_interval",):
+                val = parse_time_ns(val, TimeUnit.SEC)
+            elif leaf == "runahead":
+                val = parse_time_ns(val, TimeUnit.MS)
+            elif leaf.startswith("bandwidth_"):
+                val = parse_bits_per_sec(val)
+            elif isinstance(cur, bool):
+                val = bool(val)
+            elif isinstance(cur, int):
+                val = int(val)
+        except (TypeError, ValueError) as e:
+            raise ConfigError(f"bad value for --{dotted}: {e}") from e
+        setattr(obj, leaf, val)
+    return cfg

@@ -1,0 +1,109 @@
+"""Unit-string parsing ("10 Mbit", "50 ms", "1 GiB").
+
+Reference: src/main/utility/units.rs — Shadow accepts SI and binary prefixes on
+time, bit-rate, and byte quantities throughout the YAML config and CLI. This
+module provides the same surface: a quantity is an integer or a string
+"<number> <prefix><unit>" (space optional).
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+
+_NUM_RE = re.compile(r"^\s*([0-9]+(?:\.[0-9]+)?)\s*([A-Za-zμ]*)\s*$")
+
+_SI = {"": 1, "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12}
+_BIN = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40}
+
+_TIME_SUFFIX_NS = {
+    "ns": 1,
+    "nsec": 1,
+    "us": 1_000,
+    "usec": 1_000,
+    "μs": 1_000,
+    "ms": 1_000_000,
+    "msec": 1_000_000,
+    "s": 1_000_000_000,
+    "sec": 1_000_000_000,
+    "second": 1_000_000_000,
+    "seconds": 1_000_000_000,
+    "m": 60 * 1_000_000_000,
+    "min": 60 * 1_000_000_000,
+    "minute": 60 * 1_000_000_000,
+    "minutes": 60 * 1_000_000_000,
+    "h": 3600 * 1_000_000_000,
+    "hour": 3600 * 1_000_000_000,
+    "hours": 3600 * 1_000_000_000,
+}
+
+
+class TimeUnit(enum.Enum):
+    NS = 1
+    US = 1_000
+    MS = 1_000_000
+    SEC = 1_000_000_000
+
+
+def _split(value: str) -> tuple[float, str]:
+    m = _NUM_RE.match(value)
+    if not m:
+        raise ValueError(f"cannot parse quantity: {value!r}")
+    return float(m.group(1)), m.group(2)
+
+
+def parse_time_ns(value: int | float | str, default_unit: TimeUnit = TimeUnit.SEC) -> int:
+    """Parse a time quantity to int64 nanoseconds (rounded, not truncated).
+
+    Bare numbers take `default_unit` (the reference defaults bare config times
+    to seconds, e.g. `stop_time: 10`).
+    """
+    if isinstance(value, (int, float)):
+        return int(value * default_unit.value)
+    num, suffix = _split(value)
+    if suffix == "":
+        return round(num * default_unit.value)
+    if suffix not in _TIME_SUFFIX_NS:
+        raise ValueError(f"unknown time unit {suffix!r} in {value!r}")
+    return round(num * _TIME_SUFFIX_NS[suffix])
+
+
+def parse_bits_per_sec(value: int | float | str) -> int:
+    """Parse a bandwidth quantity ("10 Mbit", "81920 Kibit") to bits/sec."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    num, suffix = _split(value)
+    if suffix == "":
+        return round(num)
+    for unit in ("bit", "Bit"):
+        if suffix.endswith(unit):
+            prefix = suffix[: -len(unit)]
+            if prefix in _SI:
+                return round(num * _SI[prefix])
+            if prefix in _BIN:
+                return round(num * _BIN[prefix])
+            # lowercase SI prefixes are accepted too ("mbit" in the wild)
+            if prefix.upper() in _SI:
+                return round(num * _SI[prefix.upper()])
+            raise ValueError(f"unknown bit-rate prefix {prefix!r} in {value!r}")
+    raise ValueError(f"unknown bit-rate unit in {value!r}")
+
+
+def parse_bytes(value: int | float | str) -> int:
+    """Parse a byte quantity ("1 GiB", "512 KB", "100 B") to bytes."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    num, suffix = _split(value)
+    if suffix == "":
+        return round(num)
+    for unit in ("bytes", "byte", "B"):
+        if suffix.endswith(unit):
+            prefix = suffix[: -len(unit)]
+            if prefix in _SI:
+                return round(num * _SI[prefix])
+            if prefix in _BIN:
+                return round(num * _BIN[prefix])
+            if prefix.upper() in _SI:
+                return round(num * _SI[prefix.upper()])
+            break
+    raise ValueError(f"unknown byte unit in {value!r}")

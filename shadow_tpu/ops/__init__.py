@@ -1,0 +1,40 @@
+"""Device-side kernels: event-queue SoA ops, per-host RNG, sorted batch merge.
+
+These are the TPU equivalents of the reference's per-host
+`BinaryHeap<Reverse<Event>>` (src/main/core/work/event_queue.rs) and its
+deterministic `Event` ordering (src/main/core/work/event.rs:102-155), recast as
+fixed-shape vectorized array programs so XLA can fuse and tile them.
+"""
+
+from shadow_tpu.ops.events import (
+    EventQueue,
+    EVENT_PAYLOAD_WORDS,
+    make_queue,
+    next_time,
+    queue_len,
+    pop_min,
+    push_one,
+    pack_order,
+    check_order_limits,
+    ORDER_MAX,
+)
+from shadow_tpu.ops.merge import merge_flat_events
+from shadow_tpu.ops.rng import RngState, rng_init, rng_next_u64, rng_uniform
+
+__all__ = [
+    "EventQueue",
+    "EVENT_PAYLOAD_WORDS",
+    "make_queue",
+    "next_time",
+    "queue_len",
+    "pop_min",
+    "push_one",
+    "pack_order",
+    "check_order_limits",
+    "ORDER_MAX",
+    "merge_flat_events",
+    "RngState",
+    "rng_init",
+    "rng_next_u64",
+    "rng_uniform",
+]

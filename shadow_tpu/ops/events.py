@@ -1,0 +1,158 @@
+"""Per-host event queues as fixed-capacity SoA arrays in HBM.
+
+Reference model being rebuilt (not ported):
+  - src/main/core/work/event_queue.rs:10-55 — per-host `BinaryHeap` with a
+    monotonic-time assertion and `next_event_time` peek.
+  - src/main/core/work/event.rs:102-155 — deterministic total order:
+    (time, packets-before-local-tasks, src host id, per-src sequence number).
+
+TPU-first recast: a queue is a `[H, C]` slab per field (times i64, order-key
+i64, kind i32, payload i32×P). Empty slots hold TIME_MAX / ORDER_MAX. All ops
+are branch-free masked reductions/scatters over the full slab so every host
+advances in the same fused kernel; `H` is the sharded axis on the device mesh.
+
+The total order is packed into two i64 keys compared lexicographically:
+  primary   = event time (ns)
+  secondary = `order` = (is_local_task << 62) | (src_host << 40) | seq
+Packets sort before local tasks at equal times (is_local=1 for local tasks),
+matching event.rs:131-155; `seq` is a per-source monotonically increasing
+counter so concurrent sends resolve identically under any sharding.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from shadow_tpu.simtime import TIME_MAX
+
+# payload words per event: models pack (src, size, flow/port, aux) etc.
+EVENT_PAYLOAD_WORDS = 4
+
+# order-key field widths: 1 bit is_local | 22 bits src host | 40 bits seq
+_SEQ_BITS = 40
+_SRC_SHIFT = _SEQ_BITS
+_LOCAL_SHIFT = 62
+SEQ_MASK = (1 << _SEQ_BITS) - 1
+ORDER_MAX = (1 << 63) - 1  # empty-slot sentinel, compares after any real key
+
+
+class EventQueue(NamedTuple):
+    """SoA event slab for H hosts × C slots (all arrays shard on axis 0)."""
+
+    t: Array  # i64[H, C] event time; TIME_MAX = empty
+    order: Array  # i64[H, C] secondary sort key; ORDER_MAX = empty
+    kind: Array  # i32[H, C] event kind (model handler index)
+    payload: Array  # i32[H, C, P]
+    dropped: Array  # i64[H] events lost to capacity overflow (observability)
+
+
+class Event(NamedTuple):
+    """One popped event per host (all [H])."""
+
+    t: Array  # i64[H]
+    order: Array  # i64[H]
+    kind: Array  # i32[H]
+    payload: Array  # i32[H, P]
+
+
+def pack_order(is_local, src_host, seq) -> Array:
+    """Pack the deterministic tiebreak key (event.rs:131-155 equivalent).
+
+    Field limits — enforced statically via `check_order_limits`, not per-draw
+    (this runs in the hot pop/merge path): src_host < 2^22 (≈4.2M hosts) and
+    seq < 2^40 (≈1.1e12 events per source; a source emits at most one event
+    per microstep, so wrap is unreachable in any real simulation length).
+    """
+    is_local = jnp.asarray(is_local, jnp.int64)
+    src_host = jnp.asarray(src_host, jnp.int64)
+    seq = jnp.asarray(seq, jnp.int64)
+    return (is_local << _LOCAL_SHIFT) | (src_host << _SRC_SHIFT) | (seq & SEQ_MASK)
+
+
+def check_order_limits(num_hosts: int) -> None:
+    """Static guard called at simulation build time: the packed key must never
+    collide with ORDER_MAX (empty-slot sentinel) or spill src bits into the
+    is_local bit."""
+    if num_hosts >= (1 << (_LOCAL_SHIFT - _SRC_SHIFT)):
+        raise ValueError(
+            f"num_hosts={num_hosts} exceeds the {1 << (_LOCAL_SHIFT - _SRC_SHIFT)}"
+            " host limit of the packed event-order key"
+        )
+
+
+def make_queue(num_hosts: int, capacity: int) -> EventQueue:
+    return EventQueue(
+        t=jnp.full((num_hosts, capacity), TIME_MAX, jnp.int64),
+        order=jnp.full((num_hosts, capacity), ORDER_MAX, jnp.int64),
+        kind=jnp.zeros((num_hosts, capacity), jnp.int32),
+        payload=jnp.zeros((num_hosts, capacity, EVENT_PAYLOAD_WORDS), jnp.int32),
+        dropped=jnp.zeros((num_hosts,), jnp.int64),
+    )
+
+
+def next_time(q: EventQueue) -> Array:
+    """Per-host earliest pending event time (event_queue.rs:52-54 peek)."""
+    return jnp.min(q.t, axis=1)
+
+
+def queue_len(q: EventQueue) -> Array:
+    return jnp.sum((q.t != TIME_MAX).astype(jnp.int32), axis=1)
+
+
+def pop_min(q: EventQueue, limit) -> tuple[EventQueue, Event, Array]:
+    """Pop each host's earliest event strictly before `limit` (i64 scalar or [H]).
+
+    Returns (queue', event, active[H] bool). Inactive hosts get a dummy event
+    (t=TIME_MAX) and their queue is untouched. Ties on time break by the packed
+    `order` key — the device analogue of Event::cmp (event.rs:102-110).
+    """
+    limit = jnp.asarray(limit, jnp.int64)
+    tmin = jnp.min(q.t, axis=1)  # [H]
+    active = tmin < limit
+    # among slots at the min time, take the smallest order key
+    at_min = q.t == tmin[:, None]
+    cand_order = jnp.where(at_min, q.order, ORDER_MAX)
+    idx = jnp.argmin(cand_order, axis=1)  # [H]
+    hh = jnp.arange(q.t.shape[0])
+    ev = Event(
+        t=jnp.where(active, q.t[hh, idx], TIME_MAX),
+        order=jnp.where(active, q.order[hh, idx], ORDER_MAX),
+        kind=jnp.where(active, q.kind[hh, idx], 0),
+        payload=jnp.where(active[:, None], q.payload[hh, idx], 0),
+    )
+    clear = active[:, None] & (jnp.arange(q.t.shape[1])[None, :] == idx[:, None])
+    return (
+        q._replace(
+            t=jnp.where(clear, TIME_MAX, q.t),
+            order=jnp.where(clear, ORDER_MAX, q.order),
+        ),
+        ev,
+        active,
+    )
+
+
+def push_one(q: EventQueue, mask, t, order, kind, payload) -> EventQueue:
+    """Push one event per host where `mask` ([H] bool) is set.
+
+    Args are per-host arrays: t i64[H], order i64[H], kind i32[H],
+    payload i32[H, P]. Overflow (no free slot) increments `dropped` instead of
+    silently corrupting — the static-shape analogue of the reference heap's
+    unbounded growth, surfaced in sim-stats.
+    """
+    free = q.t == TIME_MAX  # [H, C]
+    has_free = jnp.any(free, axis=1)
+    slot = jnp.argmax(free, axis=1)  # first free slot per host
+    do = mask & has_free
+    oh = do[:, None] & (jnp.arange(q.t.shape[1])[None, :] == slot[:, None])
+    return q._replace(
+        t=jnp.where(oh, jnp.asarray(t, jnp.int64)[:, None], q.t),
+        order=jnp.where(oh, jnp.asarray(order, jnp.int64)[:, None], q.order),
+        kind=jnp.where(oh, jnp.asarray(kind, jnp.int32)[:, None], q.kind),
+        payload=jnp.where(
+            oh[:, :, None], jnp.asarray(payload, jnp.int32)[:, None, :], q.payload
+        ),
+        dropped=q.dropped + jnp.where(mask & ~has_free, 1, 0).astype(jnp.int64),
+    )

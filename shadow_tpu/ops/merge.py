@@ -1,0 +1,95 @@
+"""Deterministic batch merge of cross-host events into per-host queues.
+
+This is the round-barrier half of the reference's cross-host path: in Shadow a
+worker locks the destination host's `Mutex<EventQueue>` and pushes
+(src/main/core/worker.rs:644-654). On TPU there are no locks: all packets
+emitted during a round are staged in a flat outbox, exchanged at the barrier,
+and inserted here with a single sorted scatter whose order is fully determined
+by the packed event order key — so the result is bit-identical for any shard
+count or arrival interleaving.
+
+Algorithm (all static shapes, O(N log N + H·C)):
+  1. sort entries by (dst, time, order) — invalid entries sort to the end, so
+     under overflow pressure the *latest* events are shed, never the most
+     urgent ones;
+  2. rank r of each entry within its dst segment via searchsorted;
+  3. build each host's free-slot map: rank → slot index (scatter of slot ids
+     keyed by the running count of free slots);
+  4. scatter entry r into its dst's r-th free slot; entries beyond the free
+     count or beyond `max_inserts` land in `dropped` (counted, never silent).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from shadow_tpu.ops.events import EventQueue
+from shadow_tpu.simtime import TIME_MAX
+
+
+def merge_flat_events(
+    q: EventQueue,
+    dst,  # i32[N] local host index of each entry
+    t,  # i64[N]
+    order,  # i64[N] packed tiebreak key (unique per live entry)
+    kind,  # i32[N]
+    payload,  # i32[N, P]
+    valid,  # bool[N]
+    max_inserts: int,
+) -> EventQueue:
+    num_hosts, cap = q.t.shape
+    n = dst.shape[0]
+    r_cap = min(max_inserts, cap)
+
+    # -- 1. sort by (dst, t, order); invalid entries get dst=num_hosts (sort last)
+    dst_key = jnp.where(valid, dst.astype(jnp.int32), jnp.int32(num_hosts))
+    sorted_ops = lax.sort(
+        (
+            dst_key.astype(jnp.int64),
+            t,
+            order,
+            kind.astype(jnp.int64),
+            jnp.arange(n, dtype=jnp.int64),
+        ),
+        num_keys=3,
+    )
+    s_dst, s_t, s_order, s_kind, s_idx = sorted_ops
+    s_payload = payload[s_idx]
+    s_valid = s_dst < num_hosts
+
+    # -- 2. rank within destination segment
+    seg_start = jnp.searchsorted(s_dst, s_dst, side="left")
+    rank = jnp.arange(n, dtype=jnp.int64) - seg_start
+
+    # -- 3. free-slot map per host: slot_of_rank[h, r] = index of r-th free slot
+    free = q.t == TIME_MAX  # [H, C]
+    free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1  # [H, C]
+    scatter_r = jnp.where(free & (free_rank < r_cap), free_rank, r_cap)
+    slot_of_rank = jnp.full((num_hosts, r_cap), -1, jnp.int32)
+    hh = jnp.broadcast_to(jnp.arange(num_hosts)[:, None], free.shape)
+    cc = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32)[None, :], free.shape)
+    slot_of_rank = slot_of_rank.at[hh, scatter_r].set(cc, mode="drop")
+
+    # -- 4. scatter entries into (dst, slot)
+    in_rank = s_valid & (rank < r_cap)
+    h_safe = jnp.where(s_valid, s_dst, 0).astype(jnp.int32)
+    r_safe = jnp.where(in_rank, rank, 0).astype(jnp.int32)
+    slot = slot_of_rank[h_safe, r_safe]  # [N]
+    ok = in_rank & (slot >= 0)
+    h_scatter = jnp.where(ok, h_safe, num_hosts)  # out-of-bounds → dropped
+    s_scatter = jnp.where(ok, slot, 0)
+
+    new_t = q.t.at[h_scatter, s_scatter].set(s_t, mode="drop")
+    new_order = q.order.at[h_scatter, s_scatter].set(s_order, mode="drop")
+    new_kind = q.kind.at[h_scatter, s_scatter].set(s_kind.astype(jnp.int32), mode="drop")
+    new_payload = q.payload.at[h_scatter, s_scatter].set(s_payload, mode="drop")
+
+    # -- overflow accounting (int scatter-add: order-independent, deterministic)
+    lost = s_valid & ~ok
+    dropped = q.dropped.at[jnp.where(lost, h_safe, num_hosts)].add(
+        jnp.where(lost, 1, 0).astype(jnp.int64), mode="drop"
+    )
+    return EventQueue(
+        t=new_t, order=new_order, kind=new_kind, payload=new_payload, dropped=dropped
+    )

@@ -1,0 +1,82 @@
+"""Per-host counter RNG: vectorized xoshiro256++ lanes, one per simulated host.
+
+The reference seeds one Xoshiro256++ per host from the global seed
+(src/main/host/host.rs:233) so packet-loss draws and app randomness are
+deterministic per host regardless of scheduling. Same contract here: state is
+uint64[H, 4]; draws advance a host's lane ONLY under an explicit mask, so the
+per-host draw sequence depends only on that host's event history — never on
+how hosts are grouped into shards or microsteps. That masked-advance rule is
+what keeps the determinism gate (tests/test_determinism.py) true across mesh
+shapes.
+
+Seeding uses splitmix64(global_seed, host_id), the standard xoshiro seeding
+recipe (capability-equivalent to the reference; not bit-equal to rand_xoshiro).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+class RngState(NamedTuple):
+    s: Array  # uint64[H, 4]
+
+
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _splitmix64(x: Array) -> tuple[Array, Array]:
+    x = x + _GOLDEN
+    z = x
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> jnp.uint64(31))
+    return x, z
+
+
+def rng_init(num_hosts: int, seed: int) -> RngState:
+    x = jnp.uint64(seed) + jnp.arange(num_hosts, dtype=jnp.uint64) * jnp.uint64(
+        0xD1342543DE82EF95
+    )
+    lanes = []
+    for _ in range(4):
+        x, z = _splitmix64(x)
+        lanes.append(z)
+    return RngState(s=jnp.stack(lanes, axis=1))
+
+
+def _rotl(x: Array, k: int) -> Array:
+    return (x << jnp.uint64(k)) | (x >> jnp.uint64(64 - k))
+
+
+def rng_next_u64(state: RngState, mask) -> tuple[RngState, Array]:
+    """Draw a uint64 per host; advance state only where `mask` ([H] bool).
+
+    xoshiro256++ step: result = rotl(s0+s3,23)+s0; standard state transition.
+    """
+    s0, s1, s2, s3 = (state.s[:, i] for i in range(4))
+    result = _rotl(s0 + s3, 23) + s0
+    t = s1 << jnp.uint64(17)
+    s2n = s2 ^ s0
+    s3n = s3 ^ s1
+    s1n = s1 ^ s2n
+    s0n = s0 ^ s3n
+    s2n = s2n ^ t
+    s3n = _rotl(s3n, 45)
+    new = jnp.stack([s0n, s1n, s2n, s3n], axis=1)
+    mask = jnp.asarray(mask, bool)
+    return RngState(s=jnp.where(mask[:, None], new, state.s)), result
+
+
+def rng_uniform(state: RngState, mask) -> tuple[RngState, Array]:
+    """Draw float32 in [0, 1) per host (masked advance).
+
+    Top 24 bits → f32 mantissa; enough resolution for packet-loss draws
+    (reference draws f64 against edge loss probability, worker.rs:374-390).
+    """
+    state, x = rng_next_u64(state, mask)
+    u24 = (x >> jnp.uint64(40)).astype(jnp.uint32)
+    return state, u24.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))

@@ -1,0 +1,42 @@
+"""Simulation / emulated time.
+
+Reference: src/lib/shadow-shim-helper-rs/src/{simulation_time.rs,emulated_time.rs}.
+SimulationTime = ns since simulation start. EmulatedTime = ns since the
+emulation epoch 2000-01-01T00:00:00 UTC (emulated_time.rs:28-48), which is what
+managed processes observe via clock_gettime.
+
+All device-side times are int64 nanoseconds of *simulation* time; TIME_MAX is
+the empty-slot / +inf sentinel used by the event-queue kernels.
+"""
+
+import datetime
+
+NS_PER_USEC = 1_000
+NS_PER_MSEC = 1_000_000
+NS_PER_SEC = 1_000_000_000
+
+# i64 max. Used as "no event" sentinel in device arrays.
+TIME_MAX = (1 << 63) - 1
+
+# 2000-01-01T00:00:00Z as unix seconds (reference emulated_time.rs:28-48).
+EMUTIME_EPOCH_UNIX_SEC = int(
+    datetime.datetime(2000, 1, 1, tzinfo=datetime.timezone.utc).timestamp()
+)
+
+
+def sim_to_emulated_ns(sim_ns: int) -> int:
+    """SimulationTime (ns since sim start) -> EmulatedTime (ns since epoch)."""
+    return EMUTIME_EPOCH_UNIX_SEC * NS_PER_SEC + sim_ns
+
+
+def emulated_to_unix_ns(emu_ns: int) -> int:
+    """EmulatedTime -> unix ns, for pcap timestamps / strace-style logs."""
+    return emu_ns
+
+
+def fmt_time(sim_ns: int) -> str:
+    """Human display like the reference status bar (hh:mm:ss.mmm)."""
+    s, ns = divmod(int(sim_ns), NS_PER_SEC)
+    h, rem = divmod(s, 3600)
+    m, sec = divmod(rem, 60)
+    return f"{h:02d}:{m:02d}:{sec:02d}.{ns // NS_PER_MSEC:03d}"

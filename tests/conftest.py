@@ -1,0 +1,16 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Multi-chip sharding is validated the way the driver does it — N virtual CPU
+devices via --xla_force_host_platform_device_count (real multi-chip hardware is
+not available in this environment). This mirrors the reference's test posture:
+"multi-node" is many simulated hosts in one process (SURVEY.md §4.7).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,103 @@
+"""Config schema tests (reference analogue: src/test/config/)."""
+
+import pytest
+
+from shadow_tpu.config import load_config, merge_cli_overrides
+from shadow_tpu.config.options import ConfigError
+
+MINIMAL = """
+general: {stop_time: 10s}
+hosts:
+  a: {network_node_id: 0, processes: [{model: timer}]}
+"""
+
+
+def test_minimal_defaults():
+    cfg = load_config(MINIMAL, is_text=True)
+    assert cfg.general.stop_time == 10_000_000_000
+    assert cfg.general.seed == 1
+    assert cfg.general.heartbeat_interval == 1_000_000_000  # default "1 s"
+    assert cfg.experimental.scheduler == "tpu"
+    assert cfg.hosts[0].name == "a"
+    assert cfg.hosts[0].processes[0].model == "timer"
+
+
+def test_heartbeat_explicit_and_null():
+    cfg = load_config(
+        "general: {stop_time: 1s, heartbeat_interval: 5s}\nhosts: {}", is_text=True
+    )
+    assert cfg.general.heartbeat_interval == 5_000_000_000
+    cfg = load_config(
+        "general: {stop_time: 1s, heartbeat_interval: null}\nhosts: {}", is_text=True
+    )
+    assert cfg.general.heartbeat_interval is None
+
+
+def test_count_expansion():
+    cfg = load_config(
+        """
+general: {stop_time: 1s}
+hosts:
+  client: {network_node_id: 2, count: 3, processes: [{model: timer}]}
+""",
+        is_text=True,
+    )
+    assert [h.name for h in cfg.hosts] == ["client1", "client2", "client3"]
+    assert all(h.network_node_id == 2 for h in cfg.hosts)
+
+
+def test_unknown_keys_named():
+    with pytest.raises(ConfigError, match="sped"):
+        load_config("general: {stop_time: 1s, sped: 2}", is_text=True)
+    with pytest.raises(ConfigError, match="path.*model|model.*path"):
+        load_config(
+            "general: {stop_time: 1s}\nhosts: {a: {processes: [{}]}}", is_text=True
+        )
+
+
+def test_bandwidth_zero_is_explicit():
+    cfg = load_config(
+        """
+general: {stop_time: 1s}
+hosts:
+  a: {bandwidth_down: 0, bandwidth_up: "10 Mbit", processes: [{model: timer}]}
+""",
+        is_text=True,
+    )
+    assert cfg.hosts[0].bandwidth_down == 0  # not silently None
+    assert cfg.hosts[0].bandwidth_up == 10_000_000
+
+
+def test_cli_overrides():
+    cfg = load_config(MINIMAL, is_text=True)
+    cfg = merge_cli_overrides(
+        cfg,
+        {
+            "general.stop_time": "20s",
+            "general.seed": "9",
+            "general.heartbeat_interval": "2",
+            "experimental.rounds_per_chunk": "16",
+        },
+    )
+    assert cfg.general.stop_time == 20_000_000_000
+    assert cfg.general.seed == 9
+    assert cfg.general.heartbeat_interval == 2_000_000_000  # bare seconds, like YAML
+    assert cfg.experimental.rounds_per_chunk == 16
+    with pytest.raises(ConfigError, match="no_such"):
+        merge_cli_overrides(cfg, {"general.no_such": "1"})
+
+
+def test_host_option_defaults_cascade():
+    cfg = load_config(
+        """
+general: {stop_time: 1s}
+host_option_defaults: {pcap_enabled: true}
+hosts:
+  a: {processes: [{model: timer}]}
+  b: {host_options: {pcap_enabled: false}, processes: [{model: timer}]}
+""",
+        is_text=True,
+    )
+    by_name = {h.name: h for h in cfg.hosts}
+    assert by_name["a"].host_options.pcap_enabled is True
+    assert by_name["b"].host_options.pcap_enabled is False

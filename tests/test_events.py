@@ -1,0 +1,144 @@
+"""Event-queue kernel unit tests (reference analogue:
+src/main/core/work/event_queue.rs tests + event.rs ordering tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.ops import (
+    make_queue,
+    next_time,
+    pop_min,
+    push_one,
+    pack_order,
+    queue_len,
+    merge_flat_events,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+from shadow_tpu.simtime import TIME_MAX
+
+H, C = 4, 8
+
+
+def _push(q, host, t, order, kind=1, payload=None):
+    mask = jnp.arange(H) == host
+    tt = jnp.full((H,), t, jnp.int64)
+    oo = jnp.full((H,), order, jnp.int64)
+    kk = jnp.full((H,), kind, jnp.int32)
+    pp = jnp.zeros((H, EVENT_PAYLOAD_WORDS), jnp.int32)
+    if payload is not None:
+        pp = pp + jnp.asarray(payload, jnp.int32)[None, :]
+    return push_one(q, mask, tt, oo, kk, pp)
+
+
+def test_push_pop_roundtrip():
+    q = make_queue(H, C)
+    q = _push(q, 0, 100, 5)
+    q = _push(q, 0, 50, 7)
+    q = _push(q, 2, 10, 1)
+    nt = np.asarray(next_time(q))
+    assert nt[0] == 50 and nt[2] == 10 and nt[1] == TIME_MAX
+
+    q, ev, active = pop_min(q, TIME_MAX)
+    assert list(np.asarray(active)) == [True, False, True, False]
+    assert np.asarray(ev.t)[0] == 50
+    assert np.asarray(ev.t)[2] == 10
+
+    q, ev, active = pop_min(q, TIME_MAX)
+    assert np.asarray(ev.t)[0] == 100
+    assert not np.asarray(active)[2]
+
+
+def test_pop_respects_limit():
+    q = make_queue(H, C)
+    q = _push(q, 1, 500, 1)
+    q, ev, active = pop_min(q, 500)  # strictly-before semantics
+    assert not np.asarray(active)[1]
+    q, ev, active = pop_min(q, 501)
+    assert np.asarray(active)[1]
+
+
+def test_deterministic_tiebreak_packets_before_local():
+    """Equal times: packets (is_local=0) pop before local tasks, then by
+    (src, seq) — the event.rs:102-155 total order."""
+    q = make_queue(H, C)
+    q = _push(q, 0, 100, pack_order(1, 0, 3))  # local task
+    q = _push(q, 0, 100, pack_order(0, 2, 9))  # packet from host 2
+    q = _push(q, 0, 100, pack_order(0, 1, 11))  # packet from host 1
+    q, ev, _ = pop_min(q, TIME_MAX)
+    assert np.asarray(ev.order)[0] == int(pack_order(0, 1, 11))
+    q, ev, _ = pop_min(q, TIME_MAX)
+    assert np.asarray(ev.order)[0] == int(pack_order(0, 2, 9))
+    q, ev, _ = pop_min(q, TIME_MAX)
+    assert np.asarray(ev.order)[0] == int(pack_order(1, 0, 3))
+
+
+def test_overflow_counts_dropped():
+    q = make_queue(2, 2)
+    mask = jnp.array([True, False])
+    t = jnp.zeros((2,), jnp.int64)
+    o = jnp.zeros((2,), jnp.int64)
+    k = jnp.zeros((2,), jnp.int32)
+    p = jnp.zeros((2, EVENT_PAYLOAD_WORDS), jnp.int32)
+    for i in range(3):
+        q = push_one(q, mask, t + i, o + i, k, p)
+    assert int(q.dropped[0]) == 1
+    assert int(queue_len(q)[0]) == 2
+
+
+def test_merge_flat_events_sorted_and_counted():
+    q = make_queue(H, C)
+    q = _push(q, 1, 5, 1)  # pre-existing event occupies slot 0 of host 1
+    n = 6
+    dst = jnp.array([1, 1, 3, 1, 0, 2], jnp.int32)
+    t = jnp.array([30, 10, 7, 20, 9, 9], jnp.int64)
+    order = jnp.array([pack_order(0, s, i) for i, s in enumerate([2, 3, 0, 1, 1, 1])], jnp.int64)
+    kind = jnp.full((n,), 2, jnp.int32)
+    payload = jnp.tile(jnp.arange(n, dtype=jnp.int32)[:, None], (1, EVENT_PAYLOAD_WORDS))
+    valid = jnp.array([True, True, True, True, False, True])
+
+    q2 = merge_flat_events(q, dst, t, order, kind, payload, valid, max_inserts=C)
+    assert int(queue_len(q2)[1]) == 4  # 1 old + 3 merged
+    assert int(queue_len(q2)[0]) == 0  # invalid entry not inserted
+    assert int(queue_len(q2)[2]) == 1
+    assert int(queue_len(q2)[3]) == 1
+
+    # pop host 1 in order: 5 (old), then 10/20/30 by time
+    times = []
+    for _ in range(4):
+        q2, ev, active = pop_min(q2, TIME_MAX)
+        assert np.asarray(active)[1]
+        times.append(int(np.asarray(ev.t)[1]))
+    assert times == [5, 10, 20, 30]
+
+
+def test_merge_overflow_sheds_latest_not_earliest():
+    """Under overflow pressure the merge must keep the most urgent events:
+    drop priority is (time, order), not the raw order key."""
+    q = make_queue(1, 1)
+    dst = jnp.zeros((2,), jnp.int32)
+    t = jnp.array([100, 5], jnp.int64)
+    # the later event has the *smaller* order key (earlier src)
+    order = jnp.array([pack_order(0, 0, 1), pack_order(0, 3, 1)], jnp.int64)
+    kind = jnp.zeros((2,), jnp.int32)
+    payload = jnp.zeros((2, EVENT_PAYLOAD_WORDS), jnp.int32)
+    q2 = merge_flat_events(q, dst, t, order, kind, payload, jnp.ones((2,), bool), 4)
+    q2, ev, active = pop_min(q2, TIME_MAX)
+    assert int(np.asarray(ev.t)[0]) == 5  # urgent event survived
+    assert int(q2.dropped[0]) == 1
+
+
+def test_merge_overflow_drops_counted():
+    q = make_queue(2, 2)
+    n = 4
+    dst = jnp.zeros((n,), jnp.int32)
+    t = jnp.arange(n, dtype=jnp.int64) + 1
+    order = jnp.array([pack_order(0, 0, i) for i in range(n)], jnp.int64)
+    kind = jnp.zeros((n,), jnp.int32)
+    payload = jnp.zeros((n, EVENT_PAYLOAD_WORDS), jnp.int32)
+    valid = jnp.ones((n,), bool)
+    q2 = merge_flat_events(q, dst, t, order, kind, payload, valid, max_inserts=8)
+    assert int(queue_len(q2)[0]) == 2
+    assert int(q2.dropped[0]) == 2
+    # lowest-order entries won the slots
+    q2, ev, _ = pop_min(q2, TIME_MAX)
+    assert int(np.asarray(ev.t)[0]) == 1

@@ -1,0 +1,49 @@
+from shadow_tpu.config.units import (
+    TimeUnit,
+    parse_bits_per_sec,
+    parse_bytes,
+    parse_time_ns,
+)
+
+import pytest
+
+
+def test_time_suffixes():
+    assert parse_time_ns("50 ms") == 50_000_000
+    assert parse_time_ns("10s") == 10_000_000_000
+    assert parse_time_ns("1 us") == 1_000
+    assert parse_time_ns("3 min") == 180_000_000_000
+    assert parse_time_ns("2 h") == 7_200_000_000_000
+    assert parse_time_ns("1.5 ms") == 1_500_000
+
+
+def test_time_bare_default_unit():
+    assert parse_time_ns(10) == 10_000_000_000
+    assert parse_time_ns("10") == 10_000_000_000
+    assert parse_time_ns(10, TimeUnit.MS) == 10_000_000
+
+
+def test_bitrates():
+    assert parse_bits_per_sec("10 Mbit") == 10_000_000
+    assert parse_bits_per_sec("81920 Kibit") == 81920 * 1024
+    assert parse_bits_per_sec("1 Gbit") == 1_000_000_000
+    assert parse_bits_per_sec(12345) == 12345
+
+
+def test_bytes():
+    assert parse_bytes("1 GiB") == 2**30
+    assert parse_bytes("512 KB") == 512_000
+    assert parse_bytes("100 B") == 100
+    assert parse_bytes("2 MiB") == 2 * 2**20
+
+
+def test_fractional_rounds_not_truncates():
+    assert parse_time_ns("4.1 s") == 4_100_000_000
+    assert parse_bits_per_sec("0.5 Mbit") == 500_000
+
+
+def test_bad_units():
+    with pytest.raises(ValueError):
+        parse_time_ns("10 parsecs")
+    with pytest.raises(ValueError):
+        parse_bits_per_sec("10 Xbit")
