@@ -1,0 +1,26 @@
+"""PDES core: the round-based conservative event loop on device.
+
+TPU recast of the reference's L3-L5 (SURVEY.md §1): Controller window
+computation (src/main/core/controller.rs:88-112), Manager scheduling loop
+(manager.rs:392-478), per-thread min-next-event reduction (manager.rs:459-464
+→ lax.pmin over the mesh), and Host::execute's event dispatch
+(host.rs:809-864 → vectorized microsteps).
+"""
+
+from shadow_tpu.core.engine import (
+    Engine,
+    EngineConfig,
+    EngineParams,
+    SimState,
+    Stats,
+    Outbox,
+)
+
+__all__ = [
+    "Engine",
+    "EngineConfig",
+    "EngineParams",
+    "SimState",
+    "Stats",
+    "Outbox",
+]

@@ -1,0 +1,652 @@
+"""The conservative-PDES round engine, entirely on device.
+
+One *round* advances every host through the safe window
+[window_start, window_end):
+
+  1. barrier: global min next-event time over the mesh (`lax.pmin` — the
+     device form of the per-thread min reduction at reference
+     manager.rs:459-464 + controller.rs:88-112);
+  2. window_end = min(global_min + runahead, stop_time) where runahead is the
+     minimum network latency, optionally shrinking dynamically
+     (reference core/runahead.rs:44-57);
+  3. microsteps: while any local host has an event < window_end, every host
+     pops its earliest event (deterministic total order) and one vectorized
+     model dispatch executes for all active hosts (Host::execute,
+     host.rs:809-864). Packet arrivals pass ingress shaping (downlink token
+     bucket + CoDel) first; sends pass egress shaping and are staged in the
+     shard-local outbox;
+  4. exchange: outboxes all-gather across the mesh and merge into destination
+     queues with the deterministic sorted scatter (the lock-free replacement
+     for worker.rs:644-654's per-host mutex push). Conservative lookahead
+     guarantees every cross-host packet arrives >= window_end, which is what
+     makes the once-per-round exchange exact, not an approximation.
+
+Microstep loops have NO collectives, so shards run them at their own pace;
+rounds are the only synchronization points — exactly the reference's
+"hosts are the unit of parallelism" invariant (scheduler/src/lib.rs:3-6).
+
+Determinism: pops follow the packed (time, order) key; RNG advances are
+per-host masked; the cross-shard merge sorts by (dst, time, order); integer
+scatter-adds are order-free. Result: per-host event digests are bit-identical
+across runs AND across mesh shapes (the device analogue of the reference's
+determinism gate, src/test/determinism/). Scope note: bit-equality across
+*platforms* (TPU vs CPU) holds for the integer engine core and integer-only
+models, but models using float transcendentals (e.g. PHOLD's exponential
+draw) may diverge across backends — the reference likewise promises identical
+re-runs on one machine, not cross-machine equality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    KIND_INGRESS_DONE,
+    KIND_MASK,
+    KIND_PKT,
+    PAYLOAD_SIZE_WORD,
+)
+from shadow_tpu.net import (
+    TBParams,
+    TBState,
+    codel_init,
+    codel_on_packet,
+    tb_conforming_remove,
+    tb_init,
+)
+from shadow_tpu.ops import (
+    EventQueue,
+    ORDER_MAX,
+    check_order_limits,
+    merge_flat_events,
+    next_time,
+    pack_order,
+    pop_min,
+    push_one,
+)
+from shadow_tpu.ops.events import unpack_order_src
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+from shadow_tpu.ops.rng import RngState, rng_init, rng_uniform
+from shadow_tpu.simtime import TIME_MAX
+
+AXIS = "hosts"  # mesh axis name for the host dimension
+
+_FNV_PRIME = jnp.uint64(1099511628211)
+_MIX1 = jnp.uint64(0x9E3779B97F4A7C15)
+_MIX2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
+
+
+class Outbox(NamedTuple):
+    """Per-shard staging buffer for this round's outgoing packets."""
+
+    dst: Array  # i32[OB] global destination host id
+    t: Array  # i64[OB] arrival time (>= window_end); TIME_MAX = empty
+    order: Array  # i64[OB]
+    kind: Array  # i32[OB]
+    payload: Array  # i32[OB, P]
+    count: Array  # i32[1] entries appended this round (per shard)
+
+
+class Stats(NamedTuple):
+    """Device-side counters (reference: tracker.c per-host counters +
+    sim_stats.rs global counters + the determinism digest)."""
+
+    events: Array  # i64[H] events processed
+    pkts_sent: Array  # i64[H]
+    pkts_lost: Array  # i64[H] random path loss
+    pkts_unreachable: Array  # i64[H] no route to dst
+    pkts_codel_dropped: Array  # i64[H] (charged to the receiving host)
+    pkts_delivered: Array  # i64[H]
+    monotonic_violations: Array  # i64[H] pushes scheduled in the past
+    ob_dropped: Array  # i64[1] outbox-overflow losses (per shard)
+    microsteps: Array  # i64[1] total microsteps (per shard)
+    digest: Array  # u64[H] rolling per-host event-order digest
+    rounds: Array  # i64[] scheduling rounds completed (replicated)
+
+
+class SimState(NamedTuple):
+    now: Array  # i64[] completed-up-to time (replicated)
+    done: Array  # bool[] (replicated)
+    queue: EventQueue
+    rng: RngState
+    seq: Array  # i64[H] per-host emission counter (order-key seq)
+    tb_egress: TBState
+    tb_ingress: TBState
+    codel: Any  # CodelState
+    min_used_lat: Array  # i64[] min latency seen (dynamic runahead)
+    model: Any  # model state pytree
+    outbox: Outbox
+    stats: Stats
+
+
+class EngineParams(NamedTuple):
+    """Immutable per-sim arrays. Sharding: per-host arrays (bucket params,
+    model params) shard over the mesh; the routing tables (node_of, lat, loss)
+    are replicated — packet sends need arbitrary dst lookups. Dense node×node
+    tables bound graph size (~2k nodes ≈ 32 MiB); hosts-per-node is unbounded.
+    """
+
+    node_of: Array  # i32[H_total] host -> graph node (replicated)
+    lat_ns: Array  # i64[N, N] path latency; <0 = unreachable (replicated)
+    loss: Array  # f32[N, N] path loss probability (replicated)
+    eg_tb: TBParams  # uplink buckets (sharded per host)
+    in_tb: TBParams  # downlink buckets (sharded per host)
+    model: Any  # model param pytree (sharded per host)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static (trace-time) configuration."""
+
+    num_hosts: int
+    stop_time: int
+    bootstrap_end_time: int = 0
+    runahead_floor: int = 1_000_000  # 1 ms (reference runahead.rs default)
+    static_min_latency: int = 1_000_000
+    use_dynamic_runahead: bool = False
+    tb_interval_ns: int = 1_000_000  # token bucket refill quantum (1 ms)
+    use_codel: bool = True
+    queue_capacity: int = 64
+    outbox_capacity: int = 256  # per shard per round
+    max_round_inserts: int = 64  # per host per round
+    microstep_limit: int = 0  # 0 -> queue_capacity * 2
+    rounds_per_chunk: int = 64
+    world: int = 1  # mesh size (1 = single device)
+
+    def __post_init__(self):
+        check_order_limits(self.num_hosts)
+        if self.num_hosts % self.world != 0:
+            raise ValueError(
+                f"num_hosts={self.num_hosts} must divide evenly over "
+                f"world={self.world} mesh devices"
+            )
+
+    @property
+    def hosts_per_shard(self) -> int:
+        return self.num_hosts // self.world
+
+    @property
+    def effective_microstep_limit(self) -> int:
+        return self.microstep_limit or 2 * self.queue_capacity
+
+
+# --------------------------------------------------------------------------
+# state construction (host side)
+# --------------------------------------------------------------------------
+
+
+def _init_stats(cfg: EngineConfig) -> Stats:
+    h = cfg.num_hosts
+
+    # distinct buffers per field: the state pytree is donated to the jitted
+    # chunk, and donating one buffer through two leaves is an error
+    def zi():
+        return jnp.zeros((h,), jnp.int64)
+
+    return Stats(
+        events=zi(),
+        pkts_sent=zi(),
+        pkts_lost=zi(),
+        pkts_unreachable=zi(),
+        pkts_codel_dropped=zi(),
+        pkts_delivered=zi(),
+        monotonic_violations=zi(),
+        ob_dropped=jnp.zeros((cfg.world,), jnp.int64),
+        microsteps=jnp.zeros((cfg.world,), jnp.int64),
+        digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
+        rounds=jnp.zeros((), jnp.int64),
+    )
+
+
+def _init_outbox(cfg: EngineConfig) -> Outbox:
+    n = cfg.outbox_capacity * cfg.world
+    return Outbox(
+        dst=jnp.zeros((n,), jnp.int32),
+        t=jnp.full((n,), TIME_MAX, jnp.int64),
+        order=jnp.zeros((n,), jnp.int64),
+        kind=jnp.zeros((n,), jnp.int32),
+        payload=jnp.zeros((n, EVENT_PAYLOAD_WORDS), jnp.int32),
+        count=jnp.zeros((cfg.world,), jnp.int32),
+    )
+
+
+def seed_queue(
+    cfg: EngineConfig, initial_events: list[tuple[int, int, int, tuple]]
+) -> tuple[EventQueue, Array]:
+    """Build the t=0 queue from (host_id, t_ns, kind, payload) events — the
+    boot round (reference manager.rs:357-367 / host.rs:392 add_application).
+
+    Returns (queue, seq[H]) with per-host seq counters advanced past the
+    seeded events so later emissions keep globally unique order keys.
+    """
+    h, c = cfg.num_hosts, cfg.queue_capacity
+    t = np.full((h, c), TIME_MAX, np.int64)
+    order = np.full((h, c), ORDER_MAX, np.int64)
+    kind = np.zeros((h, c), np.int32)
+    payload = np.zeros((h, c, EVENT_PAYLOAD_WORDS), np.int32)
+    fill = np.zeros((h,), np.int32)
+    seq = np.zeros((h,), np.int64)
+    for host, t_ns, k, pl in initial_events:
+        slot = fill[host]
+        if slot >= c:
+            raise ValueError(
+                f"host {host}: {slot + 1} initial events exceed queue capacity {c}"
+            )
+        t[host, slot] = t_ns
+        order[host, slot] = int(pack_order(1, host, seq[host]))
+        kind[host, slot] = k
+        payload[host, slot, : len(pl)] = pl
+        fill[host] += 1
+        seq[host] += 1
+    return (
+        EventQueue(
+            t=jnp.asarray(t),
+            order=jnp.asarray(order),
+            kind=jnp.asarray(kind),
+            payload=jnp.asarray(payload),
+            dropped=jnp.zeros((h,), jnp.int64),
+        ),
+        jnp.asarray(seq),
+    )
+
+
+# --------------------------------------------------------------------------
+# device-side helpers
+# --------------------------------------------------------------------------
+
+
+def _digest_update(digest, active, t, kind, order):
+    x = t.astype(jnp.uint64) * _MIX1
+    x = x ^ (kind.astype(jnp.uint64) * _MIX2)
+    x = x ^ order.astype(jnp.uint64)
+    return jnp.where(active, (digest ^ x) * _FNV_PRIME, digest)
+
+
+def _outbox_append(ob: Outbox, cap: int, mask, dst, t, order, kind, payload):
+    """Append up to one entry per host, in host-id order (deterministic)."""
+    cnt = ob.count[0]
+    mask_i = jnp.asarray(mask, jnp.int32)
+    pos = cnt + jnp.cumsum(mask_i) - 1
+    ok = mask & (pos < cap)
+    idx = jnp.where(ok, pos, cap)  # cap = out-of-bounds -> dropped
+    new = Outbox(
+        dst=ob.dst.at[idx].set(dst.astype(jnp.int32), mode="drop"),
+        t=ob.t.at[idx].set(t, mode="drop"),
+        order=ob.order.at[idx].set(order, mode="drop"),
+        kind=ob.kind.at[idx].set(kind.astype(jnp.int32), mode="drop"),
+        payload=ob.payload.at[idx].set(payload, mode="drop"),
+        count=(cnt + jnp.sum(mask_i))[None].astype(jnp.int32),
+    )
+    n_lost = jnp.sum(jnp.asarray(mask & ~ok, jnp.int64))
+    return new, n_lost
+
+
+class Engine:
+    """Builds and runs the jitted round loop for a fixed (config, model).
+
+    Single-device: `run_chunk(state, params)`. Multi-device: the same function
+    wrapped in shard_map over a 1-D mesh of `cfg.world` devices. The Python
+    driver loop (`shadow_tpu.sim`) calls chunks until `state.done`.
+    """
+
+    def __init__(self, cfg: EngineConfig, model, mesh: Mesh | None = None):
+        if (mesh is None) != (cfg.world == 1):
+            raise ValueError("mesh must be provided iff cfg.world > 1")
+        self.cfg = cfg
+        self.model = model
+        self.mesh = mesh
+        self.run_chunk = None  # built by init_state (needs model pytree shapes)
+
+    def _build_run_chunk(self):
+        axis = AXIS if self.mesh is not None else None
+        chunk = functools.partial(_run_chunk, self.cfg, self.model, axis)
+        if self.mesh is not None:
+            state_spec = self.state_specs()
+            param_spec = self.param_specs()
+            chunk = jax.shard_map(
+                chunk,
+                mesh=self.mesh,
+                in_specs=(state_spec, param_spec),
+                out_specs=state_spec,
+                check_vma=False,
+            )
+        self.run_chunk = jax.jit(chunk, donate_argnums=0)
+
+    # ---- sharding specs ----------------------------------------------------
+
+    def _model_specs(self, tree):
+        return jax.tree.map(lambda _: P(AXIS), tree)
+
+    def state_specs(self):
+        sh, rep = P(AXIS), P()
+        return SimState(
+            now=rep,
+            done=rep,
+            queue=EventQueue(t=sh, order=sh, kind=sh, payload=sh, dropped=sh),
+            rng=RngState(s=sh),
+            seq=sh,
+            tb_egress=TBState(tokens=sh, last_itv=sh),
+            tb_ingress=TBState(tokens=sh, last_itv=sh),
+            codel=jax.tree.map(lambda _: sh, codel_init(1)),
+            min_used_lat=rep,
+            model=self._model_state_spec_tree,
+            outbox=Outbox(dst=sh, t=sh, order=sh, kind=sh, payload=sh, count=sh),
+            stats=Stats(
+                events=sh,
+                pkts_sent=sh,
+                pkts_lost=sh,
+                pkts_unreachable=sh,
+                pkts_codel_dropped=sh,
+                pkts_delivered=sh,
+                monotonic_violations=sh,
+                ob_dropped=sh,
+                microsteps=sh,
+                digest=sh,
+                rounds=rep,
+            ),
+        )
+
+    def param_specs(self):
+        sh, rep = P(AXIS), P()
+        return EngineParams(
+            node_of=rep,
+            lat_ns=rep,
+            loss=rep,
+            eg_tb=TBParams(capacity=sh, refill=sh),
+            in_tb=TBParams(capacity=sh, refill=sh),
+            model=self._model_param_spec_tree,
+        )
+
+    # ---- initialization ----------------------------------------------------
+
+    def init_state(
+        self,
+        params: EngineParams,
+        model_state,
+        initial_events: list[tuple[int, int, int, tuple]],
+        seed: int,
+    ) -> tuple[SimState, EngineParams]:
+        """Returns (state, params) — params come back re-device_put with the
+        mesh sharding when running multi-device; always use the returned pair."""
+        cfg = self.cfg
+        queue, seq = seed_queue(cfg, initial_events)
+        self._model_state_spec_tree = self._model_specs(model_state)
+        self._model_param_spec_tree = self._model_specs(params.model)
+        self._build_run_chunk()
+        state = SimState(
+            now=jnp.zeros((), jnp.int64),
+            done=jnp.zeros((), bool),
+            queue=queue,
+            rng=rng_init(cfg.num_hosts, seed),
+            seq=seq,
+            tb_egress=tb_init(params.eg_tb),
+            tb_ingress=tb_init(params.in_tb),
+            codel=codel_init(cfg.num_hosts),
+            min_used_lat=jnp.asarray(cfg.static_min_latency, jnp.int64),
+            model=model_state,
+            outbox=_init_outbox(cfg),
+            stats=_init_stats(cfg),
+        )
+        if self.mesh is not None:
+            state = jax.device_put(
+                state,
+                jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s), self.state_specs()
+                ),
+            )
+            params = jax.device_put(
+                params,
+                jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s), self.param_specs()
+                ),
+            )
+        return state, params
+
+
+# --------------------------------------------------------------------------
+# the round loop (pure function of (cfg, model, axis); shard-local arrays)
+# --------------------------------------------------------------------------
+
+
+def _pmin(x, axis):
+    return lax.pmin(x, axis) if axis else x
+
+
+def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EngineParams):
+    def cond(carry):
+        st, i = carry
+        return (~st.done) & (i < cfg.rounds_per_chunk)
+
+    def body(carry):
+        st, i = carry
+        st = _round_step(cfg, model, axis, st, params)
+        return st, i + 1
+
+    state, _ = lax.while_loop(cond, body, (state, jnp.zeros((), jnp.int64)))
+    return state
+
+
+def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EngineParams):
+    h_local = st.queue.t.shape[0]
+    shard_start = (
+        lax.axis_index(axis).astype(jnp.int64) * h_local if axis else jnp.int64(0)
+    )
+    host_gid = shard_start + jnp.arange(h_local, dtype=jnp.int64)
+
+    # ---- 1-2: barrier + window (controller.rs:88-112)
+    lmin = jnp.min(next_time(st.queue))
+    gmin = _pmin(lmin, axis)
+    done = gmin >= cfg.stop_time  # TIME_MAX (empty everywhere) implies done
+    gmin_safe = jnp.minimum(gmin, cfg.stop_time)
+    runahead = (
+        jnp.maximum(jnp.asarray(cfg.runahead_floor, jnp.int64), st.min_used_lat)
+        if cfg.use_dynamic_runahead
+        else jnp.asarray(max(cfg.runahead_floor, cfg.static_min_latency), jnp.int64)
+    )
+    window_end = jnp.minimum(gmin_safe + jnp.maximum(runahead, 1), cfg.stop_time)
+
+    # ---- 3: microsteps (no collectives inside — shards proceed independently)
+    def micro_cond(carry):
+        stc, steps = carry
+        return jnp.any(next_time(stc.queue) < window_end) & (
+            steps < cfg.effective_microstep_limit
+        )
+
+    def micro_body(carry):
+        stc, steps = carry
+        stc = _microstep(cfg, model, stc, params, host_gid, window_end)
+        return stc, steps + 1
+
+    st_m, steps = lax.while_loop(micro_cond, micro_body, (st, jnp.zeros((), jnp.int64)))
+
+    # ---- 4: exchange staged packets across the mesh
+    st_x = _exchange(cfg, axis, st_m)
+
+    stats = st_x.stats._replace(
+        rounds=st_x.stats.rounds + jnp.where(done, 0, 1),
+        microsteps=st_x.stats.microsteps + steps[None],
+    )
+    min_used = _pmin(st_x.min_used_lat, axis)
+    return st_x._replace(
+        now=jnp.where(done, st.now, window_end),
+        done=done,
+        min_used_lat=min_used,
+        stats=stats,
+    )
+
+
+def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
+    queue, ev, active = pop_min(st.queue, window_end)
+    stats = st.stats
+    stats = stats._replace(
+        events=stats.events + active,
+        digest=_digest_update(stats.digest, active, ev.t, ev.kind, ev.order),
+    )
+
+    is_pkt = (ev.kind & KIND_PKT) != 0
+    needs_ingress = active & is_pkt & ((ev.kind & KIND_INGRESS_DONE) == 0)
+
+    # ---- ingress pipeline: CoDel at the router queue, then the downlink
+    # token bucket. The law sees the delay the packet WOULD experience, and
+    # only survivors consume bandwidth (reference: the relay pulls from the
+    # CoDel queue, so dropped packets are never charged; router/mod.rs:47-62).
+    size_bits = jnp.asarray(ev.payload[:, PAYLOAD_SIZE_WORD], jnp.int64) * 8
+    no_mask = jnp.zeros_like(needs_ingress)
+    _, depart_probe = tb_conforming_remove(
+        st.tb_ingress, params.in_tb, cfg.tb_interval_ns, ev.t, size_bits, no_mask
+    )
+    sojourn = depart_probe - ev.t
+    if cfg.use_codel:
+        codel, codel_drop = codel_on_packet(st.codel, ev.t, sojourn, needs_ingress)
+    else:
+        codel, codel_drop = st.codel, jnp.zeros_like(needs_ingress)
+    tb_in, depart = tb_conforming_remove(
+        st.tb_ingress,
+        params.in_tb,
+        cfg.tb_interval_ns,
+        ev.t,
+        size_bits,
+        needs_ingress & ~codel_drop,
+    )
+    delay = needs_ingress & ~codel_drop & (depart > ev.t)
+    queue = push_one(
+        queue, delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE, ev.payload
+    )
+    stats = stats._replace(
+        pkts_codel_dropped=stats.pkts_codel_dropped + codel_drop
+    )
+
+    # ---- model dispatch (Host::execute -> TaskRef::execute / packet receive)
+    dispatch = active & ~(needs_ingress & (codel_drop | delay))
+    stats = stats._replace(pkts_delivered=stats.pkts_delivered + (dispatch & is_pkt))
+    ctx = HandlerCtx(
+        t=ev.t,
+        window_end=window_end,
+        kind=ev.kind & KIND_MASK,
+        payload=ev.payload,
+        active=dispatch,
+        is_packet=is_pkt,
+        src=unpack_order_src(ev.order),
+        host_id=host_gid,
+        state=st.model,
+        params=params.model,
+        rng=st.rng,
+    )
+    out = model.handle(ctx)
+    rng, model_state = out.rng, out.state
+    seq = st.seq
+    tb_eg = st.tb_egress
+    outbox = st.outbox
+    ob_lost = jnp.zeros((), jnp.int64)
+
+    # ---- local pushes (schedule_task_* analogue)
+    for p in out.pushes:
+        mask = p.mask & dispatch
+        t_req = jnp.asarray(p.t, jnp.int64)
+        stats = stats._replace(
+            monotonic_violations=stats.monotonic_violations + (mask & (t_req < ev.t))
+        )
+        t_push = jnp.maximum(t_req, ev.t)
+        order = pack_order(1, host_gid, seq)
+        seq = seq + mask
+        queue = push_one(
+            queue, mask, t_push, order, jnp.asarray(p.kind, jnp.int32) & KIND_MASK,
+            p.payload,
+        )
+
+    # ---- sends: egress pipeline (worker.rs:330-425 send_packet)
+    for s in out.sends:
+        mask = s.mask & dispatch
+        sz = jnp.asarray(s.size_bytes, jnp.int32)
+        tb_eg, eg_depart = tb_conforming_remove(
+            tb_eg, params.eg_tb, cfg.tb_interval_ns, ev.t, sz.astype(jnp.int64) * 8, mask
+        )
+        dst_raw = jnp.asarray(s.dst, jnp.int64)
+        bad_dst = mask & ((dst_raw < 0) | (dst_raw >= cfg.num_hosts))
+        dst = jnp.clip(dst_raw, 0, cfg.num_hosts - 1)  # safe gather only
+        src_node = params.node_of[host_gid]
+        dst_node = params.node_of[dst]
+        lat = params.lat_ns[src_node, dst_node]
+        lossp = params.loss[src_node, dst_node]
+        # a model emitting an out-of-range dst is a bug: surface it as
+        # unreachable rather than silently delivering to a clamped host
+        unreachable = mask & ((lat < 0) | bad_dst)
+        rng, u = rng_uniform(rng, mask)
+        lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
+        send_ok = mask & ~lost & ~unreachable
+        # conservative-PDES clamp (worker.rs:411-414): never before round end
+        arrive = jnp.maximum(eg_depart + jnp.maximum(lat, 0), window_end)
+        order = pack_order(0, host_gid, seq)
+        seq = seq + mask
+        payload = s.payload.at[:, PAYLOAD_SIZE_WORD].set(sz)
+        outbox, n_lost = _outbox_append(
+            outbox,
+            cfg.outbox_capacity,
+            send_ok,
+            dst,
+            arrive,
+            order,
+            jnp.asarray(s.kind, jnp.int32) | KIND_PKT,
+            payload,
+        )
+        ob_lost = ob_lost + n_lost
+        used_lat = jnp.where(send_ok, lat, TIME_MAX)
+        st = st._replace(
+            min_used_lat=jnp.minimum(st.min_used_lat, jnp.min(used_lat))
+        )
+        stats = stats._replace(
+            pkts_sent=stats.pkts_sent + mask,
+            pkts_lost=stats.pkts_lost + lost,
+            pkts_unreachable=stats.pkts_unreachable + unreachable,
+        )
+
+    stats = stats._replace(ob_dropped=stats.ob_dropped + ob_lost[None])
+    return st._replace(
+        queue=queue,
+        rng=rng,
+        seq=seq,
+        tb_egress=tb_eg,
+        tb_ingress=tb_in,
+        codel=codel,
+        model=model_state,
+        outbox=outbox,
+        stats=stats,
+    )
+
+
+def _exchange(cfg, axis, st: SimState):
+    ob = st.outbox
+    if axis:
+        g = jax.tree.map(
+            lambda a: lax.all_gather(a, axis, tiled=True),
+            Outbox(ob.dst, ob.t, ob.order, ob.kind, ob.payload, ob.count),
+        )
+    else:
+        g = ob
+    h_local = st.queue.t.shape[0]
+    shard_start = (
+        lax.axis_index(axis).astype(jnp.int32) * h_local if axis else jnp.int32(0)
+    )
+    local = g.dst - shard_start
+    valid = (g.t != TIME_MAX) & (local >= 0) & (local < h_local)
+    queue = merge_flat_events(
+        st.queue, local, g.t, g.order, g.kind, g.payload, valid, cfg.max_round_inserts
+    )
+    fresh = Outbox(
+        dst=jnp.zeros_like(ob.dst),
+        t=jnp.full_like(ob.t, TIME_MAX),
+        order=jnp.zeros_like(ob.order),
+        kind=jnp.zeros_like(ob.kind),
+        payload=jnp.zeros_like(ob.payload),
+        count=jnp.zeros_like(ob.count),
+    )
+    return st._replace(queue=queue, outbox=fresh)

@@ -1,0 +1,37 @@
+"""Host application models, vectorized over all simulated hosts.
+
+The reference runs real Linux binaries per host (the managed-process plane,
+SURVEY.md L0/L2). The TPU build additionally provides *device models*: app
+behaviors expressed as vectorized event handlers that run entirely on device —
+the "synthetic app model" of SURVEY.md §7 step 4 — so pure-simulation
+workloads (PHOLD, tgen-style traffic, gossip, timers) never leave HBM.
+
+Model registry: config `processes: [{model: <name>, model_args: {...}}]`
+resolves here.
+"""
+
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    HandlerOut,
+    LocalPush,
+    PacketSend,
+    Model,
+    register_model,
+    get_model,
+    MODEL_REGISTRY,
+)
+from shadow_tpu.models import timer as _timer  # noqa: F401  (registers)
+from shadow_tpu.models import phold as _phold  # noqa: F401
+from shadow_tpu.models import echo as _echo  # noqa: F401
+from shadow_tpu.models import gossip as _gossip  # noqa: F401
+
+__all__ = [
+    "HandlerCtx",
+    "HandlerOut",
+    "LocalPush",
+    "PacketSend",
+    "Model",
+    "register_model",
+    "get_model",
+    "MODEL_REGISTRY",
+]

@@ -1,0 +1,125 @@
+"""Vectorized host-model interface.
+
+A model is the device-side analogue of the reference's managed process +
+syscall surface for simulated-only hosts: instead of one process per host
+issuing syscalls, ONE set of handlers executes for ALL hosts per microstep,
+with per-host masks selecting who is active (classic SoA/SPMD recast of
+Host::execute's per-event dispatch, reference src/main/host/host.rs:809-864).
+
+Contract (what keeps the simulation deterministic — violating these breaks the
+determinism gate, tests/test_determinism.py):
+  - `handle` must be a pure jax function of (ctx, model params);
+  - RNG draws go through ops.rng with mask = the hosts actually consuming the
+    draw (never draw unconditionally for all hosts);
+  - state updates must be masked by `ctx.active` (inactive lanes unchanged);
+  - at most one event is handled per host per microstep; fan-out patterns
+    re-push a local continuation event at the same timestamp (the engine's
+    order key keeps continuation order deterministic).
+
+Emission ports are static: `HandlerOut.pushes` / `.sends` are tuples whose
+length is fixed at trace time (each port costs one scatter per microstep —
+keep them few; use continuations for wide fan-out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Protocol
+
+from jax import Array
+
+from shadow_tpu.ops.rng import RngState
+
+# Event-kind space: models use kinds 0..KIND_MASK; the engine owns flag bits.
+KIND_MASK = 0xFFFF
+KIND_PKT = 1 << 16  # event is a packet arrival (set by the engine at send)
+KIND_INGRESS_DONE = 1 << 17  # packet already passed ingress shaping
+
+# Packet payload convention: word 0 = size in bytes (engine-owned: drives
+# bandwidth shaping); words 1..3 are model-defined.
+PAYLOAD_SIZE_WORD = 0
+
+
+@dataclasses.dataclass
+class HandlerCtx:
+    """Per-microstep context handed to Model.handle (all arrays shard-local)."""
+
+    t: Array  # i64[H] event time (valid where active)
+    window_end: Array  # i64[] current round end
+    kind: Array  # i32[H] model kind (engine flags stripped)
+    payload: Array  # i32[H, P]
+    active: Array  # bool[H] host handles an event this microstep
+    is_packet: Array  # bool[H] event is a delivered packet
+    src: Array  # i64[H] sending host's global id (valid for packets)
+    host_id: Array  # i64[H] global host ids of this shard
+    state: Any  # model state pytree ([H, ...] arrays)
+    params: Any  # model param pytree ([H, ...] arrays, immutable)
+    rng: RngState
+
+
+class LocalPush(NamedTuple):
+    """Schedule a future event on the host's own queue (timer/task analogue,
+    reference host.rs:731-738 schedule_task_*)."""
+
+    mask: Array  # bool[H]
+    t: Array  # i64[H] absolute time, must be >= ctx.t
+    kind: Array  # i32[H] model kind
+    payload: Array  # i32[H, P]
+
+
+class PacketSend(NamedTuple):
+    """Send a packet to a (possibly remote) host — enters the egress pipeline:
+    token bucket → latency/loss → round-barrier exchange (worker.rs:330-425)."""
+
+    mask: Array  # bool[H]
+    dst: Array  # i64[H] global destination host id
+    size_bytes: Array  # i32[H]
+    kind: Array  # i32[H] model kind dispatched at the destination
+    payload: Array  # i32[H, P] (word 0 overwritten with size_bytes)
+
+
+class HandlerOut(NamedTuple):
+    state: Any
+    rng: RngState
+    pushes: tuple[LocalPush, ...] = ()
+    sends: tuple[PacketSend, ...] = ()
+
+
+class Model(Protocol):
+    """A host application model (see module docstring for the contract)."""
+
+    name: str
+
+    def build(self, hosts: list[dict], seed: int) -> tuple[Any, Any, list]:
+        """Host-side setup. `hosts` is one dict per simulated host:
+        {"host_id": int, "model_args": {...}, "start_time": ns, ...}.
+
+        Returns (params, state, initial_events) where initial_events is a list
+        of (host_id, t_ns, kind, payload_tuple) seeded into the event queue
+        (the analogue of Host::add_application scheduling process start tasks,
+        reference host.rs:392)."""
+        ...
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        ...
+
+    def report(self, state, hosts: list[dict]) -> dict:
+        """Host-side end-of-sim summary from final model state (the analogue
+        of per-process exit status / stdout, used by tests and sim-stats)."""
+        ...
+
+
+MODEL_REGISTRY: dict[str, type] = {}
+
+
+def register_model(cls):
+    MODEL_REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_model(name: str):
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name]

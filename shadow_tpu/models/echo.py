@@ -1,0 +1,154 @@
+"""UDP echo / request-response traffic (tgen-style fixed-size flows).
+
+The device recast of the reference's tgen integration workloads
+(src/test/tgen/fixed_size) and BASELINE.json configs #1-2: clients send
+fixed-size requests to a server on a schedule; servers echo; clients record
+RTTs. Roles are per-host params in ONE model (a simulation runs one model;
+heterogeneous behavior lives in the role/arg arrays).
+
+RTT measurement packs the i64 send timestamp into two i32 payload words — the
+device analogue of tgen stamping payloads.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config.units import TimeUnit, parse_time_ns
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    HandlerOut,
+    LocalPush,
+    PacketSend,
+    register_model,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+
+KIND_TICK = 0  # client send timer
+KIND_REQ = 1  # request packet (server handles)
+KIND_RESP = 2  # response packet (client handles)
+
+ROLE_CLIENT = 0
+ROLE_SERVER = 1
+
+
+def _pack_t(t):
+    lo = (t & 0xFFFFFFFF).astype(jnp.int32)
+    hi = (t >> 32).astype(jnp.int32)
+    return lo, hi
+
+
+def _unpack_t(lo, hi):
+    return (hi.astype(jnp.int64) << 32) | (lo.astype(jnp.int64) & 0xFFFFFFFF)
+
+
+@register_model
+class UdpEchoModel:
+    name = "udp_echo"
+
+    def build(self, hosts, seed):
+        h = len(hosts)
+        role = np.zeros((h,), np.int32)
+        peer = np.zeros((h,), np.int64)
+        interval = np.zeros((h,), np.int64)
+        size = np.zeros((h,), np.int32)
+        by_name = {hh["name"]: hh["host_id"] for hh in hosts}
+        for i, hh in enumerate(hosts):
+            a = hh["model_args"]
+            r = a.get("role", "client")
+            role[i] = ROLE_SERVER if r == "server" else ROLE_CLIENT
+            if role[i] == ROLE_CLIENT:
+                p = a.get("peer")
+                if p is None:
+                    raise ValueError(f"echo client {hh['name']} needs model_args.peer")
+                peer[i] = by_name[p] if isinstance(p, str) else int(p)
+            interval[i] = parse_time_ns(a.get("interval", "1 s"), TimeUnit.SEC)
+            size[i] = int(a.get("size_bytes", 512))
+        params = {
+            "role": jnp.asarray(role),
+            "peer": jnp.asarray(peer),
+            "interval": jnp.asarray(interval),
+            "size": jnp.asarray(size),
+        }
+        state = {
+            "sent": jnp.zeros((h,), jnp.int64),
+            "rcvd": jnp.zeros((h,), jnp.int64),  # responses (client) / requests (server)
+            "rtt_sum": jnp.zeros((h,), jnp.int64),
+            "rtt_max": jnp.zeros((h,), jnp.int64),
+        }
+        events = [
+            (hh["host_id"], hh["start_time"], KIND_TICK, ())
+            for i, hh in enumerate(hosts)
+            if role[i] == ROLE_CLIENT
+        ]
+        return params, state, events
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        h = ctx.kind.shape[0]
+        is_client = ctx.params["role"] == ROLE_CLIENT
+        is_server = ctx.params["role"] == ROLE_SERVER
+        zeros_payload = jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32)
+
+        # --- client tick: send a stamped request, schedule next tick
+        tick = ctx.active & (ctx.kind == KIND_TICK) & is_client
+        lo, hi = _pack_t(ctx.t)
+        req_payload = zeros_payload.at[:, 1].set(lo).at[:, 2].set(hi)
+        send_req = PacketSend(
+            mask=tick,
+            dst=ctx.params["peer"],
+            size_bytes=ctx.params["size"],
+            kind=jnp.full((h,), KIND_REQ, jnp.int32),
+            payload=req_payload,
+        )
+        push_tick = LocalPush(
+            mask=tick,
+            t=ctx.t + ctx.params["interval"],
+            kind=jnp.full((h,), KIND_TICK, jnp.int32),
+            payload=zeros_payload,
+        )
+
+        # --- server: echo the request payload (timestamp rides back)
+        req = ctx.active & (ctx.kind == KIND_REQ) & is_server
+        send_resp = PacketSend(
+            mask=req,
+            dst=ctx.src,
+            size_bytes=ctx.payload[:, 0],  # echo the request size
+            kind=jnp.full((h,), KIND_RESP, jnp.int32),
+            payload=ctx.payload,
+        )
+
+        # --- client response: record RTT
+        resp = ctx.active & (ctx.kind == KIND_RESP) & is_client
+        t_sent = _unpack_t(ctx.payload[:, 1], ctx.payload[:, 2])
+        rtt = jnp.where(resp, ctx.t - t_sent, 0)
+
+        state = {
+            "sent": ctx.state["sent"] + tick,
+            "rcvd": ctx.state["rcvd"] + (resp | req),
+            "rtt_sum": ctx.state["rtt_sum"] + rtt,
+            "rtt_max": jnp.maximum(ctx.state["rtt_max"], rtt),
+        }
+        return HandlerOut(
+            state=state,
+            rng=ctx.rng,
+            pushes=(push_tick,),
+            sends=(send_req, send_resp),
+        )
+
+    def report(self, state, hosts):
+        sent = np.asarray(state["sent"])
+        rcvd = np.asarray(state["rcvd"])
+        rtt_sum = np.asarray(state["rtt_sum"])
+        roles = np.array(
+            [1 if hh["model_args"].get("role") == "server" else 0 for hh in hosts]
+        )
+        client = roles == 0
+        n_resp = rcvd[client].sum()
+        return {
+            "requests_sent": int(sent[client].sum()),
+            "responses_received": int(n_resp),
+            "requests_served": int(rcvd[~client].sum()),
+            "mean_rtt_ms": float(rtt_sum[client].sum() / max(n_resp, 1) / 1e6),
+            "max_rtt_ms": float(np.asarray(state["rtt_max"]).max() / 1e6),
+        }

@@ -1,0 +1,123 @@
+"""Gossip/pubsub flood: high-fan-out scatter workload.
+
+BASELINE.json config #3 ("100k-host gossip/pubsub flood, sparse adjacency").
+A source publishes a message; every host forwards it once to `fanout` random
+static neighbors. Fan-out uses the engine's continuation pattern: one packet
+per microstep, with a same-timestamp local continuation event walking the
+neighbor list — deterministic order, no dynamic shapes (see
+models/base.py contract).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    HandlerOut,
+    LocalPush,
+    PacketSend,
+    register_model,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+
+KIND_MSG = 0  # gossip packet arrives
+KIND_FWD = 1  # forwarding continuation (payload word1 = neighbor index)
+KIND_PUB = 2  # publisher's initial event
+
+
+@register_model
+class GossipModel:
+    name = "gossip"
+
+    def build(self, hosts, seed):
+        h = len(hosts)
+        args0 = hosts[0]["model_args"]
+        fanout = int(args0.get("fanout", 8))
+        size = int(args0.get("payload_bytes", 256))
+        rng = np.random.default_rng(seed)
+        # static random neighbor lists (sparse adjacency, CSR-like [H, K])
+        neighbors = rng.integers(0, h, size=(h, fanout), dtype=np.int64)
+        # avoid self-loops deterministically
+        self_rows = neighbors == np.arange(h)[:, None]
+        neighbors = np.where(self_rows, (neighbors + 1) % h, neighbors)
+        params = {
+            "neighbors": jnp.asarray(neighbors),
+            "size": jnp.full((h,), size, jnp.int32),
+            "fanout": jnp.full((h,), fanout, jnp.int32),
+        }
+        state = {
+            "seen": jnp.zeros((h,), bool),
+            "recv_time": jnp.full((h,), -1, jnp.int64),
+            "hops": jnp.full((h,), -1, jnp.int32),
+            "fwd_idx": jnp.zeros((h,), jnp.int32),
+        }
+        events = []
+        for hh in hosts:
+            if hh["model_args"].get("publisher", False):
+                events.append((hh["host_id"], hh["start_time"], KIND_PUB, ()))
+        return params, state, events
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        h = ctx.kind.shape[0]
+        seen = ctx.state["seen"]
+        msg = ctx.active & ((ctx.kind == KIND_MSG) | (ctx.kind == KIND_PUB))
+        fresh = msg & ~seen
+        hop = jnp.where(ctx.kind == KIND_PUB, 0, ctx.payload[:, 1] + 1)
+
+        # first sight: record + start the forwarding walk at neighbor 0
+        state = {
+            "seen": seen | fresh,
+            "recv_time": jnp.where(fresh, ctx.t, ctx.state["recv_time"]),
+            "hops": jnp.where(fresh, hop, ctx.state["hops"]),
+            "fwd_idx": ctx.state["fwd_idx"],
+        }
+        zeros_payload = jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32)
+        start_fwd = LocalPush(
+            mask=fresh,
+            t=ctx.t,
+            kind=jnp.full((h,), KIND_FWD, jnp.int32),
+            payload=zeros_payload.at[:, 1].set(hop),
+        )
+
+        # continuation: send to neighbors[fwd_idx], re-push until fanout done
+        fwd = ctx.active & (ctx.kind == KIND_FWD)
+        idx = state["fwd_idx"]
+        more = fwd & (idx < ctx.params["fanout"])
+        nbr = jnp.take_along_axis(
+            ctx.params["neighbors"],
+            jnp.clip(idx, 0, ctx.params["neighbors"].shape[1] - 1)[:, None].astype(
+                jnp.int64
+            ),
+            axis=1,
+        )[:, 0]
+        send = PacketSend(
+            mask=more,
+            dst=nbr,
+            size_bytes=ctx.params["size"],
+            kind=jnp.full((h,), KIND_MSG, jnp.int32),
+            payload=ctx.payload,  # hop count rides in word 1
+        )
+        state["fwd_idx"] = jnp.where(more, idx + 1, idx)
+        cont = LocalPush(
+            mask=more & ((idx + 1) < ctx.params["fanout"]),
+            t=ctx.t,
+            kind=jnp.full((h,), KIND_FWD, jnp.int32),
+            payload=ctx.payload,
+        )
+        return HandlerOut(
+            state=state, rng=ctx.rng, pushes=(start_fwd, cont), sends=(send,)
+        )
+
+    def report(self, state, hosts):
+        seen = np.asarray(state["seen"])
+        hops = np.asarray(state["hops"])
+        rt = np.asarray(state["recv_time"])
+        reached = seen.sum()
+        return {
+            "reached": int(reached),
+            "coverage": float(reached / len(seen)),
+            "max_hops": int(hops.max()),
+            "spread_ms": float((rt.max() - rt[rt >= 0].min()) / 1e6) if reached else 0.0,
+        }

@@ -1,0 +1,100 @@
+"""PHOLD: the classic PDES benchmark workload.
+
+Reference: src/test/phold/ — Shadow's PHOLD is a real socket program (10 hosts,
+50 ms latency) where each node holds messages and forwards them to random peers
+after random delays. Device recast: each host starts with `population` jobs;
+handling a job draws a random peer and sends it a small packet after an
+exponential holding delay; receiving the packet is the next job. Event
+population is conserved, so this stresses the steady-state round loop +
+exchange path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config.units import TimeUnit, parse_time_ns
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    HandlerOut,
+    LocalPush,
+    PacketSend,
+    register_model,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+from shadow_tpu.ops.rng import rng_uniform
+
+KIND_JOB = 0  # a held message matures: pick a peer, send
+KIND_MSG = 1  # message arrives: hold it, then it matures
+
+
+@register_model
+class PholdModel:
+    name = "phold"
+
+    def build(self, hosts, seed):
+        h = len(hosts)
+        args0 = hosts[0]["model_args"]
+        mean_delay = np.array(
+            [
+                parse_time_ns(hh["model_args"].get("mean_delay", "100 ms"), TimeUnit.MS)
+                for hh in hosts
+            ],
+            np.int64,
+        )
+        size = int(args0.get("payload_bytes", 64))
+        population = int(args0.get("population", 1))
+        params = {
+            "mean_delay": jnp.asarray(mean_delay),
+            "size": jnp.full((h,), size, jnp.int32),
+            "num_hosts": jnp.full((h,), h, jnp.int64),
+        }
+        state = {"handled": jnp.zeros((h,), jnp.int64)}
+        events = []
+        for hh in hosts:
+            for _ in range(population):
+                events.append((hh["host_id"], hh["start_time"], KIND_JOB, ()))
+        return params, state, events
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        h = ctx.kind.shape[0]
+        job = ctx.active & (ctx.kind == KIND_JOB)
+        arrived = ctx.active & (ctx.kind == KIND_MSG)
+
+        # an arrived message is held: schedule its maturity after an
+        # exponential delay drawn from the receiver's RNG lane
+        rng, u_hold = rng_uniform(ctx.rng, arrived)
+        hold = _exp_delay(u_hold, ctx.params["mean_delay"])
+        push = LocalPush(
+            mask=arrived,
+            t=ctx.t + hold,
+            kind=jnp.full((h,), KIND_JOB, jnp.int32),
+            payload=jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32),
+        )
+
+        # a matured job picks a uniform random peer and sends
+        rng, u_dst = rng_uniform(rng, job)
+        n = ctx.params["num_hosts"]
+        dst = jnp.minimum((u_dst * n.astype(jnp.float32)).astype(jnp.int64), n - 1)
+        send = PacketSend(
+            mask=job,
+            dst=dst,
+            size_bytes=ctx.params["size"],
+            kind=jnp.full((h,), KIND_MSG, jnp.int32),
+            payload=jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32),
+        )
+
+        state = {"handled": ctx.state["handled"] + ctx.active}
+        return HandlerOut(state=state, rng=rng, pushes=(push,), sends=(send,))
+
+    def report(self, state, hosts):
+        handled = np.asarray(state["handled"])
+        return {"total_events": int(handled.sum()), "min": int(handled.min()), "max": int(handled.max())}
+
+
+def _exp_delay(u, mean_ns):
+    """Exponential holding time, floored at 1 us so jobs always advance."""
+    x = -jnp.log(jnp.maximum(1e-7, 1.0 - u))
+    d = (x * mean_ns.astype(jnp.float32)).astype(jnp.int64)
+    return jnp.maximum(d, 1_000)

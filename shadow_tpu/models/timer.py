@@ -1,0 +1,56 @@
+"""Periodic-timer workload: the pure sort/barrier stress model.
+
+BASELINE.json config #5 ("1M-host synthetic timer-only workload"). Each host
+fires a timer every `interval`, counts the fire, and reschedules — no packets,
+so rounds exercise only the pop/push/min-reduction kernels. The device
+analogue of a managed process sitting in a nanosleep loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config.units import TimeUnit, parse_time_ns
+from shadow_tpu.models.base import HandlerCtx, HandlerOut, LocalPush, register_model
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+
+KIND_FIRE = 0
+
+
+@register_model
+class TimerModel:
+    name = "timer"
+
+    def build(self, hosts, seed):
+        h = len(hosts)
+        interval = np.array(
+            [
+                parse_time_ns(hh["model_args"].get("interval", "10 ms"), TimeUnit.MS)
+                for hh in hosts
+            ],
+            np.int64,
+        )
+        params = {"interval": jnp.asarray(interval)}
+        state = {"fires": jnp.zeros((h,), jnp.int64)}
+        events = [(hh["host_id"], hh["start_time"], KIND_FIRE, ()) for hh in hosts]
+        return params, state, events
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        fire = ctx.active & (ctx.kind == KIND_FIRE)
+        state = {"fires": ctx.state["fires"] + fire}
+        push = LocalPush(
+            mask=fire,
+            t=ctx.t + ctx.params["interval"],
+            kind=jnp.full_like(ctx.kind, KIND_FIRE),
+            payload=jnp.zeros((ctx.kind.shape[0], EVENT_PAYLOAD_WORDS), jnp.int32),
+        )
+        return HandlerOut(state=state, rng=ctx.rng, pushes=(push,))
+
+    def report(self, state, hosts):
+        fires = np.asarray(state["fires"])
+        return {
+            "total_fires": int(fires.sum()),
+            "min_fires": int(fires.min()),
+            "max_fires": int(fires.max()),
+        }

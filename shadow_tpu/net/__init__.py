@@ -1,0 +1,28 @@
+"""Network plane: bandwidth token buckets, CoDel AQM, path latency/loss.
+
+Reference components rebuilt here (vectorized over all hosts, device-side):
+  - src/main/network/relay/ — token-bucket bandwidth enforcement
+    (relay/mod.rs:276-319, token_bucket.rs)
+  - src/main/network/router/codel_queue.rs — RFC-8289 CoDel AQM on the
+    per-host ingress path
+  - src/main/core/worker.rs:330-425 — Worker::send_packet latency/loss lookup
+
+Where the reference *blocks a relay task* and reschedules it on token refill,
+the TPU build computes departure times analytically from the same quantized
+refill schedule — identical observable packet timing, no control flow.
+"""
+
+from shadow_tpu.net.tokenbucket import TBParams, TBState, tb_init, tb_conforming_remove
+from shadow_tpu.net.codel import CodelState, codel_init, codel_on_packet, TARGET_NS, INTERVAL_NS
+
+__all__ = [
+    "TBParams",
+    "TBState",
+    "tb_init",
+    "tb_conforming_remove",
+    "CodelState",
+    "codel_init",
+    "codel_on_packet",
+    "TARGET_NS",
+    "INTERVAL_NS",
+]

@@ -1,0 +1,109 @@
+"""CoDel active queue management, one control-law lane per host ingress.
+
+Reference: src/main/network/router/codel_queue.rs — RFC-8289 CoDel guarding
+each host's upstream router queue, with TARGET = 10 ms standing delay and
+INTERVAL = 100 ms (codel_queue.rs:23,28), drop_next = now + INTERVAL/sqrt(count)
+computed in f64 and rounded (codel_queue.rs:286-290), and re-entry hysteresis
+`now - drop_next < 16*INTERVAL` (codel_queue.rs:279).
+
+TPU recast: the queue itself is implicit — packets flow through the ingress
+token bucket, and a packet's *standing delay* (sojourn) is its bucket delay
+`depart - arrival`. The control law runs once per packet at arrival pop, in
+arrival order (identical to dequeue order through the FIFO bucket), as a
+branch-free state update over all hosts. Deviation from the reference, by
+design: the `total_bytes_stored <= MTU` backlog exemption (codel_queue.rs:238)
+is subsumed by the sojourn test — an empty implicit queue means zero bucket
+delay, which is always below TARGET; there is no materialized byte count.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from shadow_tpu.config.units import parse_time_ns
+
+TARGET_NS = parse_time_ns("10 ms")
+INTERVAL_NS = parse_time_ns("100 ms")
+
+
+class CodelState(NamedTuple):
+    first_above: Array  # i64[H]; 0 = standing delay not above TARGET
+    drop_next: Array  # i64[H] next scheduled drop time while dropping
+    count: Array  # i32[H] drops in current dropping interval
+    dropping: Array  # bool[H]
+
+
+def codel_init(num_hosts: int) -> CodelState:
+    return CodelState(
+        first_above=jnp.zeros((num_hosts,), jnp.int64),
+        drop_next=jnp.zeros((num_hosts,), jnp.int64),
+        count=jnp.zeros((num_hosts,), jnp.int32),
+        dropping=jnp.zeros((num_hosts,), bool),
+    )
+
+
+def _control_law(now, count) -> Array:
+    """now + INTERVAL/sqrt(count), f64-rounded exactly like codel_queue.rs:286-290."""
+    c = jnp.maximum(count, 1).astype(jnp.float64)
+    return now + jnp.round(jnp.float64(INTERVAL_NS) / jnp.sqrt(c)).astype(jnp.int64)
+
+
+def codel_on_packet(
+    state: CodelState, now, sojourn_ns, mask
+) -> tuple[CodelState, Array]:
+    """Run the CoDel law for one packet per host where `mask`.
+
+    `now` i64[H] = arrival pop time; `sojourn_ns` i64[H] = ingress queueing
+    delay the packet will experience. Returns (state', drop[H] bool).
+    """
+    now = jnp.asarray(now, jnp.int64)
+    sojourn = jnp.asarray(sojourn_ns, jnp.int64)
+    mask = jnp.asarray(mask, bool)
+
+    below = sojourn < TARGET_NS
+
+    # --- tracking first_above_time (codel_queue.rs:238-262)
+    fa_unset = state.first_above == 0
+    new_first_above = jnp.where(
+        below, 0, jnp.where(fa_unset, now + INTERVAL_NS, state.first_above)
+    )
+    ok_to_drop = ~below & ~fa_unset & (now >= state.first_above)
+
+    # --- dropping state machine
+    dropping = state.dropping
+    count = state.count
+    drop_next = state.drop_next
+    drop = jnp.zeros_like(mask)
+
+    # leave dropping mode when delay dips below target
+    leave = dropping & ~ok_to_drop
+    # while dropping: drop each time we cross drop_next
+    fire = dropping & ok_to_drop & (now >= drop_next)
+    count_f = count + 1
+    drop_next_f = _control_law(drop_next, count_f)
+
+    # enter dropping mode whenever ok_to_drop while in store mode
+    # (codel_queue.rs:151-171); the 16*INTERVAL recency test only decides
+    # whether the drop count resumes decayed or restarts at 1 (:271-290)
+    enter = ~dropping & ok_to_drop
+    recent = (now - drop_next) < 16 * INTERVAL_NS
+    count_e = jnp.where(recent & (count > 2), count - 2, 1).astype(jnp.int32)
+    drop_next_e = _control_law(now, count_e)
+
+    new_dropping = jnp.where(leave, False, jnp.where(enter, True, dropping))
+    new_count = jnp.where(fire, count_f, jnp.where(enter, count_e, count))
+    new_drop_next = jnp.where(fire, drop_next_f, jnp.where(enter, drop_next_e, drop_next))
+    drop = fire | enter
+
+    return (
+        CodelState(
+            first_above=jnp.where(mask, new_first_above, state.first_above),
+            drop_next=jnp.where(mask, new_drop_next, state.drop_next),
+            count=jnp.where(mask, new_count, state.count),
+            dropping=jnp.where(mask, new_dropping, state.dropping),
+        ),
+        drop & mask,
+    )

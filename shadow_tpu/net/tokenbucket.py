@@ -1,0 +1,90 @@
+"""Token-bucket bandwidth limiter, one lane per host.
+
+Reference: src/main/network/relay/token_bucket.rs (277 LoC) + relay/mod.rs:
+276-319 — each host's uplink/downlink is a bucket refilled every 1 ms with a
+burst allowance, and the relay forwards packets only when tokens conform,
+rescheduling itself at the next refill otherwise.
+
+TPU recast: the refill schedule is quantized exactly like the reference
+(discrete intervals), but instead of blocking/rescheduling a relay task we
+compute each packet's conforming departure time in closed form:
+
+    tokens(t)   = min(capacity, tokens + elapsed_intervals * refill)
+    depart      = t                          if tokens >= size
+                = (itv(t) + k) * interval    with k = ceil((size-tokens)/refill)
+
+All integer i64 math (bits, ns) — bit-deterministic on any backend. A
+`refill == 0` lane means "unshaped" (no bandwidth configured) and passes
+packets through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+class TBParams(NamedTuple):
+    """Pure-array params so the pytree shards cleanly under shard_map; the
+    refill quantum (reference: 1 ms) is passed statically to the ops."""
+
+    capacity: Array  # i64[H] burst size, bits
+    refill: Array  # i64[H] bits added per interval; 0 = unshaped
+
+
+class TBState(NamedTuple):
+    tokens: Array  # i64[H] bits available at interval boundary `last_itv`
+    last_itv: Array  # i64[H] interval index of last accounting
+
+
+def tb_init(params: TBParams) -> TBState:
+    """Buckets start full (token_bucket.rs: initialized to capacity).
+
+    `tokens` is a fresh buffer (not an alias of params.capacity): engine state
+    is donated to the jitted step while params are not."""
+    return TBState(
+        tokens=params.capacity + jnp.zeros_like(params.capacity),
+        last_itv=jnp.zeros_like(params.capacity),
+    )
+
+
+def tb_conforming_remove(
+    state: TBState, params: TBParams, interval_ns: int, t_ns, size_bits, mask
+) -> tuple[TBState, Array]:
+    """Charge `size_bits` per host where `mask`; return (state', depart_ns[H]).
+
+    depart >= t_ns is the time the packet conforms. Packets larger than the
+    burst capacity still depart after enough whole intervals (the reference
+    grants an MTU burst allowance for the same reason: relay/mod.rs:276-319).
+    """
+    t_ns = jnp.asarray(t_ns, jnp.int64)
+    size_bits = jnp.asarray(size_bits, jnp.int64)
+    itv = t_ns // interval_ns
+    elapsed = jnp.maximum(itv - state.last_itv, 0)
+    # saturating refill (cap), computed without i64 overflow for huge gaps
+    gain = jnp.where(
+        elapsed < (1 << 20), elapsed * params.refill, params.capacity
+    )
+    tokens = jnp.minimum(params.capacity, state.tokens + gain)
+
+    conforms = tokens >= size_bits
+    deficit = jnp.maximum(size_bits - tokens, 0)
+    refill_safe = jnp.maximum(params.refill, 1)
+    k = (deficit + refill_safe - 1) // refill_safe  # ceil, >= 1 when deficit > 0
+    depart_wait = (itv + k) * interval_ns
+
+    shaped = params.refill > 0
+    depart = jnp.where(shaped & ~conforms, depart_wait, t_ns)
+    new_tokens = jnp.where(conforms, tokens - size_bits, tokens + k * params.refill - size_bits)
+    new_itv = jnp.where(conforms, itv, itv + k)
+
+    upd = jnp.asarray(mask, bool) & shaped
+    return (
+        TBState(
+            tokens=jnp.where(upd, new_tokens, state.tokens),
+            last_itv=jnp.where(upd, new_itv, state.last_itv),
+        ),
+        depart,
+    )

@@ -72,6 +72,14 @@ def pack_order(is_local, src_host, seq) -> Array:
     return (is_local << _LOCAL_SHIFT) | (src_host << _SRC_SHIFT) | (seq & SEQ_MASK)
 
 
+def unpack_order_src(order) -> Array:
+    """Recover the sending host's global id from a packed order key (packets
+    carry their source here — the reference's Packet keeps src addr fields)."""
+    return (jnp.asarray(order, jnp.int64) >> _SRC_SHIFT) & (
+        (1 << (_LOCAL_SHIFT - _SRC_SHIFT)) - 1
+    )
+
+
 def check_order_limits(num_hosts: int) -> None:
     """Static guard called at simulation build time: the packed key must never
     collide with ORDER_MAX (empty-slot sentinel) or spill src bits into the

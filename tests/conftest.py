@@ -1,16 +1,23 @@
-"""Test env: force an 8-device virtual CPU mesh BEFORE jax initializes.
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax backends initialize.
 
 Multi-chip sharding is validated the way the driver does it — N virtual CPU
 devices via --xla_force_host_platform_device_count (real multi-chip hardware is
 not available in this environment). This mirrors the reference's test posture:
 "multi-node" is many simulated hosts in one process (SURVEY.md §4.7).
+
+Note: this box's sitecustomize registers the `axon` TPU plugin and forces
+`jax_platforms="axon,cpu"`, overriding the JAX_PLATFORMS env var — so we must
+override back via jax.config before any backend is touched.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

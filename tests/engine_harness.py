@@ -1,0 +1,83 @@
+"""Shared test harness: build and run small sims directly against the engine
+(the config->sim builder layer has its own tests)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import Engine, EngineConfig, EngineParams
+from shadow_tpu.models import get_model
+from shadow_tpu.net import TBParams
+
+
+def run_sim(
+    model_name: str,
+    hosts: list[dict],
+    stop: int,
+    world: int = 1,
+    latency: int = 50_000_000,
+    loss: float = 0.0,
+    bw_bits: int = 0,
+    qcap: int = 32,
+    obcap: int = 256,
+    seed: int = 1,
+    runahead_floor: int = 1_000_000,
+    use_codel: bool = True,
+):
+    h = len(hosts)
+    cfg = EngineConfig(
+        num_hosts=h,
+        stop_time=stop,
+        runahead_floor=runahead_floor,
+        static_min_latency=latency,
+        queue_capacity=qcap,
+        outbox_capacity=obcap,
+        max_round_inserts=qcap,
+        rounds_per_chunk=64,
+        world=world,
+        use_codel=use_codel,
+    )
+    model = get_model(model_name)()
+    mparams, mstate, events = model.build(hosts, seed=seed)
+    params = EngineParams(
+        node_of=jnp.zeros((h,), jnp.int32),
+        lat_ns=jnp.full((1, 1), latency, jnp.int64),
+        loss=jnp.full((1, 1), loss, jnp.float32),
+        eg_tb=TBParams(
+            capacity=jnp.full((h,), 30_000, jnp.int64),
+            refill=jnp.full((h,), bw_bits // 1000, jnp.int64),
+        ),
+        in_tb=TBParams(
+            capacity=jnp.full((h,), 30_000, jnp.int64),
+            refill=jnp.full((h,), bw_bits // 1000, jnp.int64),
+        ),
+        model=mparams,
+    )
+    mesh = None
+    if world > 1:
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:world]), ("hosts",))
+    eng = Engine(cfg, model, mesh)
+    state, params = eng.init_state(params, mstate, events, seed=seed)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500, "simulation failed to terminate"
+    stats = jax.device_get(state.stats)
+    report = model.report(jax.device_get(state.model), hosts)
+    return state, stats, report
+
+
+def mk_hosts(n: int, model_args=None, **extra) -> list[dict]:
+    return [
+        {
+            "host_id": i,
+            "name": f"h{i}",
+            "start_time": 0,
+            "model_args": dict(model_args or {}),
+            **extra,
+        }
+        for i in range(n)
+    ]

@@ -1,0 +1,81 @@
+"""The determinism gate.
+
+Reference analogue: src/test/determinism/ — run the identical config twice and
+with both schedulers, then byte-compare outputs (SURVEY.md §4.3). Here the
+compared artifact is the per-host rolling event digest (time, kind, order of
+every popped event), and "both schedulers" becomes "1-device vs 8-device mesh":
+sharding must not change any host's event history, packet-loss draws included.
+"""
+
+import numpy as np
+import pytest
+
+from tests.engine_harness import mk_hosts, run_sim
+
+STOP = 1_000_000_000
+
+
+def _digest(model, hosts, world, seed=1, **kw):
+    _, stats, _ = run_sim(model, hosts, STOP, world=world, seed=seed, **kw)
+    return np.asarray(stats.digest), stats
+
+
+def _phold_hosts():
+    return mk_hosts(16, {"mean_delay": "30 ms", "population": 2})
+
+
+def test_two_runs_bit_identical():
+    hosts = _phold_hosts()
+    d1, s1 = _digest("phold", hosts, world=1, loss=0.1)
+    d2, s2 = _digest("phold", hosts, world=1, loss=0.1)
+    assert np.array_equal(d1, d2)
+    assert int(s1.rounds) == int(s2.rounds)
+
+
+def test_sharding_does_not_change_history():
+    hosts = _phold_hosts()
+    d1, s1 = _digest("phold", hosts, world=1, loss=0.1)
+    d8, s8 = _digest("phold", hosts, world=8, loss=0.1)
+    assert np.array_equal(d1, d8)
+    # global event count identical too
+    assert int(np.asarray(s1.events).sum()) == int(np.asarray(s8.events).sum())
+
+
+def test_sharding_invariance_under_shaping_and_codel():
+    """Token buckets + CoDel + loss together must stay mesh-invariant."""
+    hosts = [
+        dict(host_id=0, name="server", start_time=0, model_args={"role": "server"}),
+        *(
+            dict(
+                host_id=i,
+                name=f"c{i}",
+                start_time=0,
+                model_args={
+                    "role": "client",
+                    "peer": "server",
+                    "interval": "5 ms",
+                    "size_bytes": 2000,
+                },
+            )
+            for i in range(1, 8)
+        ),
+    ]
+    kw = dict(bw_bits=2_000_000, loss=0.05, use_codel=True)
+    d1, _ = _digest("udp_echo", hosts, world=1, **kw)
+    d8, _ = _digest("udp_echo", hosts, world=8, **kw)
+    assert np.array_equal(d1, d8)
+
+
+def test_seed_changes_history():
+    hosts = _phold_hosts()
+    d1, _ = _digest("phold", hosts, world=1, seed=1)
+    d2, _ = _digest("phold", hosts, world=1, seed=2)
+    assert not np.array_equal(d1, d2)
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_other_mesh_shapes(world):
+    hosts = _phold_hosts()
+    d1, _ = _digest("phold", hosts, world=1)
+    dw, _ = _digest("phold", hosts, world=world)
+    assert np.array_equal(d1, dw)

@@ -1,0 +1,108 @@
+"""Engine behavior tests: round loop, packet path, shaping, models.
+
+Reference analogue: the per-subsystem unit tests plus the phold/tgen system
+tests (SURVEY.md §4). Shapes are tiny — jit compile dominates test wall time.
+"""
+
+import numpy as np
+
+from tests.engine_harness import mk_hosts, run_sim
+
+
+def test_timer_counts_exact():
+    hosts = mk_hosts(8, {"interval": "10 ms"})
+    _, stats, report = run_sim("timer", hosts, 1_000_000_000)
+    # fires at 0, 10ms, ..., 990ms -> exactly 100 per host; stop_time excluded
+    assert report["min_fires"] == 100
+    assert report["max_fires"] == 100
+    assert int(np.asarray(stats.events).sum()) == 800
+    assert int(np.asarray(stats.monotonic_violations).sum()) == 0
+
+
+def test_stop_time_is_exclusive():
+    hosts = mk_hosts(1, {"interval": "10 ms"})
+    _, _, report = run_sim("timer", hosts, 10_000_000)  # one interval
+    assert report["total_fires"] == 1  # t=0 only; t=10ms == stop not fired
+
+
+def test_phold_conserves_population():
+    hosts = mk_hosts(8, {"mean_delay": "50 ms", "population": 2})
+    state, stats, report = run_sim("phold", hosts, 1_000_000_000)
+    sent = int(np.asarray(stats.pkts_sent).sum())
+    delivered = int(np.asarray(stats.pkts_delivered).sum())
+    lost = int(np.asarray(stats.pkts_lost).sum())
+    assert sent > 0
+    assert lost == 0
+    # every sent packet is delivered or still in flight at stop
+    assert delivered <= sent
+    assert sent - delivered < 64
+    assert int(np.asarray(stats.events).sum()) == report["total_events"]
+
+
+def test_echo_rtt_is_twice_latency():
+    hosts = [
+        dict(host_id=0, name="server", start_time=0, model_args={"role": "server"}),
+        dict(
+            host_id=1,
+            name="c1",
+            start_time=0,
+            model_args={"role": "client", "peer": "server", "interval": "100 ms"},
+        ),
+    ]
+    _, stats, report = run_sim("udp_echo", hosts, 1_000_000_000, latency=25_000_000)
+    assert report["responses_received"] > 0
+    assert abs(report["mean_rtt_ms"] - 50.0) < 1e-6
+    assert abs(report["max_rtt_ms"] - 50.0) < 1e-6
+
+
+def test_loss_drops_packets():
+    hosts = [
+        dict(host_id=0, name="server", start_time=0, model_args={"role": "server"}),
+        *(
+            dict(
+                host_id=i,
+                name=f"c{i}",
+                start_time=0,
+                model_args={"role": "client", "peer": "server", "interval": "20 ms"},
+            )
+            for i in range(1, 8)
+        ),
+    ]
+    _, stats, report = run_sim("udp_echo", hosts, 2_000_000_000, loss=0.25)
+    lost = int(np.asarray(stats.pkts_lost).sum())
+    sent = int(np.asarray(stats.pkts_sent).sum())
+    assert lost > 0
+    assert 0.1 < lost / sent < 0.45  # ~25%
+    assert report["responses_received"] < report["requests_sent"]
+
+
+def test_bandwidth_shaping_inflates_rtt():
+    fast = [
+        dict(host_id=0, name="server", start_time=0, model_args={"role": "server"}),
+        dict(
+            host_id=1,
+            name="c",
+            start_time=0,
+            model_args={
+                "role": "client",
+                "peer": "server",
+                "interval": "10 ms",
+                "size_bytes": 2500,
+            },
+        ),
+    ]
+    # demand 2 Mbit/s against a 1 Mbit/s shaped path vs an unshaped one
+    _, _, shaped = run_sim("udp_echo", fast, 1_000_000_000, bw_bits=1_000_000)
+    _, _, unshaped = run_sim("udp_echo", fast, 1_000_000_000, bw_bits=0)
+    assert unshaped["mean_rtt_ms"] < shaped["mean_rtt_ms"] - 5
+    assert abs(unshaped["mean_rtt_ms"] - 100.0) < 1e-6
+
+
+def test_gossip_full_coverage():
+    hosts = mk_hosts(32, {"fanout": 5})
+    hosts[0]["model_args"]["publisher"] = True
+    _, stats, report = run_sim("gossip", hosts, 5_000_000_000)
+    assert report["coverage"] == 1.0
+    assert 1 <= report["max_hops"] <= 10
+    # each host forwards exactly fanout packets (incl. publisher)
+    assert int(np.asarray(stats.pkts_sent).sum()) == 32 * 5

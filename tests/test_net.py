@@ -1,0 +1,132 @@
+"""Token-bucket and CoDel unit tests (reference analogue:
+token_bucket.rs tests and codel_queue.rs:330-530 tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.net import (
+    INTERVAL_NS,
+    TARGET_NS,
+    TBParams,
+    codel_init,
+    codel_on_packet,
+    tb_conforming_remove,
+    tb_init,
+)
+
+MS = 1_000_000
+ITV = 1 * MS  # 1 ms refill quantum
+
+
+def _tb(cap_bits, refill_bits, n=1):
+    p = TBParams(
+        capacity=jnp.full((n,), cap_bits, jnp.int64),
+        refill=jnp.full((n,), refill_bits, jnp.int64),
+    )
+    return p, tb_init(p)
+
+
+def _remove(s, p, t, bits, mask=True):
+    m = jnp.full(p.capacity.shape, mask)
+    s, depart = tb_conforming_remove(
+        s, p, ITV, jnp.full(p.capacity.shape, t, jnp.int64),
+        jnp.full(p.capacity.shape, bits, jnp.int64), m
+    )
+    return s, int(depart[0])
+
+
+def test_tb_conforming_passes_immediately():
+    p, s = _tb(30_000, 1_000)
+    s, d = _remove(s, p, 5 * MS, 20_000)
+    assert d == 5 * MS
+    # 10_000 left; next 20_000 at same time must wait ceil(10000/1000)=10 itvs
+    s, d = _remove(s, p, 5 * MS, 20_000)
+    assert d == 15 * MS
+
+
+def test_tb_refill_is_quantized():
+    p, s = _tb(10_000, 1_000)
+    s, d = _remove(s, p, 0, 10_000)  # drain full burst at t=0
+    assert d == 0
+    # at t=2.5ms only 2 whole intervals refilled -> 2000 bits; need 3000
+    s, d = _remove(s, p, int(2.5 * MS), 3_000)
+    assert d == 3 * MS
+
+
+def test_tb_unshaped_passthrough():
+    p, s = _tb(0, 0)
+    s, d = _remove(s, p, 7 * MS, 10**9)
+    assert d == 7 * MS
+    assert int(s.tokens[0]) == 0  # untouched
+
+
+def test_tb_huge_gap_no_overflow():
+    p, s = _tb(30_000, 1_000)
+    s, d = _remove(s, p, 0, 30_000)
+    s, d = _remove(s, p, 10**15, 30_000)  # ~11.5 days later
+    assert d == 10**15
+
+
+def test_codel_first_drop_after_one_interval():
+    """Sustained over-target delay must start dropping after ONE interval of
+    persistence, regardless of how late in the sim congestion begins
+    (entry law: codel_queue.rs:151-171)."""
+    start = 2_000 * MS  # past the 16*INTERVAL-from-zero edge
+    s = codel_init(1)
+    mask = jnp.ones((1,), bool)
+    sojourn = jnp.full((1,), TARGET_NS + 5 * MS, jnp.int64)
+    drops = []
+    t = start
+    for i in range(15):
+        s, drop = codel_on_packet(s, jnp.full((1,), t, jnp.int64), sojourn, mask)
+        drops.append((t - start) // MS if bool(drop[0]) else None)
+        t += 10 * MS
+    fired = [d for d in drops if d is not None]
+    assert fired, "no drops under sustained over-target delay"
+    # first drop at the first packet with now >= first_above (= start+INTERVAL)
+    assert fired[0] == INTERVAL_NS // MS
+
+
+def test_codel_no_drop_below_target():
+    s = codel_init(1)
+    mask = jnp.ones((1,), bool)
+    sojourn = jnp.full((1,), TARGET_NS - 1, jnp.int64)
+    t = 0
+    for _ in range(30):
+        s, drop = codel_on_packet(s, jnp.full((1,), t, jnp.int64), sojourn, mask)
+        assert not bool(drop[0])
+        t += 10 * MS
+    assert not bool(s.dropping[0])
+
+
+def test_codel_recovers_when_delay_clears():
+    s = codel_init(1)
+    mask = jnp.ones((1,), bool)
+    over = jnp.full((1,), TARGET_NS * 3, jnp.int64)
+    under = jnp.full((1,), 0, jnp.int64)
+    t = 0
+    for _ in range(25):
+        s, _ = codel_on_packet(s, jnp.full((1,), t, jnp.int64), over, mask)
+        t += 10 * MS
+    assert bool(s.dropping[0])
+    s, drop = codel_on_packet(s, jnp.full((1,), t, jnp.int64), under, mask)
+    assert not bool(drop[0])
+    assert not bool(s.dropping[0])
+    assert int(s.first_above[0]) == 0
+
+
+def test_codel_drop_rate_accelerates():
+    """While dropping persists, inter-drop gaps shrink (INTERVAL/sqrt(count))."""
+    s = codel_init(4)
+    mask = jnp.ones((4,), bool)
+    sojourn = jnp.full((4,), TARGET_NS * 4, jnp.int64)
+    drop_times = []
+    t = 0
+    for _ in range(400):
+        s, drop = codel_on_packet(s, jnp.full((4,), t, jnp.int64), sojourn, mask)
+        if bool(drop[0]):
+            drop_times.append(t)
+        t += 5 * MS
+    gaps = np.diff(drop_times)
+    assert len(gaps) > 5
+    assert gaps[-1] < gaps[1]  # accelerating
