@@ -1,0 +1,101 @@
+"""CLI entry point: `python -m shadow_tpu [OPTIONS] <config.yaml | ->`.
+
+Mirrors the reference binary's interface (src/main/shadow.rs:30-66: clap
+parse, YAML load, CLI-over-file merge, run, exit code from plugin errors):
+every `--section.key=value` flag overrides the matching config field, CLI
+winning (configuration.rs:19-24). `-` reads the config from stdin
+(src/test/config read-from-stdin behavior).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from shadow_tpu import __version__
+from shadow_tpu.config.options import ConfigError, load_config, merge_cli_overrides
+
+
+def _split_overrides(extra: list[str]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    i = 0
+    while i < len(extra):
+        a = extra[i]
+        if not a.startswith("--"):
+            raise ConfigError(f"unexpected argument {a!r}")
+        body = a[2:]
+        if "=" in body:
+            k, v = body.split("=", 1)
+        else:
+            if i + 1 >= len(extra):
+                raise ConfigError(f"flag {a!r} needs a value")
+            k, v = body, extra[i + 1]
+            i += 1
+        out[k.replace("-", "_")] = v
+        i += 1
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native conservative-PDES network simulator",
+        epilog=(
+            "Any config field can be overridden with --section.key=value, "
+            "e.g. --general.stop_time='10 s' --experimental.rounds_per_chunk=128"
+        ),
+    )
+    p.add_argument("config", help="YAML simulation config ('-' = stdin)")
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("--progress", action="store_true", help="print a progress line")
+    p.add_argument(
+        "--dry-run", action="store_true",
+        help="parse config + build the simulation, run nothing (config check)",
+    )
+    p.add_argument(
+        "--print-stats", action="store_true",
+        help="print the sim-stats JSON to stdout after the run",
+    )
+    args, extra = p.parse_known_args(argv)
+
+    try:
+        cfg = load_config(args.config)
+        overrides = _split_overrides(extra)
+        if overrides:
+            cfg = merge_cli_overrides(cfg, overrides)
+        if args.progress:
+            cfg.general.progress = True
+        from shadow_tpu.sim import Simulation  # deferred: jax init is slow
+
+        sim = Simulation(cfg)
+        if args.dry_run:
+            print(
+                f"config ok: {len(sim.hosts)} hosts, "
+                f"{sim.graph.num_nodes} graph nodes, "
+                f"world={sim.engine_cfg.world}",
+                file=sys.stderr,
+            )
+            return 0
+        sim.run()
+        data_dir = sim.write_outputs()
+        report = sim.stats_report()
+        if args.print_stats:
+            json.dump(report, sys.stdout, indent=2)
+            print()
+        print(
+            f"done: simulated {report['simulated_seconds']:.3f}s in "
+            f"{report['wall_seconds']:.2f}s "
+            f"({report['sim_wall_ratio']:.2f}x), "
+            f"{report['events_processed']} events, "
+            f"{report['packets_delivered']} packets; outputs in {data_dir}/",
+            file=sys.stderr,
+        )
+        return 0
+    except ConfigError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,7 +121,7 @@ class ExperimentalOptions:
 
     Kept from the reference: `scheduler`, `runahead`, `use_dynamic_runahead`,
     `interface_qdisc`. New (static-shape knobs the TPU engine needs):
-    `event_queue_capacity`, `outbox_capacity`, `max_round_inserts`,
+    `event_queue_capacity`, `sends_per_host_round`, `max_round_inserts`,
     `rounds_per_chunk`, `microstep_limit`.
     """
 
@@ -132,7 +132,7 @@ class ExperimentalOptions:
     use_codel: bool = True
     # --- TPU engine static shapes ---
     event_queue_capacity: int = 64  # per-host pending-event slots
-    outbox_capacity: int = 0  # per-shard per-round packet buffer; 0 = auto
+    sends_per_host_round: int = 8  # per-host round send budget (drop above)
     max_round_inserts: int = 0  # max packets merged into one host per round; 0 = auto
     rounds_per_chunk: int = 64  # rounds per jit'd chunk between host syncs
     microstep_limit: int = 0  # safety bound on events/host/round; 0 = capacity
@@ -154,7 +154,7 @@ class ExperimentalOptions:
                 setattr(e, f, bool(d.pop(f)))
         for f in (
             "event_queue_capacity",
-            "outbox_capacity",
+            "sends_per_host_round",
             "max_round_inserts",
             "rounds_per_chunk",
             "microstep_limit",

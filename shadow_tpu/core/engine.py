@@ -107,7 +107,8 @@ class Stats(NamedTuple):
     pkts_codel_dropped: Array  # i64[H] (charged to the receiving host)
     pkts_delivered: Array  # i64[H]
     monotonic_violations: Array  # i64[H] pushes scheduled in the past
-    ob_dropped: Array  # i64[1] outbox-overflow losses (per shard)
+    pkts_budget_dropped: Array  # i64[H] over the per-host round send budget
+    ob_dropped: Array  # i64[1] outbox-overflow losses (invariant check: always 0)
     microsteps: Array  # i64[1] total microsteps (per shard)
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
@@ -119,6 +120,7 @@ class SimState(NamedTuple):
     queue: EventQueue
     rng: RngState
     seq: Array  # i64[H] per-host emission counter (order-key seq)
+    sent_round: Array  # i32[H] sends staged this round (budget accounting)
     tb_egress: TBState
     tb_ingress: TBState
     codel: Any  # CodelState
@@ -156,7 +158,11 @@ class EngineConfig:
     tb_interval_ns: int = 1_000_000  # token bucket refill quantum (1 ms)
     use_codel: bool = True
     queue_capacity: int = 64
-    outbox_capacity: int = 256  # per shard per round
+    # Per-HOST send budget per round. Budget-drop decisions depend only on a
+    # host's own send count, and the shard outbox is sized hosts_per_shard *
+    # budget so aggregate overflow is impossible — this is what keeps drop
+    # behavior (hence digests) identical across mesh shapes.
+    sends_per_host_round: int = 8
     max_round_inserts: int = 64  # per host per round
     microstep_limit: int = 0  # 0 -> queue_capacity * 2
     rounds_per_chunk: int = 64
@@ -173,6 +179,11 @@ class EngineConfig:
     @property
     def hosts_per_shard(self) -> int:
         return self.num_hosts // self.world
+
+    @property
+    def outbox_capacity(self) -> int:
+        """Per-shard staging slots; cannot overflow under the per-host budget."""
+        return self.hosts_per_shard * self.sends_per_host_round
 
     @property
     def effective_microstep_limit(self) -> int:
@@ -200,6 +211,7 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         pkts_codel_dropped=zi(),
         pkts_delivered=zi(),
         monotonic_violations=zi(),
+        pkts_budget_dropped=zi(),
         ob_dropped=jnp.zeros((cfg.world,), jnp.int64),
         microsteps=jnp.zeros((cfg.world,), jnp.int64),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
@@ -334,6 +346,7 @@ class Engine:
             queue=EventQueue(t=sh, order=sh, kind=sh, payload=sh, dropped=sh),
             rng=RngState(s=sh),
             seq=sh,
+            sent_round=sh,
             tb_egress=TBState(tokens=sh, last_itv=sh),
             tb_ingress=TBState(tokens=sh, last_itv=sh),
             codel=jax.tree.map(lambda _: sh, codel_init(1)),
@@ -348,6 +361,7 @@ class Engine:
                 pkts_codel_dropped=sh,
                 pkts_delivered=sh,
                 monotonic_violations=sh,
+                pkts_budget_dropped=sh,
                 ob_dropped=sh,
                 microsteps=sh,
                 digest=sh,
@@ -388,6 +402,7 @@ class Engine:
             queue=queue,
             rng=rng_init(cfg.num_hosts, seed),
             seq=seq,
+            sent_round=jnp.zeros((cfg.num_hosts,), jnp.int32),
             tb_egress=tb_init(params.eg_tb),
             tb_ingress=tb_init(params.in_tb),
             codel=codel_init(cfg.num_hosts),
@@ -544,6 +559,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     out = model.handle(ctx)
     rng, model_state = out.rng, out.state
     seq = st.seq
+    sent_round = st.sent_round
     tb_eg = st.tb_egress
     outbox = st.outbox
     ob_lost = jnp.zeros((), jnp.int64)
@@ -582,7 +598,12 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         unreachable = mask & ((lat < 0) | bad_dst)
         rng, u = rng_uniform(rng, mask)
         lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
-        send_ok = mask & ~lost & ~unreachable
+        # per-host round budget: the drop decision is a function of this
+        # host's own sends only, so it cannot vary with mesh shape
+        over_budget = sent_round >= cfg.sends_per_host_round
+        send_ok = mask & ~lost & ~unreachable & ~over_budget
+        budget_dropped = mask & ~lost & ~unreachable & over_budget
+        sent_round = sent_round + send_ok.astype(jnp.int32)
         # conservative-PDES clamp (worker.rs:411-414): never before round end
         arrive = jnp.maximum(eg_depart + jnp.maximum(lat, 0), window_end)
         order = pack_order(0, host_gid, seq)
@@ -607,6 +628,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             pkts_sent=stats.pkts_sent + mask,
             pkts_lost=stats.pkts_lost + lost,
             pkts_unreachable=stats.pkts_unreachable + unreachable,
+            pkts_budget_dropped=stats.pkts_budget_dropped + budget_dropped,
         )
 
     stats = stats._replace(ob_dropped=stats.ob_dropped + ob_lost[None])
@@ -614,6 +636,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         queue=queue,
         rng=rng,
         seq=seq,
+        sent_round=sent_round,
         tb_egress=tb_eg,
         tb_ingress=tb_in,
         codel=codel,
@@ -649,4 +672,6 @@ def _exchange(cfg, axis, st: SimState):
         payload=jnp.zeros_like(ob.payload),
         count=jnp.zeros_like(ob.count),
     )
-    return st._replace(queue=queue, outbox=fresh)
+    return st._replace(
+        queue=queue, outbox=fresh, sent_round=jnp.zeros_like(st.sent_round)
+    )

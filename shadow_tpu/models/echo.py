@@ -62,7 +62,15 @@ class UdpEchoModel:
                 p = a.get("peer")
                 if p is None:
                     raise ValueError(f"echo client {hh['name']} needs model_args.peer")
-                peer[i] = by_name[p] if isinstance(p, str) else int(p)
+                if isinstance(p, str):
+                    if p not in by_name:
+                        raise ValueError(
+                            f"echo client {hh['name']}: unknown peer {p!r} "
+                            f"(hosts: {sorted(by_name)[:10]}...)"
+                        )
+                    peer[i] = by_name[p]
+                else:
+                    peer[i] = int(p)
             interval[i] = parse_time_ns(a.get("interval", "1 s"), TimeUnit.SEC)
             size[i] = int(a.get("size_bytes", 512))
         params = {

@@ -33,19 +33,25 @@ class GossipModel:
 
     def build(self, hosts, seed):
         h = len(hosts)
-        args0 = hosts[0]["model_args"]
-        fanout = int(args0.get("fanout", 8))
-        size = int(args0.get("payload_bytes", 256))
+        fanout = np.array(
+            [int(hh["model_args"].get("fanout", 8)) for hh in hosts], np.int32
+        )
+        size = np.array(
+            [int(hh["model_args"].get("payload_bytes", 256)) for hh in hosts],
+            np.int32,
+        )
         rng = np.random.default_rng(seed)
-        # static random neighbor lists (sparse adjacency, CSR-like [H, K])
-        neighbors = rng.integers(0, h, size=(h, fanout), dtype=np.int64)
+        # static random neighbor lists (sparse adjacency, CSR-like [H, K]);
+        # K = max fanout, per-host fanout masks the tail of each row
+        k = max(int(fanout.max()), 1)
+        neighbors = rng.integers(0, h, size=(h, k), dtype=np.int64)
         # avoid self-loops deterministically
         self_rows = neighbors == np.arange(h)[:, None]
         neighbors = np.where(self_rows, (neighbors + 1) % h, neighbors)
         params = {
             "neighbors": jnp.asarray(neighbors),
-            "size": jnp.full((h,), size, jnp.int32),
-            "fanout": jnp.full((h,), fanout, jnp.int32),
+            "size": jnp.asarray(size),
+            "fanout": jnp.asarray(fanout),
         }
         state = {
             "seen": jnp.zeros((h,), bool),

@@ -35,7 +35,6 @@ class PholdModel:
 
     def build(self, hosts, seed):
         h = len(hosts)
-        args0 = hosts[0]["model_args"]
         mean_delay = np.array(
             [
                 parse_time_ns(hh["model_args"].get("mean_delay", "100 ms"), TimeUnit.MS)
@@ -43,17 +42,19 @@ class PholdModel:
             ],
             np.int64,
         )
-        size = int(args0.get("payload_bytes", 64))
-        population = int(args0.get("population", 1))
+        size = np.array(
+            [int(hh["model_args"].get("payload_bytes", 64)) for hh in hosts],
+            np.int32,
+        )
         params = {
             "mean_delay": jnp.asarray(mean_delay),
-            "size": jnp.full((h,), size, jnp.int32),
+            "size": jnp.asarray(size),
             "num_hosts": jnp.full((h,), h, jnp.int64),
         }
         state = {"handled": jnp.zeros((h,), jnp.int64)}
         events = []
         for hh in hosts:
-            for _ in range(population):
+            for _ in range(int(hh["model_args"].get("population", 1))):
                 events.append((hh["host_id"], hh["start_time"], KIND_JOB, ()))
         return params, state, events
 

@@ -1,0 +1,359 @@
+"""Network graph: GML parse, shortest-path routing, IP assignment.
+
+Reference components being rebuilt (not ported):
+  - src/lib/gml-parser (Rust, 542 LoC): GML tokenizer/parser.
+  - src/main/network/graph/mod.rs:134 `NetworkGraph::parse`;
+    :183-228 parallel all-pairs Dijkstra -> `PathProperties{latency_ns,
+    packet_loss}`; :230-253 direct-edge mode; :354-427 `IpAssignment`;
+    :430-493 `RoutingInfo`.
+  - configuration.rs GraphOptions "1_gbit_switch" built-in graph.
+
+TPU-first recast: routing is materialized as dense node-by-node tables
+(latency i64[N,N], loss f32[N,N]) that replicate onto every mesh shard so a
+packet send is two gathers (src node, dst node) inside the vectorized
+microstep — the reference instead does a HashMap lookup per packet
+(worker.rs:392). Unreachable pairs hold latency -1 (the engine counts these
+as pkts_unreachable; the reference errors at setup for disconnected graphs).
+
+All-pairs shortest paths run once at setup on CPU via scipy's Dijkstra (the
+reference uses rayon-parallel petgraph Dijkstra, graph/mod.rs:190-208); path
+packet-loss composes as 1 - prod(1 - edge_loss) along the chosen path,
+recovered from the predecessor matrix in topological (distance) order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import re
+from typing import Any
+
+import numpy as np
+
+from shadow_tpu.config.units import parse_bits_per_sec, parse_time_ns, TimeUnit
+
+
+class GraphError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# GML parsing (reference: src/lib/gml-parser)
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s*(?:
+        (?P<comment>\#[^\n]*)
+      | (?P<lbracket>\[)
+      | (?P<rbracket>\])
+      | (?P<string>"(?:[^"\\]|\\.)*")
+      | (?P<number>[+-]?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
+      | (?P<key>[A-Za-z_][A-Za-z0-9_]*)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize_gml(text: str):
+    pos = 0
+    n = len(text)
+    while pos < n:
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                return
+            raise GraphError(f"GML parse error at offset {pos}: {text[pos:pos+40]!r}")
+        pos = m.end()
+        if m.lastgroup == "comment":
+            continue
+        yield m.lastgroup, m.group(m.lastgroup)
+
+
+def _parse_gml_value(tokens, tok_type, tok):
+    if tok_type == "lbracket":
+        return _parse_gml_list(tokens)
+    if tok_type == "string":
+        return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if tok_type == "number":
+        if re.fullmatch(r"[+-]?\d+", tok):
+            return int(tok)
+        return float(tok)
+    if tok_type == "key":  # bare words (GML allows unquoted values rarely)
+        return tok
+    raise GraphError(f"unexpected GML token {tok!r}")
+
+
+def _parse_gml_list(tokens) -> list[tuple[str, Any]]:
+    """A GML record is an ordered multimap: repeated keys (node, edge) stack."""
+    items: list[tuple[str, Any]] = []
+    for tok_type, tok in tokens:
+        if tok_type == "rbracket":
+            return items
+        if tok_type != "key":
+            raise GraphError(f"expected key in GML record, got {tok!r}")
+        try:
+            vt, vv = next(tokens)
+        except StopIteration:
+            raise GraphError(f"GML key {tok!r} has no value") from None
+        items.append((tok, _parse_gml_value(tokens, vt, vv)))
+    return items
+
+
+def parse_gml(text: str) -> dict[str, Any]:
+    """Parse GML text into {"directed": bool, "nodes": [...], "edges": [...]}.
+
+    Node/edge attributes keep their GML keys (id, source, target,
+    host_bandwidth_down/up, latency, packet_loss, label, ...).
+    """
+    tokens = _tokenize_gml(text)
+    top = _parse_gml_list(tokens)  # implicit outer record
+    graph_rec = None
+    for k, v in top:
+        if k == "graph":
+            graph_rec = v
+            break
+    if graph_rec is None:
+        raise GraphError("GML text has no `graph [...]` record")
+    directed = False
+    nodes, edges = [], []
+    for k, v in graph_rec:
+        if k == "directed":
+            directed = bool(v)
+        elif k == "node":
+            nodes.append(dict(v))
+        elif k == "edge":
+            edges.append(dict(v))
+    if not nodes:
+        raise GraphError("graph has no nodes")
+    return {"directed": directed, "nodes": nodes, "edges": edges}
+
+
+# --------------------------------------------------------------------------
+# The network graph + routing tables
+# --------------------------------------------------------------------------
+
+# built-in one-node graph (reference GraphOptions "1_gbit_switch")
+ONE_GBIT_SWITCH_GML = """
+graph [
+  directed 0
+  node [
+    id 0
+    host_bandwidth_down "1 Gbit"
+    host_bandwidth_up "1 Gbit"
+  ]
+  edge [
+    source 0
+    target 0
+    latency "1 ms"
+    packet_loss 0.0
+  ]
+]
+"""
+
+
+@dataclasses.dataclass
+class NetworkGraph:
+    """Parsed graph + routing tables (reference NetworkGraph + RoutingInfo).
+
+    node_ids: original GML ids in index order (configs reference these).
+    lat_ns[N, N]: path latency; -1 where unreachable.
+    loss[N, N]: path packet-loss probability in [0, 1).
+    bw_down_bits / bw_up_bits [N]: per-node host bandwidth defaults (0 = none
+    given; per-host config overrides win, sim_config.rs:203).
+    """
+
+    node_ids: np.ndarray  # i64[N] original GML ids
+    lat_ns: np.ndarray  # i64[N, N]
+    loss: np.ndarray  # f32[N, N]
+    bw_down_bits: np.ndarray  # i64[N]
+    bw_up_bits: np.ndarray  # i64[N]
+    directed: bool
+
+    def __post_init__(self):
+        self._index_of = {int(g): i for i, g in enumerate(self.node_ids)}
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_ids)
+
+    def node_index(self, gml_id: int) -> int:
+        try:
+            return self._index_of[int(gml_id)]
+        except KeyError:
+            raise GraphError(f"config references unknown graph node id {gml_id}") from None
+
+    @property
+    def min_latency_ns(self) -> int:
+        """Smallest reachable path latency — the conservative-PDES lookahead
+        bound (reference runahead.rs:5-13: round length <= min latency)."""
+        reach = self.lat_ns[self.lat_ns >= 0]
+        if reach.size == 0:
+            raise GraphError("graph has no reachable paths")
+        return int(reach.min())
+
+
+def _edge_arrays(g: dict, index_of: dict[int, int]):
+    n = len(index_of)
+    lat = np.full((n, n), -1, np.int64)
+    sur = np.zeros((n, n), np.float64)  # survival probability per direct edge
+    for e in g["edges"]:
+        try:
+            s = index_of[int(e["source"])]
+            d = index_of[int(e["target"])]
+        except KeyError as k:
+            raise GraphError(f"edge references unknown node {k}") from None
+        if "latency" not in e:
+            raise GraphError(f"edge {e.get('source')}->{e.get('target')} missing latency")
+        l_ns = parse_time_ns(e["latency"], TimeUnit.MS)
+        if l_ns <= 0:
+            raise GraphError("edge latency must be > 0 (conservative lookahead)")
+        p_loss = float(e.get("packet_loss", 0.0))
+        if not (0.0 <= p_loss < 1.0):
+            raise GraphError(f"packet_loss {p_loss} outside [0, 1)")
+        pairs = [(s, d)] if g["directed"] else [(s, d), (d, s)]
+        for a, b in pairs:
+            # parallel edges: keep the lowest-latency one (deterministic)
+            if lat[a, b] < 0 or l_ns < lat[a, b]:
+                lat[a, b] = l_ns
+                sur[a, b] = 1.0 - p_loss
+    return lat, sur
+
+
+def _shortest_paths(lat: np.ndarray, sur: np.ndarray):
+    """All-pairs shortest path by latency; compose survival along the chosen
+    path via the predecessor matrix (reference graph/mod.rs:183-228)."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import dijkstra
+
+    n = lat.shape[0]
+    mask = lat >= 0
+    w = np.where(mask, lat, 0).astype(np.float64)
+    graph = csr_matrix((w[mask], np.nonzero(mask)), shape=(n, n))
+    dist, pred = dijkstra(graph, directed=True, return_predecessors=True)
+
+    # self paths: a self-edge (possible in GML) wins over the trivial 0 path —
+    # the reference routes loopback-node traffic over the self-edge latency.
+    self_edge = np.diag(mask)
+    dist_ns = np.where(np.isinf(dist), -1, np.rint(dist)).astype(np.int64)
+    path_sur = np.zeros((n, n), np.float64)
+    # walk nodes per source in increasing-distance order: survival follows the
+    # predecessor tree (optimal substructure), fully deterministic because
+    # scipy's dijkstra tie-breaks are fixed for a fixed input.
+    order = np.argsort(dist, axis=1, kind="stable")
+    for s in range(n):
+        ps = path_sur[s]
+        ps[s] = 1.0
+        for j in order[s]:
+            p = pred[s, j]
+            if p < 0:
+                continue  # unreachable or the source itself
+            ps[j] = ps[p] * sur[p, j]
+    for s in range(n):
+        if self_edge[s]:
+            dist_ns[s, s] = lat[s, s]
+            path_sur[s, s] = sur[s, s]
+        elif dist_ns[s, s] == 0:
+            path_sur[s, s] = 1.0
+    return dist_ns, path_sur
+
+
+def _direct_paths(lat: np.ndarray, sur: np.ndarray):
+    """use_shortest_path=false: only direct edges route (graph/mod.rs:230-253)."""
+    return lat.copy(), sur.copy()
+
+
+def _node_bandwidth(nd: dict, key: str) -> int:
+    v = nd.get(key)
+    return parse_bits_per_sec(v) if v is not None else 0
+
+
+def build_graph(
+    gml_text: str, *, use_shortest_path: bool = True
+) -> NetworkGraph:
+    g = parse_gml(gml_text)
+    ids = [int(nd["id"]) for nd in g["nodes"]]
+    if len(set(ids)) != len(ids):
+        raise GraphError("duplicate node ids in graph")
+    index_of = {gid: i for i, gid in enumerate(ids)}
+    lat, sur = _edge_arrays(g, index_of)
+    if use_shortest_path:
+        path_lat, path_sur = _shortest_paths(lat, sur)
+    else:
+        path_lat, path_sur = _direct_paths(lat, sur)
+    loss = np.where(path_lat >= 0, 1.0 - path_sur, 0.0).astype(np.float32)
+    return NetworkGraph(
+        node_ids=np.asarray(ids, np.int64),
+        lat_ns=path_lat,
+        loss=loss,
+        bw_down_bits=np.asarray(
+            [_node_bandwidth(nd, "host_bandwidth_down") for nd in g["nodes"]], np.int64
+        ),
+        bw_up_bits=np.asarray(
+            [_node_bandwidth(nd, "host_bandwidth_up") for nd in g["nodes"]], np.int64
+        ),
+        directed=bool(g["directed"]),
+    )
+
+
+def load_graph(options) -> NetworkGraph:
+    """Build from config GraphOptions (reference load_network_graph,
+    graph/mod.rs:495-530; xz-compressed files supported like GraphSource)."""
+    if options.type == "1_gbit_switch":
+        return build_graph(ONE_GBIT_SWITCH_GML, use_shortest_path=options.use_shortest_path)
+    if options.type != "gml":
+        raise GraphError(f"unknown graph type {options.type!r}")
+    if options.inline is not None:
+        text = options.inline
+    elif options.path is not None:
+        if options.path.endswith(".xz"):
+            import lzma
+
+            with lzma.open(options.path, "rt") as f:
+                text = f.read()
+        else:
+            with open(options.path) as f:
+                text = f.read()
+    else:
+        raise GraphError("graph.type=gml needs `path` or `inline`")
+    return build_graph(text, use_shortest_path=options.use_shortest_path)
+
+
+# --------------------------------------------------------------------------
+# IP assignment (reference graph/mod.rs:354-427)
+# --------------------------------------------------------------------------
+
+
+class IpAssignment:
+    """Sequential 11.0.0.0/8 assignment skipping .0 and .255 octets like the
+    reference, with manual addresses honored and collisions rejected."""
+
+    def __init__(self, base: str = "11.0.0.0"):
+        self._next = int(ipaddress.IPv4Address(base)) + 1
+        self._by_ip: dict[int, int] = {}  # ip -> host index
+        self._by_host: dict[int, int] = {}
+
+    def assign_manual(self, host: int, ip: str) -> int:
+        addr = int(ipaddress.IPv4Address(ip))
+        if addr in self._by_ip:
+            raise GraphError(f"duplicate ip_addr {ip}")
+        self._by_ip[addr] = host
+        self._by_host[host] = addr
+        return addr
+
+    def assign(self, host: int) -> int:
+        while True:
+            addr = self._next
+            self._next += 1
+            if addr & 0xFF in (0, 255):  # skip network/broadcast-looking octets
+                continue
+            if addr not in self._by_ip:
+                self._by_ip[addr] = host
+                self._by_host[host] = addr
+                return addr
+
+    def ip_of(self, host: int) -> str:
+        return str(ipaddress.IPv4Address(self._by_host[host]))
+
+    def host_of(self, ip: str) -> int:
+        return self._by_ip[int(ipaddress.IPv4Address(ip))]

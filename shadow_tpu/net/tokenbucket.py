@@ -58,11 +58,17 @@ def tb_conforming_remove(
     depart >= t_ns is the time the packet conforms. Packets larger than the
     burst capacity still depart after enough whole intervals (the reference
     grants an MTU burst allowance for the same reason: relay/mod.rs:276-319).
+
+    A lane is FIFO (the reference relay forwards in queue order): accounting
+    never moves backward, so a packet arriving while a predecessor is still
+    waiting on refill is charged from the predecessor's boundary
+    (`last_itv`), not from its own arrival interval — its stored tokens only
+    exist at that boundary.
     """
     t_ns = jnp.asarray(t_ns, jnp.int64)
     size_bits = jnp.asarray(size_bits, jnp.int64)
-    itv = t_ns // interval_ns
-    elapsed = jnp.maximum(itv - state.last_itv, 0)
+    itv = jnp.maximum(t_ns // interval_ns, state.last_itv)
+    elapsed = itv - state.last_itv
     # saturating refill (cap), computed without i64 overflow for huge gaps
     gain = jnp.where(
         elapsed < (1 << 20), elapsed * params.refill, params.capacity
@@ -76,7 +82,10 @@ def tb_conforming_remove(
     depart_wait = (itv + k) * interval_ns
 
     shaped = params.refill > 0
-    depart = jnp.where(shaped & ~conforms, depart_wait, t_ns)
+    # conforming depart: immediate, unless the tokens live at a future
+    # boundary inherited from a still-waiting predecessor
+    depart_now = jnp.maximum(t_ns, itv * interval_ns)
+    depart = jnp.where(shaped & ~conforms, depart_wait, jnp.where(shaped, depart_now, t_ns))
     new_tokens = jnp.where(conforms, tokens - size_bits, tokens + k * params.refill - size_bits)
     new_itv = jnp.where(conforms, itv, itv + k)
 

@@ -1,0 +1,346 @@
+"""Simulation driver: config -> graph -> engine -> run loop -> outputs.
+
+This is the analogue of the reference's L5/L6 stack (SURVEY.md §1):
+  - `SimConfig::new` (sim_config.rs:47): expand config into per-host specs,
+    load the graph, assign IPs, compute routing.
+  - `Controller::run` / `Manager::run` (controller.rs:40, manager.rs:219):
+    build hosts, seed boot events, drive the round loop, merge stats, write
+    `processed-config.yaml` (manager.rs:182-193) and `sim-stats.json`
+    (manager.rs:544-546).
+  - heartbeat logging (manager.rs:675-717) and the status-bar progress line
+    (controller.rs:115-168, utility/status_bar.rs).
+
+The scheduling loop itself is on-device (`core.engine`); this module only
+decides how many jitted chunks to run and when to print. The reference's
+equivalent of `chunks` is the Manager's `while window` loop — here each chunk
+is `rounds_per_chunk` whole scheduling rounds fused into one device program,
+which is the batching that amortizes dispatch latency (SURVEY.md §7 hard
+part 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from shadow_tpu.core.engine import Engine, EngineConfig, EngineParams
+from shadow_tpu.models.base import get_model
+from shadow_tpu.net import TBParams
+from shadow_tpu.net.graph import IpAssignment, NetworkGraph, load_graph
+from shadow_tpu.simtime import NS_PER_SEC
+
+MTU_BITS = 1500 * 8
+UNLIMITED_BW = 1 << 40  # token-bucket params for "no bandwidth configured"
+
+
+@dataclasses.dataclass
+class HostSpec:
+    """One simulated host after config expansion (reference HostInfo,
+    sim_config.rs:168-192)."""
+
+    host_id: int
+    name: str
+    node_index: int  # index into graph tables (NOT the GML id)
+    ip: str
+    bw_down_bits: int  # 0 = unlimited
+    bw_up_bits: int
+    model: str
+    model_args: dict[str, Any]
+    start_time: int
+    shutdown_time: int | None
+    pcap_enabled: bool
+    pcap_capture_size: int
+
+
+def expand_hosts(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
+    """Config hosts -> HostSpecs with IPs and node indices resolved.
+
+    Hosts are sorted by name for a config-order-independent host-id mapping
+    (the reference shuffles hosts for scheduler balance, manager.rs:272 —
+    sharding here is by contiguous id range, so a stable order is what keeps
+    runs reproducible across config reorderings)."""
+    ips = IpAssignment()
+    specs: list[HostSpec] = []
+    ordered = sorted(cfg.hosts, key=lambda h: h.name)
+    # manual IPs first so sequential assignment skips them (graph/mod.rs:370)
+    for i, h in enumerate(ordered):
+        if h.ip_addr is not None:
+            ips.assign_manual(i, h.ip_addr)
+    for i, h in enumerate(ordered):
+        if not h.processes:
+            raise ConfigError(f"host {h.name!r} has no processes")
+        dev_models = [p for p in h.processes if p.model is not None]
+        if len(dev_models) != 1:
+            raise ConfigError(
+                f"host {h.name!r}: exactly one device-model process per host "
+                f"is supported (got {len(dev_models)})"
+            )
+        p = dev_models[0]
+        node = graph.node_index(h.network_node_id)
+        if h.ip_addr is None:
+            ips.assign(i)
+        bw_down = h.bandwidth_down if h.bandwidth_down is not None else int(
+            graph.bw_down_bits[node]
+        )
+        bw_up = h.bandwidth_up if h.bandwidth_up is not None else int(
+            graph.bw_up_bits[node]
+        )
+        specs.append(
+            HostSpec(
+                host_id=i,
+                name=h.name,
+                node_index=node,
+                ip=ips.ip_of(i),
+                bw_down_bits=bw_down,
+                bw_up_bits=bw_up,
+                model=p.model,
+                model_args=dict(p.model_args),
+                start_time=p.start_time,
+                shutdown_time=p.shutdown_time,
+                pcap_enabled=h.host_options.pcap_enabled,
+                pcap_capture_size=h.host_options.pcap_capture_size,
+            )
+        )
+    return specs
+
+
+def _tb_params(bws: np.ndarray, interval_ns: int) -> TBParams:
+    """Bandwidth -> token bucket: refill = bits per interval, burst capacity =
+    refill + one MTU (reference relay/token_bucket.rs: 1ms refill quantum with
+    an MTU burst allowance, relay/mod.rs:276-319)."""
+    unlimited = bws <= 0
+    per_itv = np.maximum(bws * interval_ns // NS_PER_SEC, 1)
+    refill = np.where(unlimited, UNLIMITED_BW, per_itv).astype(np.int64)
+    cap = np.where(unlimited, UNLIMITED_BW, per_itv + MTU_BITS).astype(np.int64)
+    return TBParams(capacity=jnp.asarray(cap), refill=jnp.asarray(refill))
+
+
+def resolve_world(parallelism: int) -> int:
+    """0 = all local devices (reference: 0 = all cores, configuration.rs)."""
+    avail = jax.device_count()
+    if parallelism <= 0:
+        return avail
+    if parallelism > avail:
+        raise ConfigError(
+            f"general.parallelism={parallelism} exceeds {avail} available devices"
+        )
+    return parallelism
+
+
+class Simulation:
+    """Built simulation: engine + host specs + run loop."""
+
+    def __init__(self, cfg: ConfigOptions, *, world: int | None = None):
+        self.cfg = cfg
+        self.graph = load_graph(cfg.network.graph)
+        self.hosts = expand_hosts(cfg, self.graph)
+        if not self.hosts:
+            raise ConfigError("config defines no hosts")
+        models = {h.model for h in self.hosts}
+        if len(models) != 1:
+            raise ConfigError(
+                f"all hosts must run one device model per simulation for "
+                f"vectorized dispatch; got {sorted(models)}"
+            )
+        self.model = get_model(models.pop())()
+
+        ex = cfg.experimental
+        world = resolve_world(cfg.general.parallelism) if world is None else world
+        # pad the host count to a multiple of the mesh size with inert hosts
+        # (empty queues never activate; the digest ignores them)
+        self._num_real = len(self.hosts)
+        num_hosts = -(-self._num_real // world) * world
+        qcap = ex.event_queue_capacity
+        self.engine_cfg = EngineConfig(
+            num_hosts=num_hosts,
+            stop_time=cfg.general.stop_time,
+            bootstrap_end_time=cfg.general.bootstrap_end_time,
+            runahead_floor=ex.runahead,
+            static_min_latency=max(self.graph.min_latency_ns, 1),
+            use_dynamic_runahead=ex.use_dynamic_runahead,
+            use_codel=ex.use_codel,
+            queue_capacity=qcap,
+            sends_per_host_round=ex.sends_per_host_round,
+            max_round_inserts=ex.max_round_inserts or qcap,
+            rounds_per_chunk=ex.rounds_per_chunk,
+            microstep_limit=ex.microstep_limit,
+            world=world,
+        )
+        mesh = None
+        if world > 1:
+            mesh = jax.sharding.Mesh(np.array(jax.devices()[:world]), ("hosts",))
+        self.engine = Engine(self.engine_cfg, self.model, mesh)
+        self._build_state()
+
+    # ---- build ------------------------------------------------------------
+
+    def _model_hosts(self) -> list[dict]:
+        return [
+            {
+                "host_id": h.host_id,
+                "name": h.name,
+                "start_time": h.start_time,
+                "shutdown_time": h.shutdown_time,
+                "ip": h.ip,
+                "model_args": h.model_args,
+            }
+            for h in self.hosts
+        ]
+
+    def _pad(self, tree):
+        """Pad model [H_real, ...] arrays to the engine's H_total."""
+        pad = self.engine_cfg.num_hosts - self._num_real
+
+        def f(a):
+            a = np.asarray(a)
+            if pad == 0:
+                return jnp.asarray(a)
+            width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.asarray(np.pad(a, width))
+
+        return jax.tree.map(f, tree)
+
+    def _build_state(self):
+        cfg, ecfg = self.cfg, self.engine_cfg
+        try:
+            mparams, mstate, events = self.model.build(
+                self._model_hosts(), cfg.general.seed
+            )
+        except (ValueError, KeyError) as e:
+            raise ConfigError(f"model {self.model.name!r}: {e}") from e
+        node_of = np.zeros((ecfg.num_hosts,), np.int32)
+        bw_up = np.zeros((ecfg.num_hosts,), np.int64)
+        bw_down = np.zeros((ecfg.num_hosts,), np.int64)
+        for h in self.hosts:
+            node_of[h.host_id] = h.node_index
+            bw_up[h.host_id] = h.bw_up_bits
+            bw_down[h.host_id] = h.bw_down_bits
+        params = EngineParams(
+            node_of=jnp.asarray(node_of),
+            lat_ns=jnp.asarray(self.graph.lat_ns),
+            loss=jnp.asarray(self.graph.loss),
+            eg_tb=_tb_params(bw_up, ecfg.tb_interval_ns),
+            in_tb=_tb_params(bw_down, ecfg.tb_interval_ns),
+            model=self._pad(mparams),
+        )
+        self.state, self.params = self.engine.init_state(
+            params, self._pad(mstate), events, seed=cfg.general.seed
+        )
+
+    # ---- run --------------------------------------------------------------
+
+    def run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
+        """Drive chunks until done. Returns the final stats report dict."""
+        cfg = self.cfg
+        show_progress = cfg.general.progress if progress is None else progress
+        hb_ns = cfg.general.heartbeat_interval
+        t0 = time.monotonic()
+        next_hb = hb_ns
+        chunks = 0
+        while not bool(self.state.done):
+            self.state = self.engine.run_chunk(self.state, self.params)
+            chunks += 1
+            now_ns = int(self.state.now)
+            wall = time.monotonic() - t0
+            if hb_ns and now_ns >= next_hb:
+                ev = int(np.asarray(self.state.stats.events).sum())
+                print(
+                    f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
+                    f"wall={wall:.2f}s events={ev} "
+                    f"rounds={int(self.state.stats.rounds)} "
+                    f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x",
+                    file=log,
+                )
+                next_hb = (now_ns // hb_ns + 1) * hb_ns
+            if show_progress:
+                pct = min(100.0, 100.0 * now_ns / max(cfg.general.stop_time, 1))
+                print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
+        if show_progress:
+            print(file=log)
+        self._wall_seconds = time.monotonic() - t0
+        self._chunks = chunks
+        return self.stats_report()
+
+    # ---- outputs ----------------------------------------------------------
+
+    def stats_report(self) -> dict:
+        """sim-stats content (reference sim_stats.rs counters + tracker.c)."""
+        s = jax.device_get(self.state.stats)
+        n = self._num_real
+        wall = getattr(self, "_wall_seconds", None)
+        sim_s = int(self.state.now) / NS_PER_SEC
+        report = {
+            "simulated_seconds": sim_s,
+            "wall_seconds": wall,
+            "sim_wall_ratio": (sim_s / wall) if wall else None,
+            "rounds": int(s.rounds),
+            "microsteps": int(np.asarray(s.microsteps).sum()),
+            "events_processed": int(s.events[:n].sum()),
+            "packets_sent": int(s.pkts_sent[:n].sum()),
+            "packets_delivered": int(s.pkts_delivered[:n].sum()),
+            "packets_lost": int(s.pkts_lost[:n].sum()),
+            "packets_unreachable": int(s.pkts_unreachable[:n].sum()),
+            "packets_codel_dropped": int(s.pkts_codel_dropped[:n].sum()),
+            "queue_overflow_dropped": int(
+                np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
+            ),
+            "packets_budget_dropped": int(s.pkts_budget_dropped[:n].sum()),
+            "outbox_overflow_dropped": int(np.asarray(s.ob_dropped).sum()),
+            "monotonic_violations": int(s.monotonic_violations[:n].sum()),
+            "determinism_digest": f"{int(np.bitwise_xor.reduce(s.digest[:n])):016x}",
+            "model_report": self.model.report(
+                jax.tree.map(lambda a: np.asarray(a)[:n], jax.device_get(self.state.model)),
+                self._model_hosts(),
+            ),
+        }
+        return report
+
+    def host_digests(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self.state.stats.digest))[: self._num_real]
+
+    def write_outputs(self, data_dir: str | None = None) -> str:
+        """Write the data directory (reference data-dir layout:
+        processed-config.yaml, sim-stats.json, hosts/<name>/)."""
+        data_dir = data_dir or self.cfg.general.data_directory
+        os.makedirs(data_dir, exist_ok=True)
+        with open(os.path.join(data_dir, "processed-config.yaml"), "w") as f:
+            yaml.safe_dump(self.cfg.to_dict(), f, sort_keys=False)
+        report = self.stats_report()
+        with open(os.path.join(data_dir, "sim-stats.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        s = jax.device_get(self.state.stats)
+        digests = self.host_digests()
+        for h in self.hosts:
+            hd = os.path.join(data_dir, "hosts", h.name)
+            os.makedirs(hd, exist_ok=True)
+            with open(os.path.join(hd, "host-stats.json"), "w") as f:
+                json.dump(
+                    {
+                        "name": h.name,
+                        "ip": h.ip,
+                        "events_processed": int(s.events[h.host_id]),
+                        "packets_sent": int(s.pkts_sent[h.host_id]),
+                        "packets_delivered": int(s.pkts_delivered[h.host_id]),
+                        "packets_lost": int(s.pkts_lost[h.host_id]),
+                        "determinism_digest": f"{int(digests[h.host_id]):016x}",
+                    },
+                    f,
+                    indent=2,
+                )
+        return data_dir
+
+
+def run_simulation(cfg: ConfigOptions, **kw) -> tuple[Simulation, dict]:
+    sim = Simulation(cfg, **kw)
+    report = sim.run()
+    return sim, report

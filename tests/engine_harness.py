@@ -21,7 +21,7 @@ def run_sim(
     loss: float = 0.0,
     bw_bits: int = 0,
     qcap: int = 32,
-    obcap: int = 256,
+    sends_budget: int = 8,
     seed: int = 1,
     runahead_floor: int = 1_000_000,
     use_codel: bool = True,
@@ -33,7 +33,7 @@ def run_sim(
         runahead_floor=runahead_floor,
         static_min_latency=latency,
         queue_capacity=qcap,
-        outbox_capacity=obcap,
+        sends_per_host_round=sends_budget,
         max_round_inserts=qcap,
         rounds_per_chunk=64,
         world=world,

@@ -79,3 +79,22 @@ def test_other_mesh_shapes(world):
     d1, _ = _digest("phold", hosts, world=1)
     dw, _ = _digest("phold", hosts, world=world)
     assert np.array_equal(d1, dw)
+
+
+def test_send_budget_drops_are_mesh_invariant():
+    """Gossip with fanout over the per-host send budget: which packets get
+    dropped must depend only on each host's own send count, never on shard
+    composition (regression: the old per-shard outbox capacity made drops a
+    function of mesh shape)."""
+    hosts = mk_hosts(16, {"fanout": 6})
+    hosts[0]["model_args"]["publisher"] = True
+    kw = dict(sends_budget=4, runahead_floor=50_000_000)
+    d1, s1 = _digest("gossip", hosts, world=1, **kw)
+    d8, s8 = _digest("gossip", hosts, world=8, **kw)
+    assert np.array_equal(d1, d8)
+    dropped1 = np.asarray(s1.pkts_budget_dropped)
+    assert dropped1.sum() > 0, "test must actually exceed the budget"
+    np.testing.assert_array_equal(dropped1, np.asarray(s8.pkts_budget_dropped))
+    # the shard buffer itself can never overflow under the budget
+    assert int(np.asarray(s1.ob_dropped).sum()) == 0
+    assert int(np.asarray(s8.ob_dropped).sum()) == 0

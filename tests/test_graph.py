@@ -1,0 +1,176 @@
+"""Graph subsystem tests (reference: gml-parser tests + graph/mod.rs routing
+semantics: shortest-path latency, composed path loss, direct-edge mode,
+IP assignment skipping .0/.255)."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config.options import GraphOptions
+from shadow_tpu.net.graph import (
+    GraphError,
+    IpAssignment,
+    build_graph,
+    load_graph,
+    parse_gml,
+)
+
+TRIANGLE = """
+# a comment
+graph [
+  directed 0
+  node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "10 Mbit" ]
+  node [ id 1 ]
+  node [ id 7 label "c" ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 1 target 7 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 0 target 7 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def test_parse_gml_structure():
+    g = parse_gml(TRIANGLE)
+    assert not g["directed"]
+    assert [n["id"] for n in g["nodes"]] == [0, 1, 7]
+    assert g["nodes"][2]["label"] == "c"
+    assert len(g["edges"]) == 3
+
+
+def test_shortest_path_latency_and_loss():
+    g = build_graph(TRIANGLE)
+    i0, i1, i7 = g.node_index(0), g.node_index(1), g.node_index(7)
+    # 0->7: two-hop path (20ms) beats direct edge (50ms)
+    assert g.lat_ns[i0, i7] == 20_000_000
+    # loss composes: 1 - 0.9*0.9
+    assert g.loss[i0, i7] == pytest.approx(1 - 0.9 * 0.9, abs=1e-6)
+    assert g.lat_ns[i0, i1] == 10_000_000
+    assert g.loss[i0, i1] == pytest.approx(0.1, abs=1e-6)
+    # symmetric (undirected)
+    assert np.array_equal(g.lat_ns, g.lat_ns.T)
+    # self paths are free unless a self-edge exists
+    assert g.lat_ns[i0, i0] == 0 and g.loss[i0, i0] == 0
+    assert g.min_latency_ns == 0  # self paths count (single-node graphs route)
+    assert g.bw_down_bits[i0] == 100_000_000 and g.bw_up_bits[i0] == 10_000_000
+
+
+def test_direct_edge_mode():
+    g = build_graph(TRIANGLE, use_shortest_path=False)
+    i0, i7 = g.node_index(0), g.node_index(7)
+    assert g.lat_ns[i0, i7] == 50_000_000  # no multi-hop routing
+    assert g.loss[i0, i7] == 0.0
+
+
+def test_unreachable_is_minus_one():
+    gml = """
+    graph [ directed 0
+      node [ id 0 ] node [ id 1 ] node [ id 2 ]
+      edge [ source 0 target 1 latency "5 ms" ]
+    ]
+    """
+    g = build_graph(gml)
+    assert g.lat_ns[g.node_index(0), g.node_index(2)] == -1
+    assert g.lat_ns[g.node_index(0), g.node_index(1)] == 5_000_000
+
+
+def test_directed_graph_asymmetric():
+    gml = """
+    graph [ directed 1
+      node [ id 0 ] node [ id 1 ]
+      edge [ source 0 target 1 latency "5 ms" ]
+    ]
+    """
+    g = build_graph(gml)
+    assert g.lat_ns[0, 1] == 5_000_000
+    assert g.lat_ns[1, 0] == -1
+
+
+def test_self_edge_routes_loopback():
+    gml = """
+    graph [ directed 0
+      node [ id 0 ]
+      edge [ source 0 target 0 latency "2 ms" packet_loss 0.25 ]
+    ]
+    """
+    g = build_graph(gml)
+    assert g.lat_ns[0, 0] == 2_000_000
+    assert g.loss[0, 0] == pytest.approx(0.25)
+
+
+def test_parallel_edges_keep_lowest_latency():
+    gml = """
+    graph [ directed 0
+      node [ id 0 ] node [ id 1 ]
+      edge [ source 0 target 1 latency "9 ms" packet_loss 0.5 ]
+      edge [ source 0 target 1 latency "3 ms" ]
+    ]
+    """
+    g = build_graph(gml)
+    assert g.lat_ns[0, 1] == 3_000_000
+    assert g.loss[0, 1] == 0.0
+
+
+def test_builtin_one_gbit_switch():
+    g = load_graph(GraphOptions(type="1_gbit_switch"))
+    assert g.num_nodes == 1
+    assert g.lat_ns[0, 0] == 1_000_000
+    assert g.bw_down_bits[0] == 1_000_000_000
+
+
+def test_gml_errors():
+    with pytest.raises(GraphError, match="no nodes"):
+        build_graph("graph [ directed 0 ]")
+    with pytest.raises(GraphError, match="missing latency"):
+        build_graph("graph [ node [ id 0 ] node [ id 1 ] edge [ source 0 target 1 ] ]")
+    with pytest.raises(GraphError, match="unknown node"):
+        build_graph("graph [ node [ id 0 ] edge [ source 0 target 9 latency \"1 ms\" ] ]")
+    with pytest.raises(GraphError, match="duplicate node"):
+        build_graph("graph [ node [ id 0 ] node [ id 0 ] ]")
+
+
+def test_ip_assignment():
+    ips = IpAssignment()
+    a = ips.assign(0)
+    b = ips.assign(1)
+    assert ips.ip_of(0) == "11.0.0.1" and ips.ip_of(1) == "11.0.0.2"
+    assert a != b
+    assert ips.host_of("11.0.0.2") == 1
+    ips2 = IpAssignment()
+    ips2.assign_manual(5, "11.0.0.1")
+    assert ips2.assign(6) != int(np.int64(0xB000001))  # skips taken address
+    assert ips2.ip_of(6) == "11.0.0.2"
+    with pytest.raises(GraphError, match="duplicate ip"):
+        ips2.assign_manual(7, "11.0.0.1")
+
+
+def test_ip_assignment_skips_0_and_255():
+    ips = IpAssignment()
+    seen = {ips.assign(i) & 0xFF for i in range(600)}
+    assert 0 not in seen and 255 not in seen
+
+
+def test_large_random_graph_matches_floyd_warshall():
+    rng = np.random.default_rng(0)
+    n = 40
+    lines = ["graph [ directed 0"]
+    for i in range(n):
+        lines.append(f"  node [ id {i} ]")
+    edges = set()
+    for _ in range(120):
+        a, b = rng.integers(0, n, 2)
+        if a == b or (min(a, b), max(a, b)) in edges:
+            continue
+        edges.add((min(a, b), max(a, b)))
+        ms = int(rng.integers(1, 100))
+        lines.append(f'  edge [ source {a} target {b} latency "{ms} ms" ]')
+    lines.append("]")
+    g = build_graph("\n".join(lines))
+    # oracle: Floyd-Warshall on the direct-edge matrix
+    inf = np.int64(1) << 50
+    d = np.where(g.lat_ns >= 0, g.lat_ns, inf)
+    dd = build_graph("\n".join(lines), use_shortest_path=False).lat_ns
+    d = np.where(dd >= 0, dd, inf)
+    np.fill_diagonal(d, 0)
+    for k in range(n):
+        d = np.minimum(d, d[:, k : k + 1] + d[k : k + 1, :])
+    expect = np.where(d >= inf, -1, d)
+    np.testing.assert_array_equal(g.lat_ns, expect)

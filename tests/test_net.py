@@ -53,6 +53,34 @@ def test_tb_refill_is_quantized():
     assert d == 3 * MS
 
 
+def test_tb_fifo_no_overtake_no_double_refill():
+    """A packet popped while a predecessor is still waiting on refill must not
+    depart before it, roll accounting backward, or re-accrue spent refill."""
+    p, s = _tb(10_000, 1_000)
+    # A: 20000 bits at t=0 -> drains burst, borrows 10 intervals -> departs 10ms
+    s, d = _remove(s, p, 0, 20_000)
+    assert d == 10 * MS
+    assert int(s.last_itv[0]) == 10
+    # B: 5000 bits at t=0.5ms: charged from A's boundary, departs 15ms (not 5ms)
+    s, d = _remove(s, p, MS // 2, 5_000)
+    assert d == 15 * MS
+    assert int(s.last_itv[0]) == 15  # never rolled back
+    # C: 1000 bits at t=6ms: interval 6-15 refill is already spent -> 16ms
+    s, d = _remove(s, p, 6 * MS, 1_000)
+    assert d == 16 * MS
+    # total delivered by 16ms: 26000 bits <= burst 10000 + 16*1000 = 26000
+
+
+def test_tb_conforming_at_future_boundary():
+    """Leftover tokens stored at a future boundary are only usable there."""
+    p, s = _tb(10_000, 1_000)
+    s, d = _remove(s, p, 0, 19_000)  # borrows to itv 9, leaves 0 tokens...
+    assert d == 9 * MS
+    # 1000 bits at t=1ms: one refill lands at boundary 10 -> departs 10ms
+    s, d = _remove(s, p, MS, 1_000)
+    assert d == 10 * MS
+
+
 def test_tb_unshaped_passthrough():
     p, s = _tb(0, 0)
     s, d = _remove(s, p, 7 * MS, 10**9)

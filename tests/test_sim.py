@@ -1,0 +1,179 @@
+"""End-to-end config-driven simulation tests (the analogue of the reference's
+system tests: a YAML config in, deterministic results + data-dir out;
+src/test/config + determinism suites)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config.options import ConfigError, load_config, merge_cli_overrides
+from shadow_tpu.sim import Simulation, expand_hosts
+
+ECHO_YAML = """
+general:
+  stop_time: 5 s
+  seed: 7
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_down "100 Mbit" host_bandwidth_up "100 Mbit" ]
+        node [ id 1 ]
+        edge [ source 0 target 0 latency "1 ms" ]
+        edge [ source 0 target 1 latency "25 ms" packet_loss 0.0 ]
+        edge [ source 1 target 1 latency "1 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - model: udp_echo
+        model_args: { role: server }
+  client:
+    count: 3
+    network_node_id: 1
+    bandwidth_up: 10 Mbit
+    bandwidth_down: 10 Mbit
+    processes:
+      - model: udp_echo
+        model_args: { role: client, peer: server, interval: 1 s, size_bytes: 256 }
+"""
+
+
+def _build(yaml_text=ECHO_YAML, **over):
+    cfg = load_config(yaml_text, is_text=True)
+    if over:
+        cfg = merge_cli_overrides(cfg, {k: str(v) for k, v in over.items()})
+    return cfg
+
+
+def test_expand_hosts_ips_and_bandwidth():
+    cfg = _build()
+    sim = Simulation(cfg, world=1)
+    names = [h.name for h in sim.hosts]
+    assert names == sorted(names) and "server" in names and "client2" in names
+    assert len({h.ip for h in sim.hosts}) == 4
+    by_name = {h.name: h for h in sim.hosts}
+    assert by_name["server"].bw_down_bits == 100_000_000  # from graph node
+    assert by_name["client1"].bw_up_bits == 10_000_000  # per-host override
+
+
+def test_echo_end_to_end():
+    cfg = _build()
+    sim = Simulation(cfg, world=1)
+    report = sim.run()
+    m = report["model_report"]
+    # 3 clients x 5 ticks (t=0..4s); each RTT = 2*25ms
+    assert m["requests_sent"] == 15
+    assert m["requests_served"] == 15
+    # last responses (sent t=4s) arrive 4.05s < 5s: all come back
+    assert m["responses_received"] == 15
+    assert m["mean_rtt_ms"] == pytest.approx(50.0, abs=1.0)
+    assert report["packets_lost"] == 0
+    assert report["events_processed"] > 0
+
+
+def test_determinism_across_runs_and_world(tmp_path):
+    cfg = _build()
+    d1 = Simulation(cfg, world=1)
+    d1.run()
+    d2 = Simulation(cfg, world=1)
+    d2.run()
+    np.testing.assert_array_equal(d1.host_digests(), d2.host_digests())
+    # world=2 pads 4 hosts onto 2 shards; digests must not change
+    d3 = Simulation(cfg, world=2)
+    d3.run()
+    np.testing.assert_array_equal(d1.host_digests(), d3.host_digests())
+
+
+def test_write_outputs(tmp_path):
+    cfg = _build()
+    cfg.general.data_directory = str(tmp_path / "data")
+    sim = Simulation(cfg, world=1)
+    sim.run()
+    out = sim.write_outputs()
+    with open(os.path.join(out, "sim-stats.json")) as f:
+        stats = json.load(f)
+    assert stats["packets_delivered"] == 30  # 15 requests + 15 responses
+    assert os.path.exists(os.path.join(out, "processed-config.yaml"))
+    with open(os.path.join(out, "hosts", "server", "host-stats.json")) as f:
+        server = json.load(f)
+    assert server["packets_delivered"] == 15
+    assert server["ip"]
+
+
+def test_world_padding_uneven():
+    # 4 hosts over world=8 devices -> padded to 8, inert pads don't perturb
+    cfg = _build()
+    sim = Simulation(cfg, world=8)
+    assert sim.engine_cfg.num_hosts == 8
+    report = sim.run()
+    assert report["model_report"]["responses_received"] == 15
+
+
+def test_config_errors():
+    with pytest.raises(ConfigError, match="one device-model process"):
+        Simulation(
+            _build(
+                """
+general: { stop_time: 1 s }
+hosts:
+  a:
+    processes: []
+""".replace("processes: []", "processes: [{model: udp_echo, model_args: {role: server}}, {model: timer}]")
+            ),
+            world=1,
+        )
+    with pytest.raises(ConfigError, match="no hosts"):
+        Simulation(_build("general: { stop_time: 1 s }\nhosts: {}"), world=1)
+
+
+def test_cli_round_trip(tmp_path):
+    cfg_path = tmp_path / "sim.yaml"
+    cfg_path.write_text(ECHO_YAML)
+    data_dir = tmp_path / "data"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "shadow_tpu",
+            str(cfg_path),
+            "--print-stats",
+            "--general.data_directory",
+            str(data_dir),
+            "--general.stop_time=2 s",
+            "--general.parallelism=1",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+    stats = json.loads(r.stdout)
+    assert stats["model_report"]["requests_sent"] == 6  # 3 clients x 2 ticks
+    assert (data_dir / "sim-stats.json").exists()
+    assert "done: simulated" in r.stderr
+
+
+def test_cli_dry_run_and_bad_config(tmp_path):
+    cfg_path = tmp_path / "sim.yaml"
+    cfg_path.write_text(ECHO_YAML)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", str(cfg_path), "--dry-run"],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert r.returncode == 0 and "config ok: 4 hosts" in r.stderr
+    r2 = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", str(cfg_path), "--bogus.key=1", "--dry-run"],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert r2.returncode == 2 and "config error" in r2.stderr
