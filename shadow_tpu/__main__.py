@@ -13,6 +13,8 @@ import argparse
 import json
 import sys
 
+import yaml
+
 from shadow_tpu import __version__
 from shadow_tpu.config.options import ConfigError, load_config, merge_cli_overrides
 
@@ -69,32 +71,34 @@ def main(argv: list[str] | None = None) -> int:
         from shadow_tpu.sim import Simulation  # deferred: jax init is slow
 
         sim = Simulation(cfg)
-        if args.dry_run:
-            print(
-                f"config ok: {len(sim.hosts)} hosts, "
-                f"{sim.graph.num_nodes} graph nodes, "
-                f"world={sim.engine_cfg.world}",
-                file=sys.stderr,
-            )
-            return 0
-        sim.run()
-        data_dir = sim.write_outputs()
-        report = sim.stats_report()
-        if args.print_stats:
-            json.dump(report, sys.stdout, indent=2)
-            print()
+    except (ConfigError, OSError, yaml.YAMLError) as e:
+        # Only the config-build phase maps to exit 2. GraphError subclasses
+        # ConfigError; OSError covers missing/unreadable config + graph files
+        # (reference: bad config exits with an error, not a backtrace).
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+    if args.dry_run:
         print(
-            f"done: simulated {report['simulated_seconds']:.3f}s in "
-            f"{report['wall_seconds']:.2f}s "
-            f"({report['sim_wall_ratio']:.2f}x), "
-            f"{report['events_processed']} events, "
-            f"{report['packets_delivered']} packets; outputs in {data_dir}/",
+            f"config ok: {len(sim.hosts)} hosts, "
+            f"{sim.graph.num_nodes} graph nodes, "
+            f"world={sim.engine_cfg.world}",
             file=sys.stderr,
         )
         return 0
-    except ConfigError as e:
-        print(f"config error: {e}", file=sys.stderr)
-        return 2
+    report = sim.run()
+    data_dir = sim.write_outputs(report=report)
+    if args.print_stats:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    print(
+        f"done: simulated {report['simulated_seconds']:.3f}s in "
+        f"{report['wall_seconds']:.2f}s "
+        f"({report['sim_wall_ratio']:.2f}x), "
+        f"{report['events_processed']} events, "
+        f"{report['packets_delivered']} packets; outputs in {data_dir}/",
+        file=sys.stderr,
+    )
+    return 0
 
 
 if __name__ == "__main__":

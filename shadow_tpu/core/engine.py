@@ -583,8 +583,18 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     for s in out.sends:
         mask = s.mask & dispatch
         sz = jnp.asarray(s.size_bytes, jnp.int32)
+        # per-host round budget: the drop decision is a function of this
+        # host's own sends only, so it cannot vary with mesh shape. Decided
+        # BEFORE the bandwidth charge: a budget-dropped packet must be
+        # side-effect-free (no debited bits, no borrowed refill intervals).
+        over_budget = sent_round >= cfg.sends_per_host_round
         tb_eg, eg_depart = tb_conforming_remove(
-            tb_eg, params.eg_tb, cfg.tb_interval_ns, ev.t, sz.astype(jnp.int64) * 8, mask
+            tb_eg,
+            params.eg_tb,
+            cfg.tb_interval_ns,
+            ev.t,
+            sz.astype(jnp.int64) * 8,
+            mask & ~over_budget,
         )
         dst_raw = jnp.asarray(s.dst, jnp.int64)
         bad_dst = mask & ((dst_raw < 0) | (dst_raw >= cfg.num_hosts))
@@ -598,9 +608,6 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         unreachable = mask & ((lat < 0) | bad_dst)
         rng, u = rng_uniform(rng, mask)
         lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
-        # per-host round budget: the drop decision is a function of this
-        # host's own sends only, so it cannot vary with mesh shape
-        over_budget = sent_round >= cfg.sends_per_host_round
         send_ok = mask & ~lost & ~unreachable & ~over_budget
         budget_dropped = mask & ~lost & ~unreachable & over_budget
         sent_round = sent_round + send_ok.astype(jnp.int32)

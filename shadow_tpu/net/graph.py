@@ -30,11 +30,13 @@ from typing import Any
 
 import numpy as np
 
+from shadow_tpu.config.options import ConfigError
 from shadow_tpu.config.units import parse_bits_per_sec, parse_time_ns, TimeUnit
 
 
-class GraphError(ValueError):
-    pass
+class GraphError(ConfigError):
+    """Graph problems are config problems: the CLI's exit-2 contract covers
+    both (reference exits with a config error for bad graphs too)."""
 
 
 # --------------------------------------------------------------------------
@@ -84,11 +86,17 @@ def _parse_gml_value(tokens, tok_type, tok):
     raise GraphError(f"unexpected GML token {tok!r}")
 
 
-def _parse_gml_list(tokens) -> list[tuple[str, Any]]:
-    """A GML record is an ordered multimap: repeated keys (node, edge) stack."""
+def _parse_gml_list(tokens, *, toplevel: bool = False) -> list[tuple[str, Any]]:
+    """A GML record is an ordered multimap: repeated keys (node, edge) stack.
+
+    Only the implicit top-level record may end at EOF; a nested record that
+    runs out of tokens is truncated input and must error, not silently drop
+    everything after the cut."""
     items: list[tuple[str, Any]] = []
     for tok_type, tok in tokens:
         if tok_type == "rbracket":
+            if toplevel:
+                raise GraphError("unmatched ']' at GML top level")
             return items
         if tok_type != "key":
             raise GraphError(f"expected key in GML record, got {tok!r}")
@@ -97,6 +105,8 @@ def _parse_gml_list(tokens) -> list[tuple[str, Any]]:
         except StopIteration:
             raise GraphError(f"GML key {tok!r} has no value") from None
         items.append((tok, _parse_gml_value(tokens, vt, vv)))
+    if not toplevel:
+        raise GraphError("truncated GML: record not closed with ']'")
     return items
 
 
@@ -107,7 +117,7 @@ def parse_gml(text: str) -> dict[str, Any]:
     host_bandwidth_down/up, latency, packet_loss, label, ...).
     """
     tokens = _tokenize_gml(text)
-    top = _parse_gml_list(tokens)  # implicit outer record
+    top = _parse_gml_list(tokens, toplevel=True)  # implicit outer record
     graph_rec = None
     for k, v in top:
         if k == "graph":

@@ -308,14 +308,18 @@ class Simulation:
     def host_digests(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.state.stats.digest))[: self._num_real]
 
-    def write_outputs(self, data_dir: str | None = None) -> str:
+    def write_outputs(
+        self, data_dir: str | None = None, report: dict | None = None
+    ) -> str:
         """Write the data directory (reference data-dir layout:
-        processed-config.yaml, sim-stats.json, hosts/<name>/)."""
+        processed-config.yaml, sim-stats.json, hosts/<name>/). Pass the report
+        from run() to avoid recomputing the device->host stats transfer."""
         data_dir = data_dir or self.cfg.general.data_directory
         os.makedirs(data_dir, exist_ok=True)
         with open(os.path.join(data_dir, "processed-config.yaml"), "w") as f:
             yaml.safe_dump(self.cfg.to_dict(), f, sort_keys=False)
-        report = self.stats_report()
+        if report is None:
+            report = self.stats_report()
         with open(os.path.join(data_dir, "sim-stats.json"), "w") as f:
             json.dump(report, f, indent=2)
         s = jax.device_get(self.state.stats)
