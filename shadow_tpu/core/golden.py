@@ -1,0 +1,373 @@
+"""Golden reference engine: sequential CPU PDES with the device contract.
+
+The reference validates itself by running the same workload under Linux and
+under Shadow and diffing the results (SURVEY.md §4.2: `add_linux_tests` /
+`add_shadow_tests` dual registration — the real OS is the oracle). The device
+engine needs the same kind of oracle: this module is an INDEPENDENT
+implementation of the engine semantics — per-host binary heaps (the
+reference's `BinaryHeap<Reverse<Event>>`, event_queue.rs:10-55), scalar
+integer token buckets, a scalar CoDel control law, Python-loop rounds — that
+must produce bit-identical per-host digests and counters to
+`shadow_tpu.core.engine` for any workload. Any divergence is a bug in one of
+the two (tests/test_golden.py is the gate, the analogue of the reference's
+determinism suite diffing two schedulers, src/test/determinism/).
+
+Shared on purpose: the vectorized model handlers and the per-host RNG lanes
+(`ops.rng`) — models are the workload, not the engine under test. Golden
+calls the same `model.handle` once per microstep with the same batch masks,
+so model arithmetic is common-mode; what differs is everything the engine
+does around it: queue order, window computation, shaping, budget, exchange.
+
+Deliberately slow (pure Python loops): use small host counts / short sims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core.engine import EngineConfig, EngineParams
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    KIND_INGRESS_DONE,
+    KIND_MASK,
+    KIND_PKT,
+    PAYLOAD_SIZE_WORD,
+)
+from shadow_tpu.net.codel import INTERVAL_NS as CODEL_INTERVAL_NS
+from shadow_tpu.net.codel import TARGET_NS as CODEL_TARGET_NS
+from shadow_tpu.ops.events import (
+    EVENT_PAYLOAD_WORDS,
+    ORDER_MAX,
+    pack_order,
+    unpack_order_src,
+)
+from shadow_tpu.ops.rng import rng_init, rng_uniform
+from shadow_tpu.simtime import TIME_MAX
+
+_U64 = (1 << 64) - 1
+_FNV_PRIME = 1099511628211
+_FNV_OFFSET = 0xCBF29CE484222325
+_MIX1 = 0x9E3779B97F4A7C15
+_MIX2 = 0xC2B2AE3D27D4EB4F
+
+
+def _pack(is_local: int, src: int, seq: int) -> int:
+    return int(pack_order(is_local, src, seq))
+
+
+# --------------------------------------------------------------------------
+# scalar shaping lanes (independent reimplementations of net/tokenbucket.py
+# and net/codel.py — integer / f64 math identical by construction)
+# --------------------------------------------------------------------------
+
+
+class _TokenBucket:
+    """One lane; mirrors tb_conforming_remove bit-for-bit in Python ints."""
+
+    def __init__(self, capacity: int, refill: int, interval_ns: int):
+        self.cap = int(capacity)
+        self.refill = int(refill)
+        self.interval = int(interval_ns)
+        self.tokens = int(capacity)
+        self.last_itv = 0
+
+    def _depart(self, t: int, size: int) -> tuple[int, int, int]:
+        """(depart, new_tokens, new_itv) without mutating."""
+        if self.refill <= 0:
+            return t, self.tokens, self.last_itv
+        itv = max(t // self.interval, self.last_itv)
+        elapsed = itv - self.last_itv
+        gain = elapsed * self.refill if elapsed < (1 << 20) else self.cap
+        tokens = min(self.cap, self.tokens + gain)
+        if tokens >= size:
+            return max(t, itv * self.interval), tokens - size, itv
+        k = (size - tokens + self.refill - 1) // self.refill
+        return (itv + k) * self.interval, tokens + k * self.refill - size, itv + k
+
+    def probe(self, t: int, size: int) -> int:
+        return self._depart(t, size)[0]
+
+    def charge(self, t: int, size: int) -> int:
+        depart, tokens, itv = self._depart(t, size)
+        if self.refill > 0:
+            self.tokens, self.last_itv = tokens, itv
+        return depart
+
+
+class _Codel:
+    """One control-law lane; mirrors codel_on_packet (RFC 8289 constants)."""
+
+    def __init__(self):
+        self.first_above = 0
+        self.drop_next = 0
+        self.count = 0
+        self.dropping = False
+
+    @staticmethod
+    def _law(now: int, count: int) -> int:
+        c = np.float64(max(count, 1))
+        return now + int(np.round(np.float64(CODEL_INTERVAL_NS) / np.sqrt(c)))
+
+    def on_packet(self, now: int, sojourn: int) -> bool:
+        below = sojourn < CODEL_TARGET_NS
+        fa_unset = self.first_above == 0
+        ok_to_drop = (not below) and (not fa_unset) and now >= self.first_above
+        self.first_above = (
+            0 if below else (now + CODEL_INTERVAL_NS if fa_unset else self.first_above)
+        )
+        if self.dropping:
+            if not ok_to_drop:
+                self.dropping = False
+                return False
+            if now >= self.drop_next:
+                self.count += 1
+                self.drop_next = self._law(self.drop_next, self.count)
+                return True
+            return False
+        if ok_to_drop:
+            recent = (now - self.drop_next) < 16 * CODEL_INTERVAL_NS
+            self.count = self.count - 2 if (recent and self.count > 2) else 1
+            self.drop_next = self._law(now, self.count)
+            self.dropping = True
+            return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# the golden engine
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GoldenResult:
+    digests: np.ndarray  # u64[H]
+    stats: dict[str, np.ndarray]  # per-host counters mirroring Stats
+    model_state: Any
+    now: int
+    rounds: int
+    microsteps: int
+
+
+def run_golden(
+    cfg: EngineConfig,
+    model,
+    params: EngineParams,
+    model_state,
+    initial_events: list[tuple[int, int, int, tuple]],
+    seed: int,
+) -> GoldenResult:
+    h = cfg.num_hosts
+    node_of = np.asarray(params.node_of)
+    lat_ns = np.asarray(params.lat_ns)
+    loss = np.asarray(params.loss)
+    eg = [
+        _TokenBucket(c, r, cfg.tb_interval_ns)
+        for c, r in zip(np.asarray(params.eg_tb.capacity), np.asarray(params.eg_tb.refill))
+    ]
+    ing = [
+        _TokenBucket(c, r, cfg.tb_interval_ns)
+        for c, r in zip(np.asarray(params.in_tb.capacity), np.asarray(params.in_tb.refill))
+    ]
+    codel = [_Codel() for _ in range(h)]
+    rng = rng_init(h, seed)
+    mparams_dev = jax.tree.map(jnp.asarray, params.model)
+    mstate_dev = jax.tree.map(jnp.asarray, model_state)
+
+    # per-host heaps of (t, order, kind, payload-tuple); capacity-bounded
+    heaps: list[list] = [[] for _ in range(h)]
+    seq = [0] * h
+    digests = [_FNV_OFFSET] * h
+    st = {
+        k: np.zeros(h, np.int64)
+        for k in (
+            "events",
+            "pkts_sent",
+            "pkts_lost",
+            "pkts_unreachable",
+            "pkts_codel_dropped",
+            "pkts_delivered",
+            "monotonic_violations",
+            "pkts_budget_dropped",
+            "dropped",
+        )
+    }
+    for host, t_ns, k, pl in initial_events:
+        payload = np.zeros(EVENT_PAYLOAD_WORDS, np.int32)
+        payload[: len(pl)] = pl
+        heapq.heappush(heaps[host], (int(t_ns), _pack(1, host, seq[host]), int(k), payload))
+        seq[host] += 1
+
+    def qpush(host: int, t: int, order: int, kind: int, payload) -> None:
+        if len(heaps[host]) >= cfg.queue_capacity:
+            st["dropped"][host] += 1
+            return
+        heapq.heappush(heaps[host], (t, order, kind, payload))
+
+    min_used_lat = cfg.static_min_latency
+    now = 0
+    rounds = 0
+    microsteps = 0
+    limit = cfg.effective_microstep_limit
+    r_cap = min(cfg.max_round_inserts, cfg.queue_capacity)
+
+    while True:
+        gmin = min((q[0][0] for q in heaps if q), default=TIME_MAX)
+        if gmin >= cfg.stop_time:
+            break
+        runahead = (
+            max(cfg.runahead_floor, min_used_lat)
+            if cfg.use_dynamic_runahead
+            else max(cfg.runahead_floor, cfg.static_min_latency)
+        )
+        window_end = min(min(gmin, cfg.stop_time) + max(runahead, 1), cfg.stop_time)
+
+        staged: list[tuple[int, int, int, int, np.ndarray]] = []  # dst,t,order,kind,pl
+        sent_round = np.zeros(h, np.int32)
+        steps = 0
+        while steps < limit:
+            # ---- batch pop: each host's earliest event < window_end
+            ev_t = np.full(h, TIME_MAX, np.int64)
+            ev_order = np.full(h, ORDER_MAX, np.int64)
+            ev_kind = np.zeros(h, np.int32)
+            ev_payload = np.zeros((h, EVENT_PAYLOAD_WORDS), np.int32)
+            active = np.zeros(h, bool)
+            for i in range(h):
+                if heaps[i] and heaps[i][0][0] < window_end:
+                    t, order, k, pl = heapq.heappop(heaps[i])
+                    ev_t[i], ev_order[i], ev_kind[i] = t, order, k
+                    ev_payload[i] = pl
+                    active[i] = True
+            if not active.any():
+                break
+            steps += 1
+
+            is_pkt = (ev_kind & KIND_PKT) != 0
+            needs_ingress = active & is_pkt & ((ev_kind & KIND_INGRESS_DONE) == 0)
+            dispatch = active.copy()
+            for i in np.nonzero(active)[0]:
+                st["events"][i] += 1
+                x = (int(ev_t[i]) * _MIX1) & _U64
+                x ^= (int(ev_kind[i]) * _MIX2) & _U64
+                x ^= int(ev_order[i])
+                digests[i] = ((digests[i] ^ x) * _FNV_PRIME) & _U64
+                if needs_ingress[i]:
+                    t = int(ev_t[i])
+                    size_bits = int(ev_payload[i, PAYLOAD_SIZE_WORD]) * 8
+                    sojourn = ing[i].probe(t, size_bits) - t
+                    drop = codel[i].on_packet(t, sojourn) if cfg.use_codel else False
+                    if drop:
+                        st["pkts_codel_dropped"][i] += 1
+                        dispatch[i] = False
+                        continue
+                    depart = ing[i].charge(t, size_bits)
+                    if depart > t:  # delayed: requeue past shaping, same order
+                        qpush(
+                            i,
+                            depart,
+                            int(ev_order[i]),
+                            int(ev_kind[i]) | KIND_INGRESS_DONE,
+                            ev_payload[i].copy(),
+                        )
+                        dispatch[i] = False
+            st["pkts_delivered"] += dispatch & is_pkt
+
+            # ---- model dispatch: the SAME vectorized handler as the device
+            ctx = HandlerCtx(
+                t=jnp.asarray(ev_t),
+                window_end=jnp.asarray(window_end, jnp.int64),
+                kind=jnp.asarray(ev_kind & KIND_MASK),
+                payload=jnp.asarray(ev_payload),
+                active=jnp.asarray(dispatch),
+                is_packet=jnp.asarray(is_pkt),
+                src=unpack_order_src(jnp.asarray(ev_order)),
+                host_id=jnp.arange(h, dtype=jnp.int64),
+                state=mstate_dev,
+                params=mparams_dev,
+                rng=rng,
+            )
+            out = model.handle(ctx)
+            rng, mstate_dev = out.rng, out.state
+
+            for p in out.pushes:
+                mask = np.asarray(p.mask) & dispatch
+                t_req = np.asarray(p.t, np.int64)
+                kind = np.asarray(p.kind, np.int32)
+                payload = np.asarray(p.payload, np.int32)
+                for i in np.nonzero(mask)[0]:
+                    if t_req[i] < ev_t[i]:
+                        st["monotonic_violations"][i] += 1
+                    qpush(
+                        i,
+                        int(max(t_req[i], ev_t[i])),
+                        _pack(1, i, seq[i]),
+                        int(kind[i]) & KIND_MASK,
+                        payload[i].copy(),
+                    )
+                    seq[i] += 1
+
+            for s in out.sends:
+                mask = np.asarray(s.mask) & dispatch
+                rng, u_arr = rng_uniform(rng, jnp.asarray(mask))
+                u = np.asarray(u_arr)
+                dst_arr = np.asarray(s.dst, np.int64)
+                sz_arr = np.asarray(s.size_bytes, np.int32)
+                kind = np.asarray(s.kind, np.int32)
+                payload = np.asarray(s.payload, np.int32)
+                for i in np.nonzero(mask)[0]:
+                    st["pkts_sent"][i] += 1
+                    order = _pack(0, i, seq[i])
+                    seq[i] += 1
+                    over_budget = sent_round[i] >= cfg.sends_per_host_round
+                    t = int(ev_t[i])
+                    size_bits = int(sz_arr[i]) * 8
+                    if not over_budget:
+                        eg_depart = eg[i].charge(t, size_bits)
+                    dst = int(dst_arr[i])
+                    bad = dst < 0 or dst >= h
+                    lat = int(lat_ns[node_of[i], node_of[min(max(dst, 0), h - 1)]])
+                    lossp = float(loss[node_of[i], node_of[min(max(dst, 0), h - 1)]])
+                    if lat < 0 or bad:
+                        st["pkts_unreachable"][i] += 1
+                        continue
+                    if u[i] < lossp and t >= cfg.bootstrap_end_time:
+                        st["pkts_lost"][i] += 1
+                        continue
+                    if over_budget:
+                        st["pkts_budget_dropped"][i] += 1
+                        continue
+                    sent_round[i] += 1
+                    min_used_lat = min(min_used_lat, lat)
+                    pl = payload[i].copy()
+                    pl[PAYLOAD_SIZE_WORD] = sz_arr[i]
+                    arrive = max(eg_depart + max(lat, 0), window_end)
+                    staged.append((dst, arrive, order, int(kind[i]) | KIND_PKT, pl))
+
+        microsteps += steps
+        rounds += 1
+        # ---- exchange: sorted (dst, t, order) insert, capacity + r_cap bounded
+        staged.sort(key=lambda e: (e[0], e[1], e[2]))
+        inserted_for: dict[int, int] = {}
+        for dst, t, order, kind, pl in staged:
+            n_in = inserted_for.get(dst, 0)
+            if n_in >= r_cap or len(heaps[dst]) >= cfg.queue_capacity:
+                st["dropped"][dst] += 1
+                continue
+            heapq.heappush(heaps[dst], (t, order, kind, pl))
+            inserted_for[dst] = n_in + 1
+        now = window_end
+
+    return GoldenResult(
+        digests=np.array(digests, np.uint64),
+        stats={k: v.copy() for k, v in st.items()},
+        model_state=jax.device_get(mstate_dev),
+        now=now,
+        rounds=rounds,
+        microsteps=microsteps,
+    )

@@ -12,7 +12,7 @@ from shadow_tpu.models import get_model
 from shadow_tpu.net import TBParams
 
 
-def run_sim(
+def build_sim(
     model_name: str,
     hosts: list[dict],
     stop: int,
@@ -26,6 +26,9 @@ def run_sim(
     runahead_floor: int = 1_000_000,
     use_codel: bool = True,
 ):
+    """(cfg, model, params, model_state, initial_events) — shared between the
+    device engine runner and the golden reference runner so both see byte-
+    identical inputs."""
     h = len(hosts)
     cfg = EngineConfig(
         num_hosts=h,
@@ -54,6 +57,29 @@ def run_sim(
             refill=jnp.full((h,), bw_bits // 1000, jnp.int64),
         ),
         model=mparams,
+    )
+    return cfg, model, params, mstate, events
+
+
+def run_golden_sim(model_name: str, hosts: list[dict], stop: int, seed: int = 1, **kw):
+    from shadow_tpu.core.golden import run_golden
+
+    cfg, model, params, mstate, events = build_sim(
+        model_name, hosts, stop, world=1, seed=seed, **kw
+    )
+    return run_golden(cfg, model, params, mstate, events, seed=seed)
+
+
+def run_sim(
+    model_name: str,
+    hosts: list[dict],
+    stop: int,
+    world: int = 1,
+    seed: int = 1,
+    **kw,
+):
+    cfg, model, params, mstate, events = build_sim(
+        model_name, hosts, stop, world=world, seed=seed, **kw
     )
     mesh = None
     if world > 1:

@@ -1,0 +1,89 @@
+"""Dual-target gate: device engine vs the independent golden CPU engine.
+
+The analogue of the reference's Linux-vs-Shadow dual test registration
+(src/test/CMakeLists.txt add_linux_tests/add_shadow_tests) and its
+two-scheduler determinism diff (src/test/determinism, 2a/2b vs 2c): the same
+workload runs through two unrelated engine implementations and every per-host
+digest and counter must agree bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+from tests.engine_harness import mk_hosts, run_golden_sim, run_sim
+
+STOP = 400_000_000  # golden is pure Python: keep sims short
+
+
+def _compare(model, hosts, stop=STOP, **kw):
+    state, stats, _ = run_sim(model, hosts, stop, world=1, **kw)
+    gold = run_golden_sim(model, hosts, stop, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(stats.digest), gold.digests, err_msg="digest mismatch"
+    )
+    for dev, g in [
+        (stats.events, "events"),
+        (stats.pkts_sent, "pkts_sent"),
+        (stats.pkts_lost, "pkts_lost"),
+        (stats.pkts_delivered, "pkts_delivered"),
+        (stats.pkts_codel_dropped, "pkts_codel_dropped"),
+        (stats.pkts_budget_dropped, "pkts_budget_dropped"),
+        (stats.monotonic_violations, "monotonic_violations"),
+    ]:
+        np.testing.assert_array_equal(np.asarray(dev), gold.stats[g], err_msg=g)
+    np.testing.assert_array_equal(
+        np.asarray(state.queue.dropped), gold.stats["dropped"], err_msg="dropped"
+    )
+    assert int(stats.rounds) == gold.rounds
+    return gold
+
+
+def test_timer_matches():
+    _compare("timer", mk_hosts(6, {"interval": "7 ms"}))
+
+
+def test_phold_matches():
+    # float path (exponential holding delay) + random peers + loss draws
+    _compare("phold", mk_hosts(10, {"mean_delay": "20 ms", "population": 2}), loss=0.1)
+
+
+def test_echo_under_shaping_matches():
+    # token buckets on both directions + CoDel + loss: the full ingress/egress
+    # pipeline arithmetic must agree scalar-vs-vectorized
+    hosts = [
+        dict(host_id=0, name="server", start_time=0, model_args={"role": "server"}),
+        *(
+            dict(
+                host_id=i,
+                name=f"c{i}",
+                start_time=0,
+                model_args={
+                    "role": "client",
+                    "peer": "server",
+                    "interval": "4 ms",
+                    "size_bytes": 2000,
+                },
+            )
+            for i in range(1, 6)
+        ),
+    ]
+    gold = _compare("udp_echo", hosts, bw_bits=2_000_000, loss=0.05, use_codel=True)
+    assert gold.stats["pkts_codel_dropped"].sum() > 0 or gold.stats["pkts_lost"].sum() > 0
+
+
+def test_gossip_budget_matches():
+    # send-budget drops + queue-capacity overflow paths
+    hosts = mk_hosts(12, {"fanout": 6})
+    hosts[0]["model_args"]["publisher"] = True
+    gold = _compare(
+        "gossip", hosts, sends_budget=4, runahead_floor=50_000_000, qcap=16
+    )
+    assert gold.stats["pkts_budget_dropped"].sum() > 0
+
+
+def test_golden_vs_multishard():
+    """Transitivity spot check: golden == device(world=4) directly."""
+    hosts = mk_hosts(8, {"mean_delay": "20 ms", "population": 1})
+    _, stats, _ = run_sim("phold", hosts, STOP, world=4, loss=0.1)
+    gold = run_golden_sim("phold", hosts, STOP, loss=0.1)
+    np.testing.assert_array_equal(np.asarray(stats.digest), gold.digests)
