@@ -1,0 +1,126 @@
+"""Test harness: two TcpState endpoints over a simulated wire.
+
+The reference's TCP crate tests drive `TcpState` pairs through mock
+`Dependencies` (src/lib/tcp/src/tests/); this harness is the same idea in
+simulated nanoseconds: an event list of in-flight segments with per-direction
+latency, optional deterministic drop/reorder hooks, and timer servicing.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable
+
+from shadow_tpu.tcp import Segment, TcpState
+
+MS = 1_000_000
+
+
+class Wire:
+    def __init__(
+        self,
+        a: TcpState,
+        b: TcpState,
+        latency_ns: int = 10 * MS,
+        drop: Callable[[int, str, Segment], bool] | None = None,
+    ):
+        self.ends = {"a": a, "b": b}
+        self.latency = latency_ns
+        self.drop = drop or (lambda i, d, s: False)
+        self.now = 0
+        self._q: list[tuple[int, int, str, Segment]] = []  # (t, uid, dst, seg)
+        self._uid = 0
+        self.sent: list[tuple[int, str, Segment]] = []  # full trace
+
+    def _pump_output(self):
+        for name, tcp in self.ends.items():
+            dst = "b" if name == "a" else "a"
+            for seg in tcp.poll_segments(self.now):
+                idx = len(self.sent)
+                self.sent.append((self.now, name, seg))
+                if not self.drop(idx, name, seg):
+                    self._uid += 1
+                    heapq.heappush(
+                        self._q, (self.now + self.latency, self._uid, dst, seg)
+                    )
+
+    def _next_time(self) -> int | None:
+        cands = [self._q[0][0]] if self._q else []
+        for tcp in self.ends.values():
+            t = tcp.next_timer()
+            if t is not None:
+                cands.append(t)
+        return min(cands) if cands else None
+
+    def step(self) -> bool:
+        """Advance to the next event; False when idle."""
+        self._pump_output()
+        t = self._next_time()
+        if t is None:
+            return False
+        self.now = max(self.now, t)
+        while self._q and self._q[0][0] <= self.now:
+            _, _, dst, seg = heapq.heappop(self._q)
+            self.ends[dst].on_segment(self.now, seg)
+        for tcp in self.ends.values():
+            tt = tcp.next_timer()
+            if tt is not None and tt <= self.now:
+                tcp.on_timer(self.now)
+        self._pump_output()
+        return True
+
+    def run(self, max_steps: int = 10_000, until: Callable[[], bool] | None = None):
+        for _ in range(max_steps):
+            if until is not None and until():
+                return
+            if not self.step():
+                if until is None or until():
+                    return
+        raise AssertionError(
+            f"wire did not settle in {max_steps} steps (now={self.now})"
+        )
+
+
+def handshake(latency_ns: int = 10 * MS, **kw) -> tuple[TcpState, TcpState, Wire]:
+    """Returns (client, server, wire) in ESTABLISHED."""
+    from shadow_tpu.tcp import State, TcpConfig
+
+    cfg = kw.pop("cfg", TcpConfig())
+    client = TcpState(cfg, iss=1000)
+    # server-side listener forks the actual connection on SYN
+    listener = TcpState(cfg, iss=0)
+    listener.listen()
+    server_box: list[TcpState] = []
+
+    client.connect(0)
+    syn = client.poll_segments(0)[0]
+    child = listener.accept_segment(latency_ns, syn, child_iss=5000)
+    assert child is not None
+    server_box.append(child)
+    server = server_box[0]
+    wire = Wire(client, server, latency_ns, **kw)
+    wire.now = latency_ns
+    wire.run(until=lambda: client.state == State.ESTABLISHED
+             and server.state == State.ESTABLISHED)
+    return client, server, wire
+
+
+def transfer(src: TcpState, dst: TcpState, wire: Wire, data: bytes,
+             max_steps: int = 50_000) -> bytes:
+    """Send `data` src->dst until fully delivered; returns received bytes."""
+    got = bytearray()
+    sent = 0
+
+    def pump() -> bool:
+        nonlocal sent, got
+        if sent < len(data):
+            sent += src.send(data[sent : sent + 65536])
+        while True:
+            chunk = dst.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        return len(got) == len(data)
+
+    wire.run(max_steps, until=pump)
+    return bytes(got)
