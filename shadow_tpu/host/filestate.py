@@ -1,0 +1,90 @@
+"""File status bits, listeners, and the deferred callback queue.
+
+Reference: `FileState` bitflags (`host/descriptor/mod.rs:111-140`),
+`StatusListener` (`host/descriptor/listener.rs`), and `CallbackQueue`
+(`utility/callback_queue.rs`) — the mechanism that breaks borrow cycles by
+deferring "state changed" notifications until the triggering operation has
+fully unwound. Here the queue plays the same role for Python re-entrancy:
+listener callbacks never run inside the mutation that caused them.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+
+class FileState(enum.IntFlag):
+    NONE = 0
+    ACTIVE = 1 << 0  # open and usable
+    READABLE = 1 << 1
+    WRITABLE = 1 << 2
+    CLOSED = 1 << 3
+    ERROR = 1 << 4
+    HUP = 1 << 5  # peer closed (EPOLLHUP analogue)
+    # listen sockets: a connection is ready to accept (maps to READABLE in
+    # poll semantics, kept distinct for introspection like the reference's
+    # socket-specific bits)
+    ACCEPTABLE = 1 << 6
+    CHILD_EVENT = 1 << 7  # process exit notification (pidfd-style)
+
+
+class StatusListener:
+    """Watches a file for transitions of selected state bits.
+
+    `notify(state, changed)` fires when any watched bit changes (or, for
+    level-listeners, is set). Identity-hashable so files can deregister."""
+
+    def __init__(
+        self,
+        interest: FileState,
+        callback: Callable[[FileState, FileState], None],
+        *,
+        level: bool = False,
+    ):
+        self.interest = interest
+        self.callback = callback
+        self.level = level  # fire on "set" even without a transition
+
+    def wants(self, state: FileState, changed: FileState) -> bool:
+        if self.level:
+            return bool(state & self.interest)
+        return bool(changed & self.interest)
+
+
+class CallbackQueue:
+    """Deferred-callback runner. Mutations enqueue listener notifications;
+    the outermost caller drains. `CallbackQueue.run(fn)` is the reference's
+    `CallbackQueue::queue_and_run` entry point."""
+
+    _active: "CallbackQueue | None" = None
+
+    def __init__(self):
+        self._q: list[Callable[[], None]] = []
+
+    def push(self, cb: Callable[[], None]):
+        self._q.append(cb)
+
+    def drain(self):
+        while self._q:
+            self._q.pop(0)()
+
+    @classmethod
+    def current(cls) -> "CallbackQueue | None":
+        return cls._active
+
+    @classmethod
+    def run(cls, fn: Callable[["CallbackQueue"], object]):
+        """Run fn with an active queue, draining afterwards. Nested calls
+        reuse the outer queue (callbacks still run only at the outermost
+        unwind, preserving no-reentrancy)."""
+        if cls._active is not None:
+            return fn(cls._active)
+        q = cls()
+        cls._active = q
+        try:
+            out = fn(q)
+            q.drain()
+            return out
+        finally:
+            cls._active = None

@@ -1,0 +1,151 @@
+"""CpuHost: the emulated machine and its event loop.
+
+Reference: `host/host.rs` (1452 LoC) — per-host event queue, deterministic
+per-host RNG, boot/shutdown, `execute(until)` popping events in
+deterministic order, and packet ingress/egress hooks. This host runs
+coroutine processes (`shadow_tpu.host.process`) instead of co-opted Linux
+binaries; the C++ managed-process plane (`native/`) plugs real binaries
+into the same structure.
+
+Egress: `send_packet` hands loopback traffic straight back to this host
+(scheduled, never re-entrant) and everything else to `self.egress`, wired
+by the CPU wire (`host.network.CpuNetwork`) or the device bridge
+(`shadow_tpu.cosim`).
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass, field
+from typing import Callable
+
+from shadow_tpu.host.filestate import CallbackQueue
+from shadow_tpu.host.netns import NetworkNamespace
+from shadow_tpu.host.process import Process, SyscallHandler
+from shadow_tpu.host.sockets import NetPacket
+
+TIME_MAX = (1 << 63) - 1
+
+
+@dataclass
+class HostConfig:
+    name: str
+    ip: str
+    seed: int = 0
+    host_id: int = 0
+    loopback_latency_ns: int = 0  # loopback relays same-round in reference
+
+
+class CpuHost:
+    def __init__(self, cfg: HostConfig):
+        self.cfg = cfg
+        self.name = cfg.name
+        self.ip = cfg.ip
+        self.host_id = cfg.host_id
+        self._now = 0
+        self._seq = 0  # deterministic tiebreak (host.rs event ids)
+        self._q: list[tuple[int, int, Callable]] = []
+        self._cancelled: set[int] = set()
+        self.rng = random.Random((cfg.seed << 16) ^ cfg.host_id)
+        self.netns = NetworkNamespace(self, cfg.ip)
+        self.syscalls = SyscallHandler(self)
+        self.processes: dict[int, Process] = {}
+        self._next_pid = 1000
+        # wired by the network layer: fn(host, NetPacket)
+        self.egress: Callable[["CpuHost", NetPacket], None] | None = None
+        # name -> ip resolution (DNS); wired by the simulation driver
+        self.resolver: Callable[[str], str] | None = None
+        # counters (tracker.c analogue)
+        self.counters = {
+            "events": 0,
+            "pkts_sent": 0,
+            "pkts_recv": 0,
+            "bytes_sent": 0,
+            "bytes_recv": 0,
+            "syscalls": 0,
+        }
+
+    # ---- clock & scheduling (TimerFd Scheduler protocol) -------------------
+
+    def now(self) -> int:
+        return self._now
+
+    def schedule(self, t_ns: int, fn: Callable) -> object:
+        if t_ns < self._now:
+            t_ns = self._now
+        self._seq += 1
+        token = (t_ns, self._seq)
+        heapq.heappush(self._q, (t_ns, self._seq, fn))
+        return token
+
+    def cancel(self, token: object):
+        self._cancelled.add(token[1])
+
+    def next_event_time(self) -> int:
+        while self._q and self._q[0][1] in self._cancelled:
+            self._cancelled.discard(self._q[0][1])
+            heapq.heappop(self._q)
+        return self._q[0][0] if self._q else TIME_MAX
+
+    # ---- processes ---------------------------------------------------------
+
+    def spawn(self, program, name: str | None = None, args: dict | None = None,
+              start_time: int = 0) -> Process:
+        self._next_pid += 1
+        proc = Process(self, self._next_pid, name or program.__name__, program, args)
+        self.processes[proc.pid] = proc
+        self.schedule(max(start_time, self._now), proc.resume)
+        return proc
+
+    def on_process_exit(self, proc: Process):
+        pass  # hook for the simulation driver (expected_final_state checks)
+
+    def resolve(self, name: str) -> str:
+        if self.resolver is None:
+            raise OSError(f"EAI_NONAME: no resolver for {name!r}")
+        return self.resolver(name)
+
+    def next_iss(self) -> int:
+        return self.rng.getrandbits(32)
+
+    # ---- packets -----------------------------------------------------------
+
+    def send_packet(self, pkt: NetPacket):
+        self.counters["pkts_sent"] += 1
+        self.counters["bytes_sent"] += pkt.size_bytes
+        if pkt.dst_ip in ("127.0.0.1", self.ip):
+            self.schedule(
+                self._now + self.cfg.loopback_latency_ns,
+                lambda: self.deliver_packet(pkt),
+            )
+            return
+        if self.egress is None:
+            raise RuntimeError(f"host {self.name}: no egress wired for {pkt}")
+        self.egress(self, pkt)
+
+    def deliver_packet(self, pkt: NetPacket):
+        self.counters["pkts_recv"] += 1
+        self.counters["bytes_recv"] += pkt.size_bytes
+        CallbackQueue.run(lambda q: self.netns.deliver(pkt))
+
+    # ---- the event loop ----------------------------------------------------
+
+    def execute(self, until_ns: int):
+        """Run all events with t < until_ns (Host::execute, host.rs:809)."""
+        while True:
+            t = self.next_event_time()
+            if t >= until_ns:
+                break
+            _, seq, fn = heapq.heappop(self._q)
+            if seq in self._cancelled:
+                self._cancelled.discard(seq)
+                continue
+            self._now = t
+            self.counters["events"] += 1
+            fn()
+        self._now = max(self._now, min(until_ns, TIME_MAX))
+
+    def shutdown(self):
+        for proc in list(self.processes.values()):
+            proc.kill()

@@ -1,0 +1,105 @@
+"""Per-host network namespace: interfaces, port association, packet demux.
+
+Reference: `host/network/namespace.rs` (399 LoC — localhost + eth0 and the
+AssociatedPorts registry) and the socket demux inside
+`network_interface.c` (find socket by (proto, local port, peer)). Flows
+(connected TCP 4-tuples) take precedence over wildcard port bindings
+(listeners / unconnected UDP), like the reference's most-specific-match.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.host.sockets import (
+    NetPacket,
+    PROTO_TCP,
+    TcpListenerSocket,
+    UdpSocket,
+)
+from shadow_tpu.tcp.state import rst_for
+
+EPHEMERAL_START = 49152
+EPHEMERAL_END = 65535
+
+
+class NetworkNamespace:
+    def __init__(self, host, ip: str):
+        self.host = host
+        self.default_ip = ip
+        # (proto, local_port) -> socket  [listeners + UDP binds]
+        self._ports: dict[tuple[int, int], object] = {}
+        # (proto, local_port, peer_ip, peer_port) -> TcpSocket [flows]
+        self._flows: dict[tuple[int, int, str, int], object] = {}
+        self._next_ephemeral = EPHEMERAL_START
+
+    # ---- binding -----------------------------------------------------------
+
+    def bind(self, sock, ip: str, port: int):
+        if port == 0:
+            port = self._alloc_ephemeral(sock.PROTO)
+        key = (sock.PROTO, port)
+        if key in self._ports:
+            raise OSError(f"EADDRINUSE: port {port}")
+        self._ports[key] = sock
+        sock.local_ip = ip if ip not in ("0.0.0.0", "") else self.default_ip
+        sock.local_port = port
+
+    def _alloc_ephemeral(self, proto: int) -> int:
+        for _ in range(EPHEMERAL_END - EPHEMERAL_START + 1):
+            p = self._next_ephemeral
+            self._next_ephemeral += 1
+            if self._next_ephemeral > EPHEMERAL_END:
+                self._next_ephemeral = EPHEMERAL_START
+            if (proto, p) not in self._ports:
+                return p
+        raise OSError("EADDRNOTAVAIL: ephemeral ports exhausted")
+
+    def register_flow(self, sock):
+        """Track a connected TCP socket by its 4-tuple."""
+        key = (PROTO_TCP, sock.local_port, sock.peer_ip, sock.peer_port)
+        self._flows[key] = sock
+
+    def unbind(self, sock):
+        if sock.local_port is not None:
+            key = (sock.PROTO, sock.local_port)
+            if self._ports.get(key) is sock:
+                del self._ports[key]
+        if getattr(sock, "peer_ip", None) is not None:
+            fkey = (PROTO_TCP, sock.local_port, sock.peer_ip, sock.peer_port)
+            if self._flows.get(fkey) is sock:
+                del self._flows[fkey]
+
+    # ---- demux -------------------------------------------------------------
+
+    def deliver(self, pkt: NetPacket):
+        """Incoming packet -> most specific matching socket."""
+        if pkt.proto == PROTO_TCP:
+            flow = self._flows.get(
+                (PROTO_TCP, pkt.dst_port, pkt.src_ip, pkt.src_port)
+            )
+            if flow is not None:
+                flow.deliver(pkt)
+                return
+        sock = self._ports.get((pkt.proto, pkt.dst_port))
+        if sock is not None:
+            sock.deliver(pkt)
+            return
+        # no receiver: TCP answers RST (reference closed-port behavior),
+        # UDP drops (ICMP unreachable is out of scope, as in the reference)
+        if pkt.proto == PROTO_TCP and pkt.seg is not None:
+            rst = rst_for(pkt.seg)
+            if rst is not None:
+                self.host.send_packet(
+                    NetPacket(
+                        src_ip=pkt.dst_ip,
+                        src_port=pkt.dst_port,
+                        dst_ip=pkt.src_ip,
+                        dst_port=pkt.src_port,
+                        proto=PROTO_TCP,
+                        seg=rst,
+                    )
+                )
+
+    # ---- stats -------------------------------------------------------------
+
+    def socket_count(self) -> int:
+        return len(self._ports) + len(self._flows)

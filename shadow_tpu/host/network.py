@@ -1,0 +1,78 @@
+"""The CPU wire: latency/loss fabric + conservative round loop for CpuHosts.
+
+Reference: this is the host-plane counterpart of `Worker::send_packet`
+(worker.rs:330-425 — latency lookup, loss draw from the *source* host RNG,
+cross-host event push) plus the Manager round loop (manager.rs:392-478) in
+miniature. The device engine implements the same contract on TPU; this
+fabric exists so emulated hosts can also run self-contained (and as the
+oracle for dual-target tests, SURVEY.md §4.8).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from shadow_tpu.host.host import CpuHost, TIME_MAX
+from shadow_tpu.host.sockets import NetPacket
+
+
+class CpuNetwork:
+    def __init__(
+        self,
+        hosts: list[CpuHost],
+        latency_ns: Callable[[int, int], int],
+        loss: Callable[[int, int], float] | None = None,
+        names: dict[str, str] | None = None,
+    ):
+        self.hosts = hosts
+        self.by_ip = {h.ip: h for h in hosts}
+        self.latency_ns = latency_ns
+        self.loss = loss or (lambda s, d: 0.0)
+        self.min_latency = (
+            min(
+                latency_ns(a.host_id, b.host_id)
+                for a in hosts
+                for b in hosts
+                if a is not b
+            )
+            if len(hosts) > 1
+            else 1_000_000
+        )
+        names = names or {h.name: h.ip for h in hosts}
+        for h in hosts:
+            h.egress = self._egress
+            h.resolver = names.get
+        self.pkts_dropped = 0
+        self.pkts_relayed = 0
+
+    def _egress(self, src: CpuHost, pkt: NetPacket):
+        dst = self.by_ip.get(pkt.dst_ip)
+        if dst is None:
+            return  # unreachable: dropped (reference counts + drops too)
+        lat = self.latency_ns(src.host_id, dst.host_id)
+        p = self.loss(src.host_id, dst.host_id)
+        # loss drawn from the source host's RNG (worker.rs:374-390)
+        if p > 0.0 and src.rng.random() < p:
+            self.pkts_dropped += 1
+            return
+        self.pkts_relayed += 1
+        dst.schedule(src.now() + lat, lambda: dst.deliver_packet(pkt))
+
+    # ---- conservative round loop ------------------------------------------
+
+    def run(self, stop_ns: int, *, runahead_ns: int | None = None) -> int:
+        """Advance all hosts to stop_ns in lookahead-bounded rounds.
+        Returns the number of rounds executed."""
+        runahead = max(runahead_ns or self.min_latency, 1)
+        rounds = 0
+        while True:
+            nxt = min(h.next_event_time() for h in self.hosts)
+            if nxt >= stop_ns:
+                break
+            window_end = min(nxt + runahead, stop_ns)
+            for h in self.hosts:  # deterministic host order
+                h.execute(window_end)
+            rounds += 1
+        for h in self.hosts:
+            h.execute(stop_ns)
+        return rounds

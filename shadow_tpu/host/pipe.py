@@ -1,0 +1,116 @@
+"""Pipes over a shared byte buffer.
+
+Reference: `host/descriptor/pipe.rs` (475 LoC) on top of
+`shared_buf.rs` — reader and writer ends share one bounded buffer; state
+bits flip as it fills/drains; closing the peer end raises HUP/EPIPE.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.host.descriptor import File
+from shadow_tpu.host.filestate import FileState
+
+PIPE_BUF_SIZE = 65536  # Linux default pipe capacity
+
+
+class _SharedBuf:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.data = bytearray()
+        self.readers = 0
+        self.writers = 0
+
+    def space(self) -> int:
+        return self.capacity - len(self.data)
+
+
+class PipeEnd(File):
+    def __init__(self, buf: _SharedBuf, writable: bool):
+        super().__init__()
+        self.buf = buf
+        self.is_writer = writable
+        self.peer: "PipeEnd | None" = None
+        if writable:
+            buf.writers += 1
+            self._set_state(on=FileState.WRITABLE)
+        else:
+            buf.readers += 1
+
+    def _sync(self):
+        """Recompute state bits from buffer + peer liveness."""
+        if self.closed:
+            return
+        if self.is_writer:
+            if self.buf.readers == 0:
+                self._set_state(on=FileState.ERROR | FileState.HUP, off=FileState.WRITABLE)
+            elif self.buf.space() > 0:
+                self._set_state(on=FileState.WRITABLE)
+            else:
+                self._set_state(off=FileState.WRITABLE)
+        else:
+            readable = len(self.buf.data) > 0
+            hup = self.buf.writers == 0
+            on = FileState.NONE
+            off = FileState.NONE
+            if readable:
+                on |= FileState.READABLE
+            else:
+                off |= FileState.READABLE
+            if hup:
+                on |= FileState.HUP
+                if not readable:
+                    on |= FileState.READABLE  # EOF is readable (read -> b"")
+            self._set_state(on=on, off=off)
+
+    def read(self, n: int) -> bytes | None:
+        if self.is_writer:
+            raise OSError("EBADF: read on write end")
+        if self.buf.data:
+            out = bytes(self.buf.data[:n])
+            del self.buf.data[: len(out)]
+            self._sync()
+            if self.peer is not None:
+                self.peer._sync()
+            return out
+        if self.buf.writers == 0:
+            return b""  # EOF
+        return None  # would block
+
+    def write(self, data: bytes) -> int | None:
+        if not self.is_writer:
+            raise OSError("EBADF: write on read end")
+        if self.buf.readers == 0:
+            raise BrokenPipeError("EPIPE: no readers")  # + SIGPIPE in reference
+        space = self.buf.space()
+        if space == 0:
+            return None  # would block
+        took = bytes(data[:space])
+        self.buf.data += took
+        self._sync()
+        if self.peer is not None:
+            self.peer._sync()
+        return len(took)
+
+    def close(self):
+        if self.closed:
+            return
+        if self.is_writer:
+            self.buf.writers -= 1
+        else:
+            self.buf.readers -= 1
+        super().close()
+        if self.peer is not None:
+            self.peer._sync()
+
+
+Pipe = PipeEnd  # exported name
+
+
+def create_pipe(capacity: int = PIPE_BUF_SIZE) -> tuple[PipeEnd, PipeEnd]:
+    """Returns (read_end, write_end) like pipe(2)."""
+    buf = _SharedBuf(capacity)
+    r = PipeEnd(buf, writable=False)
+    w = PipeEnd(buf, writable=True)
+    r.peer = w
+    w.peer = r
+    return r, w

@@ -368,6 +368,7 @@ class TcpState:
             State.FIN_WAIT_2,
         ):
             before = self.rcv_nxt
+            had_fin_pending = self.rcv_buf.fin_seq is not None
             self.rcv_nxt = self.rcv_buf.insert(self.rcv_nxt, seg.seq, seg.payload)
             self._pending_ack = True
             if self.rcv_nxt == before and seg.payload:
@@ -375,30 +376,41 @@ class TcpState:
                 # dup-ACK so the peer's fast-retransmit counter sees every
                 # arrival even when the wire delivers a whole batch at once
                 self._dup_ack_owed += 1
+            if had_fin_pending and self.rcv_buf.fin_seq is None:
+                # this insert filled the hole before an out-of-order FIN:
+                # the buffer consumed it, so run the FIN transitions now
+                self._on_fin_reached(now)
 
-        # --- FIN
+        # --- FIN (a fully-old retransmitted FIN never reaches here: the
+        # acceptability check above already rejected it with an ACK)
         if seg.flags & FIN and not self.rcv_fin_seen:
             fin_seq = wrapping_add(seg.seq, len(seg.payload))
             self.rcv_buf.fin_seq = fin_seq
             self.rcv_nxt = self.rcv_buf.insert(self.rcv_nxt, fin_seq, b"")
             if self.rcv_buf.fin_seq is None:  # FIN consumed in order
-                self.rcv_fin_seen = True
-                self._pending_ack = True
-                if self.state == State.ESTABLISHED:
-                    self.state = State.CLOSE_WAIT
-                elif self.state == State.FIN_WAIT_1:
-                    # our FIN acked already handled above; else simultaneous
-                    self.state = (
-                        State.TIME_WAIT if self.fin_acked else State.CLOSING
-                    )
-                    if self.fin_acked:
-                        self._enter_time_wait(now)
-                elif self.state == State.FIN_WAIT_2:
-                    self._enter_time_wait(now)
-                elif self.state == State.TIME_WAIT:
-                    self._enter_time_wait(now)  # restart 2MSL
+                self._on_fin_reached(now)
             else:
-                self._pending_ack = True
+                self._pending_ack = True  # out-of-order FIN: dup-ACK
+
+    def _on_fin_reached(self, now: int):
+        """RCV.NXT has passed the peer's FIN: EOF + state transitions."""
+        if self.rcv_fin_seen:
+            return
+        self.rcv_fin_seen = True
+        self._pending_ack = True
+        if self.state == State.ESTABLISHED:
+            self.state = State.CLOSE_WAIT
+        elif self.state == State.FIN_WAIT_1:
+            # if our own FIN is already acked this is a straight TIME_WAIT
+            # entry; otherwise simultaneous close -> CLOSING
+            if self.fin_acked:
+                self._enter_time_wait(now)
+            else:
+                self.state = State.CLOSING
+        elif self.state == State.FIN_WAIT_2:
+            self._enter_time_wait(now)
+        elif self.state == State.TIME_WAIT:
+            self._enter_time_wait(now)  # restart 2MSL
 
     # ------------------------------------------------------------- ack math
 

@@ -417,6 +417,28 @@ def test_lost_zero_window_probe_is_retransmitted():
     assert c.next_timer() is not None  # something will retry
 
 
+def test_fin_after_hole_filled_by_retransmission():
+    """A lost data segment followed by FIN: when the retransmission fills the
+    hole, the receiver must still see EOF and enter CLOSE_WAIT (review: the
+    buffer used to consume the FIN silently, acking it without ever setting
+    rcv_fin_seen — the receiver then hung in ESTABLISHED forever)."""
+    state = {"n": 0}
+
+    def drop(idx, src, seg):
+        # drop the first full-size data segment once, leaving a hole with
+        # more data and the FIN queued behind it
+        if src == "a" and seg.payload and len(seg.payload) > 500 and state["n"] == 0:
+            state["n"] = 1
+            return True
+        return False
+
+    c, s, w = handshake(drop=drop)
+    c.send(os.urandom(4000))
+    c.close(w.now)
+    w.run(200_000, until=lambda: s.rcv_fin_seen and c.fin_acked)
+    assert s.state == State.CLOSE_WAIT
+
+
 # -------------------------------------------------------------- digestion
 
 
