@@ -68,9 +68,9 @@ def main(argv: list[str] | None = None) -> int:
             cfg = merge_cli_overrides(cfg, overrides)
         if args.progress:
             cfg.general.progress = True
-        from shadow_tpu.sim import Simulation  # deferred: jax init is slow
+        from shadow_tpu.sim import build_simulation  # deferred: jax init is slow
 
-        sim = Simulation(cfg)
+        sim = build_simulation(cfg)
     except (ConfigError, OSError, yaml.YAMLError) as e:
         # Only the config-build phase maps to exit 2. GraphError subclasses
         # ConfigError; OSError covers missing/unreadable config + graph files
@@ -85,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 0
+
     report = sim.run()
     data_dir = sim.write_outputs(report=report)
     if args.print_stats:

@@ -451,12 +451,6 @@ def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EnginePa
 
 
 def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EngineParams):
-    h_local = st.queue.t.shape[0]
-    shard_start = (
-        lax.axis_index(axis).astype(jnp.int64) * h_local if axis else jnp.int64(0)
-    )
-    host_gid = shard_start + jnp.arange(h_local, dtype=jnp.int64)
-
     # ---- 1-2: barrier + window (controller.rs:88-112)
     lmin = jnp.min(next_time(st.queue))
     gmin = _pmin(lmin, axis)
@@ -468,6 +462,24 @@ def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EnginePara
         else jnp.asarray(max(cfg.runahead_floor, cfg.static_min_latency), jnp.int64)
     )
     window_end = jnp.minimum(gmin_safe + jnp.maximum(runahead, 1), cfg.stop_time)
+    return _window_step(cfg, model, axis, st, params, window_end, done)
+
+
+def _window_step(
+    cfg: EngineConfig, model, axis, st: SimState, params: EngineParams,
+    window_end, done,
+):
+    """Execute one scheduling window [*, window_end): microsteps + exchange.
+
+    Split out of `_round_step` so the co-simulation bridge
+    (`shadow_tpu.cosim`) can drive lockstep windows whose end is computed
+    jointly with the CPU host plane instead of from the device queues alone.
+    """
+    h_local = st.queue.t.shape[0]
+    shard_start = (
+        lax.axis_index(axis).astype(jnp.int64) * h_local if axis else jnp.int64(0)
+    )
+    host_gid = shard_start + jnp.arange(h_local, dtype=jnp.int64)
 
     # ---- 3: microsteps (no collectives inside — shards proceed independently)
     def micro_cond(carry):

@@ -1,0 +1,378 @@
+"""Co-simulation bridge: CPU-emulated hosts over the device network plane.
+
+The reference couples managed Linux processes to its simulated network
+through shared-memory syscall channels (SURVEY.md §3.3-3.4, §5.8). The TPU
+recast replaces that hop with per-window host↔device staging (SURVEY.md §7
+hard part 6):
+
+  every window [start, end):
+    1. joint barrier: t_next = min(CPU plane, device plane) next event time;
+       window_end = min(t_next + runahead, stop)  (controller.rs:88-112)
+    2. CPU hosts run their event loops to window_end; socket egress is
+       *staged* — (src, t, dst, size, key) — with the real bytes parked
+       host-side in a by-(src, key) store
+    3. one jitted `prepare` op: reset capture rings + merge the staged
+       send-requests into the device queues (sorted deterministic scatter)
+    4. one jitted `window` op: the engine's microstep loop + exchange — the
+       full egress pipeline (budget, token bucket, loss, latency, clamp)
+       applies to CPU-origin packets exactly as to modeled traffic
+    5. drain capture rings; map (src, key) back to bytes; schedule socket
+       delivery on each destination CPU host at the captured arrival time
+
+  Conservative lookahead makes this exact: every cross-host arrival lands
+  at >= window_end, so a packet staged in window N is always delivered into
+  window N+1 or later on both planes.
+
+Single-device for now (the CPU plane itself is one Python process); pure
+modeled simulations scale over the mesh via `shadow_tpu.sim`.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from shadow_tpu.core import engine as eng
+from shadow_tpu.core.engine import Engine, EngineParams
+from shadow_tpu.host import CpuHost, HostConfig
+from shadow_tpu.host.sockets import NetPacket, PROTO_TCP
+from shadow_tpu.models.hybrid import (
+    HybridModel,
+    KIND_SENDREQ,
+    PW_DST_OR_SRC,
+    PW_KEY,
+    PW_SIZE,
+)
+from shadow_tpu.ops import merge_flat_events, next_time, pack_order
+from shadow_tpu.programs import get_program
+from shadow_tpu.simtime import NS_PER_SEC, TIME_MAX
+from shadow_tpu import sim as simmod
+
+_BYTES_GC_WINDOWS = 1024  # sweep horizon for lost-packet payloads
+
+
+class HybridSimulation:
+    """Config-driven co-simulation (CLI-compatible with `Simulation`)."""
+
+    def __init__(self, cfg: ConfigOptions, *, staging_cap: int = 4096):
+        self.cfg = cfg
+        self.graph = simmod.load_graph(cfg.network.graph)
+        self.specs = simmod.expand_hosts_hybrid(cfg, self.graph)
+        if not self.specs:
+            raise ConfigError("config defines no hosts")
+        self.staging_cap = staging_cap
+        self.model = HybridModel()
+        ex = cfg.experimental
+        # emulated TCP bursts land many events per host per window; keep the
+        # per-host slab roomy (overflow is counted, never silent — see
+        # stats_report queue_overflow_dropped)
+        qcap = max(ex.event_queue_capacity, 256)
+        self.engine_cfg = eng.EngineConfig(
+            num_hosts=len(self.specs),
+            stop_time=cfg.general.stop_time,
+            bootstrap_end_time=cfg.general.bootstrap_end_time,
+            runahead_floor=ex.runahead,
+            static_min_latency=max(self.graph.min_latency_ns, 1),
+            use_dynamic_runahead=False,
+            use_codel=ex.use_codel,
+            queue_capacity=qcap,
+            sends_per_host_round=max(ex.sends_per_host_round, 32),
+            max_round_inserts=ex.max_round_inserts or qcap,
+            rounds_per_chunk=1,
+            microstep_limit=ex.microstep_limit,
+            world=1,
+        )
+        self.engine = Engine(self.engine_cfg, self.model, None)
+        self._build()
+
+    # ---- build -------------------------------------------------------------
+
+    def _build(self):
+        cfg, ecfg = self.cfg, self.engine_cfg
+        # device side (reuses the modeled-sim param construction)
+        node_of = np.zeros((ecfg.num_hosts,), np.int32)
+        bw_up = np.zeros((ecfg.num_hosts,), np.int64)
+        bw_down = np.zeros((ecfg.num_hosts,), np.int64)
+        for h in self.specs:
+            node_of[h.host_id] = h.node_index
+            bw_up[h.host_id] = h.bw_up_bits
+            bw_down[h.host_id] = h.bw_down_bits
+        mparams, mstate, _ = self.model.build(
+            [{"host_id": s.host_id} for s in self.specs], cfg.general.seed
+        )
+        params = EngineParams(
+            node_of=jnp.asarray(node_of),
+            lat_ns=jnp.asarray(self.graph.lat_ns),
+            loss=jnp.asarray(self.graph.loss),
+            eg_tb=simmod._tb_params(bw_up, ecfg.tb_interval_ns),
+            in_tb=simmod._tb_params(bw_down, ecfg.tb_interval_ns),
+            model=jax.tree.map(jnp.asarray, mparams),
+        )
+        self.state, self.params = self.engine.init_state(
+            params, jax.tree.map(jnp.asarray, mstate), [], seed=cfg.general.seed
+        )
+
+        # CPU side
+        self.hosts: list[CpuHost] = []
+        self.ip_to_gid: dict[str, int] = {}
+        names = {}
+        for s in self.specs:
+            names[s.name] = s.ip
+            self.ip_to_gid[s.ip] = s.host_id
+        for s in self.specs:
+            h = CpuHost(
+                HostConfig(
+                    name=s.name, ip=s.ip, seed=cfg.general.seed, host_id=s.host_id
+                )
+            )
+            h.egress = self._stage_send
+            h.resolver = names.get
+            self.hosts.append(h)
+        self.procs = []
+        for s, h in zip(self.specs, self.hosts):
+            for p in s.programs:
+                prog = get_program(p["path"])
+                args = dict(p.get("args") or {})
+                proc = h.spawn(
+                    prog, name=p["path"], args=args, start_time=p.get("start_time", 0)
+                )
+                proc.expected_final_state = p.get("expected_final_state", "running")
+                if p.get("shutdown_time") is not None:
+                    h.schedule(p["shutdown_time"], proc.kill)
+                self.procs.append(proc)
+
+        # staging + payload store
+        self._staged: list[tuple[int, int, int, int, int]] = []  # src,t,dst,size,key
+        self._send_seq = np.zeros((ecfg.num_hosts,), np.int64)
+        self._bytes: dict[tuple[int, int], tuple[int, NetPacket]] = {}
+        self._window_idx = 0
+        self._unreachable_ips = 0
+
+        # jitted ops
+        self._prepare = jax.jit(
+            functools.partial(_prepare_window, self.engine_cfg, self.model),
+            donate_argnums=0,
+        )
+        self._window = jax.jit(
+            functools.partial(eng._window_step, self.engine_cfg, self.model, None),
+            donate_argnums=0,
+        )
+
+    # ---- egress staging ----------------------------------------------------
+
+    def _stage_send(self, host: CpuHost, pkt: NetPacket):
+        dst_gid = self.ip_to_gid.get(pkt.dst_ip)
+        if dst_gid is None:
+            self._unreachable_ips += 1
+            return
+        gid = host.host_id
+        key = int(self._send_seq[gid] % (1 << 31))
+        self._send_seq[gid] += 1
+        self._bytes[(gid, key)] = (self._window_idx, pkt)
+        self._staged.append((gid, host.now(), dst_gid, pkt.size_bytes, key))
+
+    # ---- window loop -------------------------------------------------------
+
+    def _cpu_min_next(self) -> int:
+        return min(h.next_event_time() for h in self.hosts)
+
+    def run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
+        cfg = self.cfg
+        stop = cfg.general.stop_time
+        show_progress = cfg.general.progress if progress is None else progress
+        runahead = max(
+            self.engine_cfg.runahead_floor, self.engine_cfg.static_min_latency, 1
+        )
+        t0 = time.monotonic()
+        windows = 0
+        hb_ns = cfg.general.heartbeat_interval
+        next_hb = hb_ns or 0
+        while True:
+            dev_min = int(jnp.min(next_time(self.state.queue)))
+            t_next = min(self._cpu_min_next(), dev_min)
+            if self._staged:
+                # sends carried over a staging-cap overflow still need a window
+                t_next = min(t_next, min(e[1] for e in self._staged))
+            if t_next >= stop:
+                break
+            window_end = min(t_next + runahead, stop)
+            for h in self.hosts:  # deterministic host order
+                h.execute(window_end)
+            self.state = self._inject_and_run(window_end)
+            self._drain_captures()
+            windows += 1
+            if hb_ns and window_end >= next_hb:
+                wall = time.monotonic() - t0
+                print(
+                    f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
+                    f"wall={wall:.2f}s windows={windows} "
+                    f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x",
+                    file=log,
+                )
+                next_hb = (window_end // hb_ns + 1) * hb_ns
+            if show_progress:
+                pct = min(100.0, 100.0 * window_end / max(stop, 1))
+                print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
+            if self._window_idx % 256 == 0:
+                self._gc_bytes()
+        for h in self.hosts:
+            h.execute(stop)
+        if show_progress:
+            print(file=log)
+        self._wall_seconds = time.monotonic() - t0
+        self._windows = windows
+        return self.stats_report()
+
+    def _inject_and_run(self, window_end: int):
+        cap = self.staging_cap
+        staged = self._staged[:cap]
+        overflow = self._staged[cap:]
+        self._staged = overflow  # carried to next window (bounded staging)
+        n = cap
+        src = np.zeros((n,), np.int64)
+        t = np.full((n,), TIME_MAX, np.int64)
+        dstw = np.zeros((n,), np.int32)
+        order = np.zeros((n,), np.int64)
+        kind = np.zeros((n,), np.int32)
+        payload = np.zeros((n, 4), np.int32)
+        valid = np.zeros((n,), bool)
+        for i, (gid, t_ns, dst_gid, size, key) in enumerate(staged):
+            src[i] = gid
+            t[i] = t_ns
+            dstw[i] = gid  # send-request is a LOCAL event on the source host
+            order[i] = int(pack_order(1, gid, key))
+            kind[i] = KIND_SENDREQ
+            payload[i, PW_SIZE] = size
+            payload[i, PW_DST_OR_SRC] = dst_gid
+            payload[i, PW_KEY] = key
+            valid[i] = True
+        self._window_idx += 1
+        state = self._prepare(
+            self.state,
+            jnp.asarray(dstw),
+            jnp.asarray(t),
+            jnp.asarray(order),
+            jnp.asarray(kind),
+            jnp.asarray(payload),
+            jnp.asarray(valid),
+        )
+        return self._window(
+            state,
+            self.params,
+            jnp.asarray(window_end, jnp.int64),
+            jnp.zeros((), bool),
+        )
+
+    def _drain_captures(self):
+        ms = jax.device_get(self.state.model)
+        cap_n = ms["cap_n"]
+        for gid in np.nonzero(cap_n > 0)[0]:
+            host = self.hosts[int(gid)]
+            for j in range(int(cap_n[gid])):
+                t = int(ms["cap_t"][gid, j])
+                src = int(ms["cap_src"][gid, j])
+                key = int(ms["cap_key"][gid, j])
+                entry = self._bytes.pop((src, key), None)
+                if entry is None:
+                    continue  # duplicate capture (cannot happen) or GC'd
+                pkt = entry[1]
+                host.schedule(t, functools.partial(host.deliver_packet, pkt))
+
+    def _gc_bytes(self):
+        horizon = self._window_idx - _BYTES_GC_WINDOWS
+        if horizon <= 0:
+            return
+        dead = [k for k, (w, _) in self._bytes.items() if w < horizon]
+        for k in dead:  # lost to device-side drop (loss/budget/codel)
+            del self._bytes[k]
+
+    # ---- outputs -----------------------------------------------------------
+
+    def stats_report(self) -> dict:
+        s = jax.device_get(self.state.stats)
+        n = self.engine_cfg.num_hosts
+        wall = getattr(self, "_wall_seconds", None)
+        sim_s = self.cfg.general.stop_time / NS_PER_SEC
+        zombies = [p for p in self.procs if p.state.value == "zombie"]
+        failures = sum(
+            1
+            for p in self.procs
+            if p.expected_final_state == "running"
+            and p.state.value == "zombie"
+            or (
+                isinstance(p.expected_final_state, dict)
+                and p.expected_final_state.get("exited") is not None
+                and p.exit_code != p.expected_final_state["exited"]
+            )
+        )
+        return {
+            "simulated_seconds": sim_s,
+            "wall_seconds": wall,
+            "sim_wall_ratio": (sim_s / wall) if wall else None,
+            "windows": getattr(self, "_windows", 0),
+            "device_rounds": int(s.rounds),
+            "events_processed": int(s.events[:n].sum())
+            + sum(h.counters["events"] for h in self.hosts),
+            "packets_sent": int(s.pkts_sent[:n].sum()),
+            "packets_delivered": int(s.pkts_delivered[:n].sum()),
+            "packets_lost": int(s.pkts_lost[:n].sum()),
+            "packets_budget_dropped": int(s.pkts_budget_dropped[:n].sum()),
+            "packets_codel_dropped": int(s.pkts_codel_dropped[:n].sum()),
+            "queue_overflow_dropped": int(
+                np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
+            ),
+            "unreachable_ips": self._unreachable_ips,
+            "syscalls": sum(h.counters["syscalls"] for h in self.hosts),
+            "process_failures": failures,
+            "processes_exited": len(zombies),
+            "determinism_digest": f"{int(np.bitwise_xor.reduce(jax.device_get(self.state.stats.digest)[:n])):016x}",
+            "model_report": self.model.report(
+                jax.device_get(self.state.model), None
+            ),
+        }
+
+    def write_outputs(self, data_dir: str | None = None, report: dict | None = None) -> str:
+        data_dir = data_dir or self.cfg.general.data_directory
+        os.makedirs(data_dir, exist_ok=True)
+        with open(os.path.join(data_dir, "processed-config.yaml"), "w") as f:
+            yaml.safe_dump(self.cfg.to_dict(), f, sort_keys=False)
+        with open(os.path.join(data_dir, "sim-stats.json"), "w") as f:
+            json.dump(report or self.stats_report(), f, indent=2)
+        for spec, host in zip(self.specs, self.hosts):
+            hd = os.path.join(data_dir, "hosts", spec.name)
+            os.makedirs(hd, exist_ok=True)
+            for p in host.processes.values():
+                base = os.path.join(hd, f"{p.name}.{p.pid}")
+                with open(base + ".stdout", "wb") as f:
+                    f.write(b"".join(p.stdout))
+                with open(base + ".stderr", "wb") as f:
+                    f.write(b"".join(p.stderr))
+            with open(os.path.join(hd, "host-stats.json"), "w") as f:
+                json.dump({"name": spec.name, "ip": spec.ip, **host.counters}, f)
+        return data_dir
+
+
+def _prepare_window(cfg, model, state, dst, t, order, kind, payload, valid):
+    """Jitted: clear capture rings + merge staged send-requests."""
+    ms = dict(state.model)
+    ms["cap_n"] = jnp.zeros_like(ms["cap_n"])
+    queue = merge_flat_events(
+        state.queue, dst, t, order, kind, payload, valid, cfg.max_round_inserts
+    )
+    return state._replace(model=ms, queue=queue)
+
+
+def run_hybrid(cfg: ConfigOptions, **kw) -> tuple[HybridSimulation, dict]:
+    sim = HybridSimulation(cfg, **kw)
+    report = sim.run()
+    return sim, report

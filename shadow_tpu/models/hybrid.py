@@ -1,0 +1,110 @@
+"""The hybrid host model: device-side proxy for CPU-emulated hosts.
+
+This is the device half of the co-simulation bridge (`shadow_tpu.cosim`).
+Each emulated host (a `CpuHost` running coroutine/real processes) owns one
+device lane. Two event kinds flow through it:
+
+  - KIND_SENDREQ (local event, injected by the bridge): "this host's CPU
+    plane emitted a packet at time t". The handler converts it into a
+    `PacketSend`, so CPU-originated traffic goes through the FULL device
+    egress pipeline — send budget, token bucket, loss draw from the device
+    RNG, latency lookup, conservative arrival clamp, mesh exchange — exactly
+    like modeled-host traffic (worker.rs:330-425).
+  - KIND_DATA (packet event): a delivery for this host. The handler appends
+    (arrival time, src, payload key) to a per-host capture ring that the
+    bridge drains after every window and maps back to real packet bytes.
+
+Packet *bytes* never touch the device: the bridge keys each send with
+(src host, per-src counter) carried in payload words, and holds the bytes
+host-side — the TPU-native recast of the reference's payload-by-reference
+packets (src/main/routing/packet.c + payload.c refcounted chunks).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    HandlerOut,
+    PacketSend,
+    register_model,
+)
+
+KIND_SENDREQ = 1  # bridge-injected send request (local event at the source)
+KIND_DATA = 2  # packet delivery at the destination
+
+# payload word layout (word 0 is engine-owned size_bytes)
+PW_SIZE = 0
+PW_DST_OR_SRC = 1  # sendreq: dst host id; after send: unused (engine keeps it)
+PW_KEY = 2  # per-src payload key (bridge-side bytes lookup)
+PW_FLAGS = 3  # reserved
+
+
+@register_model
+class HybridModel:
+    """One device lane per emulated host (see module docstring)."""
+
+    name = "hybrid"
+
+    def __init__(self, capture_cap: int = 128):
+        self.capture_cap = capture_cap
+
+    # ---- build -------------------------------------------------------------
+
+    def build(self, hosts, seed):
+        h = len(hosts)
+        c = self.capture_cap
+        state = {
+            "cap_t": np.full((h, c), 0, np.int64),
+            "cap_src": np.zeros((h, c), np.int64),
+            "cap_key": np.zeros((h, c), np.int32),
+            "cap_size": np.zeros((h, c), np.int32),
+            "cap_n": np.zeros((h,), np.int32),
+            "cap_lost": np.zeros((h,), np.int64),  # ring overflow (observability)
+        }
+        params = {"_hosts": np.arange(h, dtype=np.int32)}  # placeholder shardable
+        return params, state, []  # no initial device events: the CPU plane drives
+
+    # ---- device handler ----------------------------------------------------
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        st = ctx.state
+        is_send = ctx.active & ~ctx.is_packet & (ctx.kind == KIND_SENDREQ)
+        is_data = ctx.active & ctx.is_packet & (ctx.kind == KIND_DATA)
+
+        # capture deliveries into the ring
+        n = st["cap_n"]
+        cap = st["cap_t"].shape[1]
+        slot_ok = is_data & (n < cap)
+        slot = jnp.where(slot_ok, n, cap)  # cap = out-of-range -> dropped
+        hh = jnp.arange(st["cap_t"].shape[0])
+        new_state = {
+            "cap_t": st["cap_t"].at[hh, slot].set(ctx.t, mode="drop"),
+            "cap_src": st["cap_src"].at[hh, slot].set(ctx.src, mode="drop"),
+            "cap_key": st["cap_key"]
+            .at[hh, slot]
+            .set(ctx.payload[:, PW_KEY], mode="drop"),
+            "cap_size": st["cap_size"]
+            .at[hh, slot]
+            .set(ctx.payload[:, PW_SIZE], mode="drop"),
+            "cap_n": n + slot_ok.astype(jnp.int32),
+            "cap_lost": st["cap_lost"] + (is_data & ~slot_ok),
+        }
+
+        send = PacketSend(
+            mask=is_send,
+            dst=ctx.payload[:, PW_DST_OR_SRC].astype(jnp.int64),
+            size_bytes=ctx.payload[:, PW_SIZE],
+            kind=jnp.full_like(ctx.kind, KIND_DATA),
+            payload=ctx.payload,
+        )
+        return HandlerOut(state=new_state, rng=ctx.rng, sends=(send,))
+
+    # ---- reporting ---------------------------------------------------------
+
+    def report(self, state, hosts) -> dict:
+        return {
+            "capture_overflow_lost": int(np.asarray(state["cap_lost"]).sum()),
+        }

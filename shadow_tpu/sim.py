@@ -60,32 +60,23 @@ class HostSpec:
     shutdown_time: int | None
     pcap_enabled: bool
     pcap_capture_size: int
+    # managed programs (hybrid/co-sim hosts): [{path, args, start_time, ...}]
+    programs: list = dataclasses.field(default_factory=list)
 
 
-def expand_hosts(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
-    """Config hosts -> HostSpecs with IPs and node indices resolved.
-
-    Hosts are sorted by name for a config-order-independent host-id mapping
-    (the reference shuffles hosts for scheduler balance, manager.rs:272 —
-    sharding here is by contiguous id range, so a stable order is what keeps
-    runs reproducible across config reorderings)."""
+def _resolve_host_basics(cfg: ConfigOptions, graph: NetworkGraph):
+    """Shared per-host resolution for both expanders: stable name order,
+    manual-IPs-first assignment (graph/mod.rs:370), graph node lookup, and
+    graph-bandwidth fallback. Yields (host_id, host_options, node, ip,
+    bw_down, bw_up)."""
     ips = IpAssignment()
-    specs: list[HostSpec] = []
     ordered = sorted(cfg.hosts, key=lambda h: h.name)
-    # manual IPs first so sequential assignment skips them (graph/mod.rs:370)
     for i, h in enumerate(ordered):
         if h.ip_addr is not None:
             ips.assign_manual(i, h.ip_addr)
     for i, h in enumerate(ordered):
         if not h.processes:
             raise ConfigError(f"host {h.name!r} has no processes")
-        dev_models = [p for p in h.processes if p.model is not None]
-        if len(dev_models) != 1:
-            raise ConfigError(
-                f"host {h.name!r}: exactly one device-model process per host "
-                f"is supported (got {len(dev_models)})"
-            )
-        p = dev_models[0]
         node = graph.node_index(h.network_node_id)
         if h.ip_addr is None:
             ips.assign(i)
@@ -95,12 +86,31 @@ def expand_hosts(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
         bw_up = h.bandwidth_up if h.bandwidth_up is not None else int(
             graph.bw_up_bits[node]
         )
+        yield i, h, node, ips.ip_of(i), bw_down, bw_up
+
+
+def expand_hosts(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
+    """Config hosts -> HostSpecs with IPs and node indices resolved.
+
+    Hosts are sorted by name for a config-order-independent host-id mapping
+    (the reference shuffles hosts for scheduler balance, manager.rs:272 —
+    sharding here is by contiguous id range, so a stable order is what keeps
+    runs reproducible across config reorderings)."""
+    specs: list[HostSpec] = []
+    for i, h, node, ip, bw_down, bw_up in _resolve_host_basics(cfg, graph):
+        dev_models = [p for p in h.processes if p.model is not None]
+        if len(dev_models) != 1:
+            raise ConfigError(
+                f"host {h.name!r}: exactly one device-model process per host "
+                f"is supported (got {len(dev_models)})"
+            )
+        p = dev_models[0]
         specs.append(
             HostSpec(
                 host_id=i,
                 name=h.name,
                 node_index=node,
-                ip=ips.ip_of(i),
+                ip=ip,
                 bw_down_bits=bw_down,
                 bw_up_bits=bw_up,
                 model=p.model,
@@ -112,6 +122,84 @@ def expand_hosts(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
             )
         )
     return specs
+
+
+def config_is_hybrid(cfg: ConfigOptions) -> bool:
+    """True if any host runs managed programs (`path:`) instead of models."""
+    return any(p.path is not None for h in cfg.hosts for p in h.processes)
+
+
+def expand_hosts_hybrid(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
+    """Config -> specs for co-simulation: every process is a managed program
+    run on a CpuHost; the device lane runs the hybrid proxy model."""
+    from shadow_tpu.programs import PROGRAM_REGISTRY
+
+    specs: list[HostSpec] = []
+    for i, h, node, ip, bw_down, bw_up in _resolve_host_basics(cfg, graph):
+        bad = [p for p in h.processes if p.path is None]
+        if bad:
+            raise ConfigError(
+                f"host {h.name!r}: mixing device models and managed programs "
+                f"in one simulation is not supported yet"
+            )
+        for p in h.processes:
+            if p.path not in PROGRAM_REGISTRY:
+                raise ConfigError(
+                    f"host {h.name!r}: unknown program {p.path!r}; "
+                    f"available: {sorted(PROGRAM_REGISTRY)}"
+                )
+        specs.append(
+            HostSpec(
+                host_id=i,
+                name=h.name,
+                node_index=node,
+                ip=ip,
+                bw_down_bits=bw_down,
+                bw_up_bits=bw_up,
+                model="hybrid",
+                model_args={},
+                start_time=0,
+                shutdown_time=None,
+                pcap_enabled=h.host_options.pcap_enabled,
+                pcap_capture_size=h.host_options.pcap_capture_size,
+                programs=[
+                    {
+                        "path": p.path,
+                        "args": _program_args(p),
+                        "start_time": p.start_time,
+                        "shutdown_time": p.shutdown_time,
+                        "expected_final_state": p.expected_final_state,
+                    }
+                    for p in h.processes
+                ],
+            )
+        )
+    return specs
+
+
+def _program_args(p) -> dict:
+    """Program args: `args: ["key=value", ...]` entries become a dict; the
+    reference passes argv strings the same way (ProcessOptions.args)."""
+    out: dict[str, Any] = {}
+    for a in p.args:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            out[k] = v
+        else:
+            out.setdefault("_argv", []).append(a)
+    out.update({f"env_{k}": v for k, v in p.environment.items()})
+    return out
+
+
+def build_simulation(cfg: ConfigOptions, **kw):
+    """Factory: modeled sims -> `Simulation` (device-only, mesh-scalable);
+    program sims -> `HybridSimulation` (CPU plane + device network)."""
+    if config_is_hybrid(cfg):
+        from shadow_tpu.cosim import HybridSimulation
+
+        kw.pop("world", None)
+        return HybridSimulation(cfg, **kw)
+    return Simulation(cfg, **kw)
 
 
 def _tb_params(bws: np.ndarray, interval_ns: int) -> TBParams:

@@ -1,0 +1,177 @@
+"""Co-simulation bridge tests: CPU-emulated hosts over the device network
+plane (the host↔device staging contract, SURVEY.md §7 hard part 6)."""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.cosim import HybridSimulation
+
+MS = 1_000_000
+
+
+def _cfg(hosts: dict, stop="3 s", seed=7, extra=None):
+    d = {
+        "general": {"stop_time": stop, "seed": seed},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "hosts": hosts,
+    }
+    if extra:
+        d.update(extra)
+    return ConfigOptions.from_dict(d)
+
+
+def _stdout(sim: HybridSimulation, host_name: str) -> str:
+    for spec, host in zip(sim.specs, sim.hosts):
+        if spec.name == host_name:
+            return "".join(
+                b"".join(p.stdout).decode() for p in host.processes.values()
+            )
+    raise KeyError(host_name)
+
+
+def test_udp_ping_over_device_plane():
+    cfg = _cfg(
+        {
+            "server": {
+                "network_node_id": 0,
+                "processes": [{"path": "udp_echo_server", "args": ["port=9000"]}],
+            },
+            "client": {
+                "network_node_id": 0,
+                "count": 2,
+                "processes": [
+                    {
+                        "path": "udp_ping",
+                        "args": ["server=server", "port=9000", "count=4"],
+                        "expected_final_state": {"exited": 0},
+                    }
+                ],
+            },
+        }
+    )
+    sim = HybridSimulation(cfg)
+    report = sim.run()
+    assert report["process_failures"] == 0
+    assert report["packets_sent"] == 16  # 2 clients x 4 pings x 2 directions
+    assert report["packets_delivered"] == 16
+    for c in ("client1", "client2"):
+        out = _stdout(sim, c)
+        assert "done ok=4/4" in out
+        # every RTT identical + deterministic under the conservative clamp
+        rtts = {l.split("rtt_ns=")[1] for l in out.splitlines() if "rtt_ns" in l}
+        assert len(rtts) == 1
+
+
+def test_tgen_tcp_flow_over_device_plane():
+    size = 200_000
+    cfg = _cfg(
+        {
+            "server": {
+                "network_node_id": 0,
+                "processes": [
+                    {
+                        "path": "tgen_server",
+                        "args": ["port=8080", "conns=1"],
+                        "expected_final_state": {"exited": 0},
+                    }
+                ],
+            },
+            "client": {
+                "network_node_id": 0,
+                "processes": [
+                    {
+                        "path": "tgen_client",
+                        "args": ["server=server", "port=8080", f"size={size}"],
+                        "expected_final_state": {"exited": 0},
+                    }
+                ],
+            },
+        },
+        stop="10 s",
+    )
+    sim = HybridSimulation(cfg)
+    report = sim.run()
+    assert report["process_failures"] == 0
+    assert f"bytes={size}" in _stdout(sim, "server")
+    assert f"sent={size}" in _stdout(sim, "client")
+
+
+def test_hybrid_determinism_two_runs():
+    def once():
+        cfg = _cfg(
+            {
+                "server": {
+                    "network_node_id": 0,
+                    "processes": [{"path": "udp_echo_server"}],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "count": 3,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server", "count=6", "size=200"],
+                        }
+                    ],
+                },
+            },
+            seed=99,
+        )
+        sim = HybridSimulation(cfg)
+        report = sim.run()
+        outs = {s.name: _stdout(sim, s.name) for s in sim.specs}
+        return report["determinism_digest"], outs, report["packets_sent"]
+
+    assert once() == once()
+
+
+def test_mixed_model_and_program_rejected():
+    cfg_dict = {
+        "general": {"stop_time": "1 s"},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "hosts": {
+            "a": {
+                "network_node_id": 0,
+                "processes": [{"path": "udp_echo_server"}],
+            },
+            "b": {
+                "network_node_id": 0,
+                "processes": [{"model": "timer", "model_args": {"interval": "1 s"}}],
+            },
+        },
+    }
+    from shadow_tpu.config.options import ConfigError
+
+    cfg = ConfigOptions.from_dict(cfg_dict)
+    with pytest.raises(ConfigError, match="mixing"):
+        HybridSimulation(cfg)
+
+
+def test_build_simulation_factory_dispatch():
+    from shadow_tpu.sim import build_simulation, Simulation
+
+    model_cfg = ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "1 s"},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {
+                "n": {
+                    "count": 4,
+                    "network_node_id": 0,
+                    "processes": [
+                        {"model": "timer", "model_args": {"interval": "100 ms"}}
+                    ],
+                }
+            },
+        }
+    )
+    assert isinstance(build_simulation(model_cfg, world=1), Simulation)
+    prog_cfg = _cfg(
+        {
+            "s": {"network_node_id": 0, "processes": [{"path": "udp_echo_server"}]},
+        },
+        stop="1 s",
+    )
+    assert isinstance(build_simulation(prog_cfg), HybridSimulation)
