@@ -1,0 +1,61 @@
+/* Shared-memory IPC layout between the simulator and managed processes.
+ *
+ * Reference: the shim event protocol + IPC channel pair of
+ * src/lib/shadow-shim-helper-rs (shim_event.rs ShimEventToShadow/ToShim,
+ * ipc.rs IPCData = two lock-free channels) and the futex-based SPSC channel
+ * of src/lib/vasi-sync/src/scchannel.rs — rebuilt as a C ping-pong channel.
+ * The Python side mirrors this layout with struct offsets
+ * (shadow_tpu/native_plane.py); keep the two in sync.
+ *
+ * Protocol: strict ping-pong per thread. The shim writes `to_shadow` only
+ * when it is EMPTY (guaranteed: it owns exactly one in-flight request), the
+ * simulator replies on `to_shim`. `sim_time_ns` is the shared simulated
+ * clock the shim answers time syscalls from without a context switch
+ * (HostShmem.sim_time, shim_shmem.rs:91 / shim_sys.c:25-114).
+ */
+#ifndef SHADOW_NATIVE_IPC_H
+#define SHADOW_NATIVE_IPC_H
+
+#include <stdint.h>
+
+enum MsgKind {
+    MSG_NONE = 0,
+    MSG_START = 1,            /* shim -> shadow: process is initialized      */
+    MSG_SYSCALL = 2,          /* shim -> shadow: trapped syscall             */
+    MSG_START_OK = 3,         /* shadow -> shim: begin running               */
+    MSG_SYSCALL_COMPLETE = 4, /* shadow -> shim: emulated, ret in `ret`      */
+    MSG_SYSCALL_NATIVE = 5,   /* shadow -> shim: execute natively            */
+};
+
+enum ChanState {
+    CHAN_EMPTY = 0,
+    CHAN_FULL = 1,
+    CHAN_CLOSED = 2,
+};
+
+typedef struct {
+    int32_t kind;
+    int32_t _pad;
+    int64_t num;     /* syscall number */
+    int64_t args[6];
+    int64_t ret;
+} ShimMsg; /* 72 bytes */
+
+typedef struct {
+    uint32_t state; /* ChanState, futex word */
+    uint32_t _pad;
+    ShimMsg msg;
+} ShimChan; /* 80 bytes */
+
+typedef struct {
+    int64_t sim_time_ns; /* simulator-maintained simulated clock */
+    uint32_t _flags;
+    uint32_t _pad;
+    ShimChan to_shadow; /* offset 16 */
+    ShimChan to_shim;   /* offset 96 */
+} IpcBlock; /* 176 bytes */
+
+#define IPC_TO_SHADOW_OFF 16
+#define IPC_TO_SHIM_OFF 96
+
+#endif

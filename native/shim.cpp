@@ -1,0 +1,300 @@
+/* The in-process shim: LD_PRELOADed into every managed process.
+ *
+ * Reference surface being rebuilt (not ported): src/lib/shim/ —
+ * seccomp filter install + SIGSYS interposition (shim_seccomp.c:36-68,
+ * 189-250), local handling of hot time syscalls from the shared simulated
+ * clock (shim_sys.c:25-114), the syscall dispatch loop (shim_syscall.c),
+ * and the preload-libc symbol overrides (lib/preload-libc) for
+ * vdso-destined time calls that raw seccomp cannot trap.
+ *
+ * Mechanism:
+ *   1. constructor maps the IPC block (path in SHADOW_SHM_PATH), builds a
+ *      one-page syscall trampoline, installs the SIGSYS handler, then a
+ *      seccomp filter that ALLOWs rt_sigreturn and any syscall issued from
+ *      the trampoline page and TRAPs everything else;
+ *   2. trapped syscalls hit handle_sigsys(): time syscalls answered from
+ *      IpcBlock.sim_time_ns with no context switch; everything else is
+ *      shipped over the futex channel and either completed with the
+ *      simulator's return value or re-executed natively via the trampoline
+ *      when the simulator answers MSG_SYSCALL_NATIVE.
+ */
+
+#define _GNU_SOURCE 1
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/futex.h>
+#include <linux/seccomp.h>
+#include <signal.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/syscall.h>
+#include <sys/ucontext.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "ipc.h"
+
+static IpcBlock *g_ipc = nullptr;
+typedef long (*raw_syscall_fn)(long n, long a, long b, long c, long d, long e,
+                               long f);
+static raw_syscall_fn g_raw = nullptr;
+static uintptr_t g_tramp_page = 0;
+
+/* ----------------------------------------------------------- trampoline */
+
+/* mov rax,rdi; mov rdi,rsi; mov rsi,rdx; mov rdx,rcx; mov r10,r8;
+ * mov r8,r9; mov r9,[rsp+8]; syscall; ret */
+static const unsigned char TRAMP_CODE[] = {
+    0x48, 0x89, 0xf8, 0x48, 0x89, 0xf7, 0x48, 0x89, 0xd6, 0x48, 0x89,
+    0xce, 0x4d, 0x89, 0xc2, 0x4d, 0x89, 0xc8, 0x4c, 0x8b, 0x4c, 0x24,
+    0x08, 0x0f, 0x05, 0xc3,
+};
+
+static int build_trampoline(void) {
+    void *page = mmap(nullptr, 4096, PROT_READ | PROT_WRITE,
+                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (page == MAP_FAILED)
+        return -1;
+    memcpy(page, TRAMP_CODE, sizeof(TRAMP_CODE));
+    if (mprotect(page, 4096, PROT_READ | PROT_EXEC))
+        return -1;
+    g_tramp_page = (uintptr_t)page;
+    g_raw = (raw_syscall_fn)page;
+    return 0;
+}
+
+/* ------------------------------------------------------------- channel */
+
+static void futex_wake(uint32_t *addr) {
+    g_raw(SYS_futex, (long)addr, FUTEX_WAKE, 1 << 30, 0, 0, 0);
+}
+
+static void futex_wait(uint32_t *addr, uint32_t val) {
+    g_raw(SYS_futex, (long)addr, FUTEX_WAIT, val, 0, 0, 0);
+}
+
+static void chan_send(ShimChan *c, const ShimMsg *m) {
+    /* ping-pong: our previous message was consumed before we send again */
+    while (__atomic_load_n(&c->state, __ATOMIC_ACQUIRE) == CHAN_FULL)
+        futex_wait(&c->state, CHAN_FULL);
+    c->msg = *m;
+    __atomic_store_n(&c->state, CHAN_FULL, __ATOMIC_RELEASE);
+    futex_wake(&c->state);
+}
+
+static int chan_recv(ShimChan *c, ShimMsg *out) {
+    uint32_t s;
+    while ((s = __atomic_load_n(&c->state, __ATOMIC_ACQUIRE)) != CHAN_FULL) {
+        if (s == CHAN_CLOSED)
+            return -1;
+        futex_wait(&c->state, s);
+    }
+    *out = c->msg;
+    __atomic_store_n(&c->state, CHAN_EMPTY, __ATOMIC_RELEASE);
+    futex_wake(&c->state);
+    return 0;
+}
+
+/* ----------------------------------------------------- time-from-shmem */
+
+static int64_t sim_now(void) {
+    return __atomic_load_n(&g_ipc->sim_time_ns, __ATOMIC_ACQUIRE);
+}
+
+static long emulate_time_syscall(long num, long a, long b) {
+    int64_t now = sim_now();
+    switch (num) {
+    case SYS_clock_gettime: {
+        struct timespec *ts = (struct timespec *)b;
+        if (ts) {
+            ts->tv_sec = now / 1000000000;
+            ts->tv_nsec = now % 1000000000;
+        }
+        return 0;
+    }
+    case SYS_gettimeofday: {
+        struct timeval *tv = (struct timeval *)a;
+        if (tv) {
+            tv->tv_sec = now / 1000000000;
+            tv->tv_usec = (now % 1000000000) / 1000;
+        }
+        return 0;
+    }
+    case SYS_time: {
+        long secs = now / 1000000000;
+        if (a)
+            *(long *)a = secs;
+        return secs;
+    }
+    }
+    return -ENOSYS;
+}
+
+/* --------------------------------------------------------------- sigsys */
+
+static long forward_syscall(long num, const long args[6]) {
+    ShimMsg req, resp;
+    memset(&req, 0, sizeof req);
+    req.kind = MSG_SYSCALL;
+    req.num = num;
+    for (int i = 0; i < 6; i++)
+        req.args[i] = args[i];
+    chan_send(&g_ipc->to_shadow, &req);
+    if (chan_recv(&g_ipc->to_shim, &resp) != 0) {
+        /* simulator went away: die quietly (ProcessDeath analogue) */
+        g_raw(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    }
+    if (resp.kind == MSG_SYSCALL_NATIVE)
+        return g_raw(num, args[0], args[1], args[2], args[3], args[4], args[5]);
+    return resp.ret;
+}
+
+extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
+                                          void *ucontext) {
+    (void)sig;
+    (void)info;
+    ucontext_t *uc = (ucontext_t *)ucontext;
+    greg_t *regs = uc->uc_mcontext.gregs;
+    long num = regs[REG_RAX];
+    long args[6] = {(long)regs[REG_RDI], (long)regs[REG_RSI],
+                    (long)regs[REG_RDX], (long)regs[REG_R10],
+                    (long)regs[REG_R8],  (long)regs[REG_R9]};
+    long ret;
+    switch (num) {
+    case SYS_clock_gettime:
+    case SYS_gettimeofday:
+    case SYS_time:
+        ret = emulate_time_syscall(num, args[0], args[1]);
+        break;
+    case SYS_clock_getres: {
+        struct timespec *ts = (struct timespec *)args[1];
+        if (ts) {
+            ts->tv_sec = 0;
+            ts->tv_nsec = 1;
+        }
+        ret = 0;
+        break;
+    }
+    default:
+        ret = forward_syscall(num, args);
+        break;
+    }
+    regs[REG_RAX] = ret;
+}
+
+/* ----------------------------------------------------- libc interposers
+ * vdso-backed time functions never produce a syscall instruction, so the
+ * seccomp filter cannot see them; exporting the symbols from the preload
+ * library routes PLT calls here instead (lib/preload-libc's INTERPOSE). */
+
+extern "C" int clock_gettime(clockid_t clk, struct timespec *ts) {
+    if (!g_ipc)
+        return (int)syscall(SYS_clock_gettime, clk, ts);
+    int64_t now = sim_now();
+    if (ts) {
+        ts->tv_sec = now / 1000000000;
+        ts->tv_nsec = now % 1000000000;
+    }
+    return 0;
+}
+
+extern "C" int gettimeofday(struct timeval *tv, void *tz) {
+    (void)tz;
+    if (!g_ipc)
+        return (int)syscall(SYS_gettimeofday, tv, tz);
+    int64_t now = sim_now();
+    if (tv) {
+        tv->tv_sec = now / 1000000000;
+        tv->tv_usec = (now % 1000000000) / 1000;
+    }
+    return 0;
+}
+
+extern "C" time_t time(time_t *tloc) {
+    if (!g_ipc)
+        return (time_t)syscall(SYS_time, tloc);
+    time_t secs = sim_now() / 1000000000;
+    if (tloc)
+        *tloc = secs;
+    return secs;
+}
+
+/* -------------------------------------------------------------- seccomp */
+
+static int install_seccomp(void) {
+    uint32_t lo = (uint32_t)(g_tramp_page & 0xffffffffu);
+    uint32_t hi = (uint32_t)(g_tramp_page >> 32);
+    struct sock_filter filter[] = {
+        /* arch check */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, arch)),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 1, 0),
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS),
+        /* rt_sigreturn always allowed (signal handler unwind) */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS, offsetof(struct seccomp_data, nr)),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, SYS_rt_sigreturn, 5, 0),
+        /* instruction pointer inside the trampoline page -> allow;
+         * anything else -> TRAP (indices: 10 = ALLOW, 11 = TRAP) */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, instruction_pointer) + 4),
+        BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, hi, 0, 4),   /* !=hi -> TRAP */
+        BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                 offsetof(struct seccomp_data, instruction_pointer)),
+        BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, lo, 0, 2),   /* <lo  -> TRAP */
+        BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, lo + 4096, 1, 0), /* >=end -> TRAP */
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+        BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_TRAP),
+    };
+    struct sock_fprog prog;
+    prog.len = sizeof(filter) / sizeof(filter[0]);
+    prog.filter = filter;
+    if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0))
+        return -1;
+    if (syscall(SYS_seccomp, SECCOMP_SET_MODE_FILTER, 0, &prog))
+        return -1;
+    return 0;
+}
+
+/* ------------------------------------------------------------------ init */
+
+__attribute__((constructor)) static void shadow_shim_init(void) {
+    const char *path = getenv("SHADOW_SHM_PATH");
+    if (!path)
+        return; /* not under the simulator: run natively */
+    int fd = open(path, O_RDWR | O_CLOEXEC);
+    if (fd < 0)
+        _exit(91);
+    void *mem =
+        mmap(nullptr, sizeof(IpcBlock), PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED)
+        _exit(92);
+    g_ipc = (IpcBlock *)mem;
+    if (build_trampoline())
+        _exit(93);
+
+    struct sigaction sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sa_sigaction = shadow_shim_handle_sigsys;
+    sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+    sigemptyset(&sa.sa_mask);
+    if (sigaction(SIGSYS, &sa, nullptr))
+        _exit(94);
+
+    /* StartReq/StartRes handshake (managed_thread.rs:135-243) */
+    ShimMsg start, resp;
+    memset(&start, 0, sizeof start);
+    start.kind = MSG_START;
+    start.num = getpid();
+    if (install_seccomp())
+        _exit(95);
+    chan_send(&g_ipc->to_shadow, &start);
+    if (chan_recv(&g_ipc->to_shim, &resp) != 0 || resp.kind != MSG_START_OK)
+        _exit(96);
+}

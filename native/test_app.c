@@ -1,0 +1,38 @@
+/* Managed-process test binary (the analogue of the reference's dual
+ * Linux/Shadow test programs, src/test/*): exercises time (simulated
+ * clock), nanosleep (simulated time advance), getrandom (deterministic),
+ * stdout writes (captured), and exit status. */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/random.h>
+#include <time.h>
+#include <unistd.h>
+
+static int64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000 + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    int sleeps = argc > 1 ? atoi(argv[1]) : 3;
+    printf("start t=%ld\n", (long)now_ns());
+    fflush(stdout);
+    for (int i = 0; i < sleeps; i++) {
+        struct timespec d = {0, 250 * 1000 * 1000}; /* 250 ms */
+        nanosleep(&d, NULL);
+        printf("tick %d t=%ld\n", i, (long)now_ns());
+        fflush(stdout);
+    }
+    unsigned char rnd[8];
+    if (getrandom(rnd, sizeof rnd, 0) != sizeof rnd)
+        return 2;
+    printf("rnd=");
+    for (unsigned i = 0; i < sizeof rnd; i++)
+        printf("%02x", rnd[i]);
+    printf("\nend t=%ld\n", (long)now_ns());
+    fflush(stdout);
+    return 0;
+}

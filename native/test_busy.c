@@ -1,0 +1,19 @@
+/* Busy-loop + time test: the managed clock must be fully simulator-driven,
+ * so a CPU busy-loop consumes ZERO simulated time (the reference models CPU
+ * delay only when configured; default frequency matching = no delay). */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <stdint.h>
+#include <time.h>
+
+int main(void) {
+    struct timespec a, b;
+    clock_gettime(CLOCK_MONOTONIC, &a);
+    volatile unsigned long x = 0;
+    for (unsigned long i = 0; i < 50UL * 1000 * 1000; i++)
+        x += i;
+    clock_gettime(CLOCK_MONOTONIC, &b);
+    long delta = (b.tv_sec - a.tv_sec) * 1000000000L + (b.tv_nsec - a.tv_nsec);
+    printf("busy delta_ns=%ld x=%lu\n", delta, x);
+    return delta == 0 ? 0 : 1;
+}

@@ -45,7 +45,7 @@ from shadow_tpu.config.options import ConfigError, ConfigOptions
 from shadow_tpu.core import engine as eng
 from shadow_tpu.core.engine import Engine, EngineParams
 from shadow_tpu.host import CpuHost, HostConfig
-from shadow_tpu.host.sockets import NetPacket, PROTO_TCP
+from shadow_tpu.host.sockets import NetPacket
 from shadow_tpu.models.hybrid import (
     HybridModel,
     KIND_SENDREQ,
@@ -141,11 +141,30 @@ class HybridSimulation:
         self.procs = []
         for s, h in zip(self.specs, self.hosts):
             for p in s.programs:
-                prog = get_program(p["path"])
                 args = dict(p.get("args") or {})
-                proc = h.spawn(
-                    prog, name=p["path"], args=args, start_time=p.get("start_time", 0)
-                )
+                if "/" in p["path"]:
+                    # real binary under the C++ shim (native plane)
+                    from shadow_tpu.native_plane import ensure_built, spawn_native
+
+                    if not ensure_built():
+                        raise ConfigError(
+                            f"native plane unavailable (no C++ toolchain?) "
+                            f"for binary {p['path']!r}"
+                        )
+                    proc = spawn_native(
+                        h,
+                        [p["path"], *p.get("argv_raw", [])],
+                        start_time=p.get("start_time", 0),
+                        env=p.get("environment") or {},
+                    )
+                else:
+                    prog = get_program(p["path"])
+                    proc = h.spawn(
+                        prog,
+                        name=p["path"],
+                        args=args,
+                        start_time=p.get("start_time", 0),
+                    )
                 proc.expected_final_state = p.get("expected_final_state", "running")
                 if p.get("shutdown_time") is not None:
                     h.schedule(p["shutdown_time"], proc.kill)
@@ -200,16 +219,19 @@ class HybridSimulation:
         while True:
             dev_min = int(jnp.min(next_time(self.state.queue)))
             t_next = min(self._cpu_min_next(), dev_min)
-            if self._staged:
-                # sends carried over a staging-cap overflow still need a window
-                t_next = min(t_next, min(e[1] for e in self._staged))
             if t_next >= stop:
                 break
             window_end = min(t_next + runahead, stop)
             for h in self.hosts:  # deterministic host order
                 h.execute(window_end)
-            self.state = self._inject_and_run(window_end)
-            self._drain_captures()
+            # drain ALL staged sends for this window (multiple passes when a
+            # burst exceeds the staging cap) so no send ever carries a stale
+            # timestamp into a later window
+            while True:
+                self.state = self._inject_and_run(window_end)
+                self._drain_captures()
+                if not self._staged:
+                    break
             windows += 1
             if hb_ns and window_end >= next_hb:
                 wall = time.monotonic() - t0
@@ -227,6 +249,13 @@ class HybridSimulation:
                 self._gc_bytes()
         for h in self.hosts:
             h.execute(stop)
+        # snapshot final states BEFORE reaping: a daemon alive at stop_time
+        # satisfies expected_final_state: running even though shutdown kills
+        # it (reference free_all_applications semantics, host.rs:791-807)
+        for p in self.procs:
+            p.state_at_stop = getattr(p.state, "value", p.state)
+        for h in self.hosts:  # reap live processes + native IPC resources
+            h.shutdown()
         if show_progress:
             print(file=log)
         self._wall_seconds = time.monotonic() - t0
@@ -303,12 +332,15 @@ class HybridSimulation:
         n = self.engine_cfg.num_hosts
         wall = getattr(self, "_wall_seconds", None)
         sim_s = self.cfg.general.stop_time / NS_PER_SEC
-        zombies = [p for p in self.procs if p.state.value == "zombie"]
+        def pstate(p):  # coroutine procs use ProcState, native procs a str
+            snap = getattr(p, "state_at_stop", None)
+            return snap if snap is not None else getattr(p.state, "value", p.state)
+
+        zombies = [p for p in self.procs if pstate(p) == "zombie"]
         failures = sum(
             1
             for p in self.procs
-            if p.expected_final_state == "running"
-            and p.state.value == "zombie"
+            if (p.expected_final_state == "running" and pstate(p) == "zombie")
             or (
                 isinstance(p.expected_final_state, dict)
                 and p.expected_final_state.get("exited") is not None

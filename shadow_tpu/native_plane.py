@@ -1,0 +1,447 @@
+"""Python side of the native managed-process plane.
+
+Reference counterpart: `ManagedThread` (managed_thread.rs:96-324 — spawn
+with preload injection, the per-thread IPC channel, the resume loop
+receiving `Syscall` events and replying Complete/DoNative) plus the syscall
+handler dispatch (host/syscall/handler/mod.rs) and `MemoryCopier`
+(process_vm_readv/writev, memory_manager/memory_copier.rs). The C++ shim
+(`native/shim.cpp`) is the in-process half.
+
+A `NativeProcess` plugs into a `CpuHost` exactly like a coroutine
+`Process`: it advances only when the host event loop drives it, real time
+never leaks in (the shared `sim_time_ns` is the only clock the child
+sees), and blocking syscalls (nanosleep) park it on host-scheduled
+wakeups. Syscalls the simulator does not emulate are answered
+MSG_SYSCALL_NATIVE and execute in the child (the reference's
+pass-through/regular-file policy).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import mmap
+import os
+import struct
+import subprocess
+import tempfile
+
+# ---- layout mirror of native/ipc.h ----------------------------------------
+
+MSG_START = 1
+MSG_SYSCALL = 2
+MSG_START_OK = 3
+MSG_SYSCALL_COMPLETE = 4
+MSG_SYSCALL_NATIVE = 5
+
+CHAN_EMPTY, CHAN_FULL, CHAN_CLOSED = 0, 1, 2
+
+# message wire format is "<ii q 6q q" at channel offset + 8 (see ipc.h)
+TO_SHADOW_OFF = 16
+TO_SHIM_OFF = 96
+IPC_SIZE = 176
+
+_libc = ctypes.CDLL(None, use_errno=True)
+SYS_futex = 202
+FUTEX_WAIT = 0
+FUTEX_WAKE = 1
+
+
+class _Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+def _futex(addr, op, val, timeout_s: float | None = None) -> int:
+    ts = None
+    if timeout_s is not None:
+        ts = _Timespec(int(timeout_s), int((timeout_s % 1.0) * 1e9))
+    r = _libc.syscall(
+        SYS_futex, ctypes.c_void_p(addr), op, val,
+        ctypes.byref(ts) if ts is not None else None, None, 0,
+    )
+    return r
+
+
+class _Iovec(ctypes.Structure):
+    _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
+
+
+def _vm_read(pid: int, addr: int, n: int) -> bytes:
+    if n <= 0 or addr == 0:
+        return b""
+    buf = ctypes.create_string_buffer(n)
+    local = _Iovec(ctypes.cast(buf, ctypes.c_void_p), n)
+    remote = _Iovec(ctypes.c_void_p(addr), n)
+    got = _libc.process_vm_readv(pid, ctypes.byref(local), 1,
+                                 ctypes.byref(remote), 1, 0)
+    if got < 0:
+        raise OSError(ctypes.get_errno(), "process_vm_readv")
+    return buf.raw[:got]
+
+
+def _vm_write(pid: int, addr: int, data: bytes) -> int:
+    if not data or addr == 0:
+        return 0
+    buf = ctypes.create_string_buffer(bytes(data), len(data))
+    local = _Iovec(ctypes.cast(buf, ctypes.c_void_p), len(data))
+    remote = _Iovec(ctypes.c_void_p(addr), len(data))
+    got = _libc.process_vm_writev(pid, ctypes.byref(local), 1,
+                                  ctypes.byref(remote), 1, 0)
+    if got < 0:
+        raise OSError(ctypes.get_errno(), "process_vm_writev")
+    return got
+
+
+# ---- build helper ----------------------------------------------------------
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+
+def shim_path() -> str:
+    return os.path.join(_NATIVE_DIR, "build", "libshadow_shim.so")
+
+
+def ensure_built() -> bool:
+    """Build the native plane if needed; False if no toolchain."""
+    if os.path.exists(shim_path()):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR], check=True,
+            capture_output=True, timeout=120,
+        )
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+    return os.path.exists(shim_path())
+
+
+# ---- IPC block -------------------------------------------------------------
+
+class IpcBlock:
+    """One shared-memory block (file-backed) mirroring native/ipc.h."""
+
+    def __init__(self):
+        fd, self.path = tempfile.mkstemp(prefix="shadow-ipc-", dir="/dev/shm")
+        os.ftruncate(fd, IPC_SIZE)
+        self._mm = mmap.mmap(fd, IPC_SIZE)
+        os.close(fd)
+        self._state_addrs = {}
+        base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+        for name, off in (("to_shadow", TO_SHADOW_OFF), ("to_shim", TO_SHIM_OFF)):
+            self._state_addrs[name] = base + off
+
+    def close(self):
+        ch_off = TO_SHADOW_OFF
+        self.set_chan_state(ch_off + 0, CHAN_CLOSED, wake=True)
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- sim clock
+    def set_time(self, t_ns: int):
+        self._mm[0:8] = struct.pack("<q", t_ns)
+
+    # -- channel primitives (Python is the "shadow" side)
+    def _chan_off(self, name: str) -> int:
+        return TO_SHADOW_OFF if name == "to_shadow" else TO_SHIM_OFF
+
+    def chan_state(self, name: str) -> int:
+        off = self._chan_off(name)
+        return struct.unpack_from("<I", self._mm, off)[0]
+
+    def set_chan_state(self, off_or_name, state: int, wake: bool = False):
+        off = (
+            self._chan_off(off_or_name)
+            if isinstance(off_or_name, str)
+            else off_or_name
+        )
+        struct.pack_into("<I", self._mm, off, state)
+        if wake:
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm)) + off
+            _futex(addr, FUTEX_WAKE, 1 << 30)
+
+    def recv_syscall(self, timeout_s: float) -> tuple[int, list[int]] | None:
+        """Wait for a message on to_shadow; returns (num, args) or None."""
+        off = TO_SHADOW_OFF
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm)) + off
+        deadline_attempts = max(1, int(timeout_s / 0.05))
+        for _ in range(deadline_attempts):
+            state = self.chan_state("to_shadow")
+            if state == CHAN_FULL:
+                kind, _pad, num, *rest = struct.unpack_from(
+                    "<ii q 6q q", self._mm, off + 8
+                )
+                args = list(rest[:6])
+                self.set_chan_state(off, CHAN_EMPTY, wake=True)
+                return (kind, num, args)
+            _futex(addr, FUTEX_WAIT, state, 0.05)
+        return None
+
+    def reply(self, kind: int, ret: int = 0):
+        off = TO_SHIM_OFF
+        struct.pack_into(
+            "<ii q 6q q", self._mm, off + 8, kind, 0, 0, 0, 0, 0, 0, 0, 0,
+            ctypes.c_int64(ret).value,
+        )
+        self.set_chan_state(off, CHAN_FULL, wake=True)
+
+
+# ---- syscall numbers the policy references ---------------------------------
+
+SYS = {
+    "read": 0, "write": 1, "close": 3, "fstat": 5, "lseek": 8, "mmap": 9,
+    "mprotect": 10, "munmap": 11, "brk": 12, "rt_sigaction": 13,
+    "rt_sigprocmask": 14, "ioctl": 16, "pread64": 17, "writev": 20,
+    "access": 21, "sched_yield": 24, "nanosleep": 35, "getpid": 39,
+    "exit": 60, "uname": 63, "fcntl": 72, "getcwd": 79, "readlink": 89,
+    "sigaltstack": 131, "arch_prctl": 158, "gettid": 186, "futex": 202,
+    "set_tid_address": 218, "clock_gettime": 228, "clock_nanosleep": 230,
+    "exit_group": 231, "openat": 257, "newfstatat": 262, "set_robust_list": 273,
+    "prlimit64": 302, "getrandom": 318, "statx": 332, "rseq": 334,
+    "clock_getres": 229, "getdents64": 217, "sched_getaffinity": 204,
+    "kill": 62, "tgkill": 234, "madvise": 28, "poll": 7, "ppoll": 271,
+    "pipe2": 293, "dup": 32, "getuid": 102, "getgid": 104, "geteuid": 107,
+    "getegid": 108, "getppid": 110,
+}
+_N2NAME = {v: k for k, v in SYS.items()}
+
+# pass-through set: memory management, real-file reads, process metadata the
+# simulator doesn't virtualize (regular_file.c passthrough analogue)
+_NATIVE_OK = {
+    SYS[n]
+    for n in (
+        "mmap", "mprotect", "munmap", "brk", "madvise", "rt_sigprocmask",
+        "sigaltstack", "arch_prctl", "set_tid_address", "set_robust_list",
+        "rseq", "prlimit64", "futex", "openat", "close", "fstat", "newfstatat",
+        "statx", "lseek", "pread64", "access", "readlink", "getcwd",
+        "getdents64", "uname", "fcntl", "getuid", "getgid", "geteuid",
+        "getegid", "dup", "pipe2",
+    )
+}
+
+NS_PER_SEC = 1_000_000_000
+
+
+class NativeProcess:
+    """A real Linux binary co-opted into a CpuHost's simulated time."""
+
+    # Wall-clock budget for one native compute stretch between syscalls.
+    # Time syscalls are answered in-process (no IPC), so a CPU-bound child
+    # is silent on the channel; this is a hung-child watchdog (the
+    # reference's resource watchdog, manager.rs:447-454), NOT a scheduling
+    # device — a slow machine only ever makes the sim slower, never changes
+    # results, unless a child genuinely exceeds this budget.
+    WALL_TIMEOUT_S = 60.0
+
+    def __init__(self, host, pid: int, name: str, argv: list[str],
+                 env: dict | None = None):
+        self.host = host
+        self.pid = pid  # virtual pid
+        self.name = name
+        self.argv = argv
+        self.env = env or {}
+        self.state = None  # mirrors host.process.ProcState via strings
+        self.exit_code: int | None = None
+        self.stdout: list[bytes] = []
+        self.stderr: list[bytes] = []
+        self.ipc = IpcBlock()
+        self._child: subprocess.Popen | None = None
+        self.syscall_count = 0
+        self.expected_final_state = "running"
+        self.strace = None  # fn(t, pid, name, args, ret)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Spawn the child (posix_spawn + LD_PRELOAD, managed_thread.rs:548)
+        and service it until it blocks or exits."""
+        env = dict(os.environ)
+        env.update(self.env)
+        env["LD_PRELOAD"] = shim_path()
+        env["SHADOW_SHM_PATH"] = self.ipc.path
+        self.ipc.set_time(self.host.now())
+        self._child = subprocess.Popen(
+            self.argv, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            stdin=subprocess.DEVNULL,
+        )
+        self.state = "running"
+        msg = self.ipc.recv_syscall(timeout_s=10.0)
+        if msg is None or msg[0] != MSG_START:
+            self._die(97)
+            return
+        self.ipc.reply(MSG_START_OK)
+        self._service_loop()
+
+    def _die(self, code: int):
+        self.state = "zombie"
+        self.exit_code = code
+        if self._child is not None and self._child.poll() is None:
+            self._child.kill()
+            self._child.wait()
+        self.ipc.close()
+        self.host.on_process_exit(self)
+
+    def kill(self):
+        if self.state != "zombie":
+            self._die(137)
+
+    # ---- the service loop --------------------------------------------------
+
+    def _service_loop(self):
+        """Handle syscalls until the child blocks in sim time or exits
+        (ManagedThread::resume's event loop, managed_thread.rs:187-324)."""
+        while True:
+            msg = self.ipc.recv_syscall(timeout_s=self.WALL_TIMEOUT_S)
+            if msg is None:
+                if self._child.poll() is not None:
+                    self._die(self._child.returncode)
+                else:
+                    self._die(98)  # hung child: reap (watchdog analogue)
+                return
+            _, num, args = msg
+            self.syscall_count += 1
+            self.host.counters["syscalls"] += 1
+            stop = self._handle(num, args)
+            if stop:
+                return
+
+    def _resume_after_sleep(self):
+        if self.state != "running":
+            return
+        self.ipc.set_time(self.host.now())
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+        self._service_loop()
+
+    # ---- dispatch ----------------------------------------------------------
+
+    def _handle(self, num: int, args: list[int]) -> bool:
+        """Returns True if the service loop should stop (blocked/exited)."""
+        cpid = self._child.pid
+        name = _N2NAME.get(num, str(num))
+        if self.strace is not None:
+            self.strace(self.host.now(), self.pid, name, tuple(args[:3]), None)
+
+        if num in _NATIVE_OK:
+            self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+
+        if num in (SYS["nanosleep"], SYS["clock_nanosleep"]):
+            req_ptr = args[0] if num == SYS["nanosleep"] else args[2]
+            raw = _vm_read(cpid, req_ptr, 16)
+            sec, nsec = struct.unpack("<qq", raw) if len(raw) == 16 else (0, 0)
+            t = sec * NS_PER_SEC + nsec
+            TIMER_ABSTIME = 1
+            if num == SYS["clock_nanosleep"] and args[1] & TIMER_ABSTIME:
+                wake_at = max(self.host.now(), t)  # absolute deadline
+            else:
+                wake_at = self.host.now() + max(0, t)
+            self.host.schedule(wake_at, self._resume_after_sleep)
+            return True  # parked
+
+        if num in (SYS["write"], SYS["writev"]) and args[0] in (1, 2):
+            data = self._gather_write(cpid, num, args)
+            (self.stdout if args[0] == 1 else self.stderr).append(data)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
+            return False
+
+        if num == SYS["read"]:
+            if args[0] == 0:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # stdin: EOF
+            else:
+                # real-file fds were opened natively; read them natively too
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+
+        if num == SYS["ioctl"] and args[0] in (0, 1, 2):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOTTY)
+            return False
+
+        if num == SYS["getrandom"]:
+            n = min(args[1], 1 << 20)
+            data = bytes(self.host.rng.getrandbits(8) for _ in range(n))
+            _vm_write(cpid, args[0], data)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+            return False
+
+        if num == SYS["getpid"]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self.pid)
+            return False
+        if num == SYS["gettid"]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self.pid)
+            return False
+        if num == SYS["getppid"]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 1)
+            return False
+        if num == SYS["sched_yield"]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SYS["sched_getaffinity"]:
+            # report one cpu (deterministic regardless of the real machine)
+            if args[1] >= 8:
+                _vm_write(cpid, args[2], struct.pack("<Q", 1))
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 8)
+            return False
+        if num == SYS["rt_sigaction"]:
+            # guard the shim's SIGSYS handler (shim_seccomp.c keeps SIGSYS)
+            SIGSYS = 31
+            if args[0] == SIGSYS:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # pretend success
+            else:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if num in (SYS["exit_group"], SYS["exit"]):
+            self.state = "zombie"
+            self.exit_code = args[0] & 0xFF
+            self.ipc.reply(MSG_SYSCALL_NATIVE)  # let it really exit
+            self._child.wait(timeout=10)
+            self.ipc.close()
+            self.host.on_process_exit(self)
+            return True
+        if num in (SYS["poll"], SYS["ppoll"]):
+            # no pollable emulated fds yet: sleep for the timeout, return 0
+            timeout_ms = args[2] if num == SYS["poll"] else -1
+            if num == SYS["ppoll"] and args[2]:
+                raw = _vm_read(cpid, args[2], 16)
+                if len(raw) == 16:
+                    s, ns = struct.unpack("<qq", raw)
+                    timeout_ms = (s * NS_PER_SEC + ns) // 1_000_000
+            if timeout_ms is None or timeout_ms < 0:
+                self._die(99)  # infinite poll with no fds we emulate: stuck
+                return True
+            self.host.schedule(
+                self.host.now() + timeout_ms * 1_000_000, self._resume_after_sleep
+            )
+            return True
+
+        # default: refuse with ENOSYS (surface unknown syscalls loudly)
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, -38)
+        return False
+
+    def _gather_write(self, cpid: int, num: int, args: list[int]) -> bytes:
+        if num == SYS["write"]:
+            return _vm_read(cpid, args[1], min(args[2], 1 << 20))
+        out = bytearray()
+        iov_cnt = min(args[2], 64)
+        raw = _vm_read(cpid, args[1], iov_cnt * 16)
+        for i in range(len(raw) // 16):
+            base, ln = struct.unpack_from("<QQ", raw, i * 16)
+            out += _vm_read(cpid, base, min(ln, 1 << 20))
+        return bytes(out)
+
+
+def spawn_native(host, argv: list[str], name: str | None = None,
+                 start_time: int = 0, env: dict | None = None) -> NativeProcess:
+    """Schedule a real binary onto a CpuHost (Host::add_application analogue)."""
+    host._next_pid += 1
+    proc = NativeProcess(host, host._next_pid, name or os.path.basename(argv[0]),
+                         argv, env)
+    host.processes[proc.pid] = proc
+    host.schedule(max(start_time, host.now()), proc.start)
+    return proc

@@ -143,10 +143,17 @@ def expand_hosts_hybrid(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpe
                 f"in one simulation is not supported yet"
             )
         for p in h.processes:
-            if p.path not in PROGRAM_REGISTRY:
+            if "/" in p.path:
+                # real binary for the native managed-process plane
+                if not os.path.exists(p.path):
+                    raise ConfigError(
+                        f"host {h.name!r}: binary {p.path!r} not found"
+                    )
+            elif p.path not in PROGRAM_REGISTRY:
                 raise ConfigError(
                     f"host {h.name!r}: unknown program {p.path!r}; "
-                    f"available: {sorted(PROGRAM_REGISTRY)}"
+                    f"available: {sorted(PROGRAM_REGISTRY)} "
+                    f"(use a path containing '/' for a real binary)"
                 )
         specs.append(
             HostSpec(
@@ -166,6 +173,8 @@ def expand_hosts_hybrid(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpe
                     {
                         "path": p.path,
                         "args": _program_args(p),
+                        "argv_raw": list(p.args),  # verbatim argv (native bins)
+                        "environment": dict(p.environment),
                         "start_time": p.start_time,
                         "shutdown_time": p.shutdown_time,
                         "expected_final_state": p.expected_final_state,

@@ -1,0 +1,113 @@
+"""Native managed-process plane tests: real Linux binaries co-opted via
+LD_PRELOAD shim + seccomp/SIGSYS + shared-memory futex channels (reference
+L0: src/lib/shim, managed_thread.rs; SURVEY.md §3.2-3.3)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import pytest
+
+from shadow_tpu.host import CpuHost, HostConfig
+
+pytestmark = pytest.mark.skipif(
+    not __import__("shadow_tpu.native_plane", fromlist=["ensure_built"]).ensure_built(),
+    reason="native toolchain unavailable",
+)
+
+from shadow_tpu.native_plane import spawn_native  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TEST_APP = os.path.join(REPO, "native", "build", "test_app")
+TEST_BUSY = os.path.join(REPO, "native", "build", "test_busy")
+
+SEC = 1_000_000_000
+
+
+def run_one(argv, seed=4, until=5 * SEC, start_time=0):
+    h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=seed, host_id=0))
+    p = spawn_native(h, argv, start_time=start_time)
+    h.execute(until)
+    return h, p
+
+
+def test_simulated_clock_and_nanosleep():
+    _, p = run_one([TEST_APP, "3"])
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0
+    assert "start t=0\n" in out
+    assert "tick 0 t=250000000" in out
+    assert "tick 1 t=500000000" in out
+    assert "tick 2 t=750000000" in out
+    assert "end t=750000000" in out
+
+
+def test_busy_loop_consumes_zero_simulated_time():
+    _, p = run_one([TEST_BUSY])
+    assert p.exit_code == 0, b"".join(p.stdout) + b"".join(p.stderr)
+    assert "delta_ns=0" in b"".join(p.stdout).decode()
+
+
+def test_native_determinism_and_seed():
+    a = run_one([TEST_APP, "2"])[1]
+    b = run_one([TEST_APP, "2"])[1]
+    assert b"".join(a.stdout) == b"".join(b.stdout)
+    c = run_one([TEST_APP, "2"], seed=99)[1]
+    assert b"".join(a.stdout) != b"".join(c.stdout)  # getrandom differs
+
+
+def test_two_processes_interleave_in_sim_time():
+    h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=4, host_id=0))
+    p1 = spawn_native(h, [TEST_APP, "2"])
+    p2 = spawn_native(h, [TEST_APP, "2"], start_time=100_000_000)
+    h.execute(5 * SEC)
+    assert p1.exit_code == 0 and p2.exit_code == 0
+    out2 = b"".join(p2.stdout).decode()
+    assert "start t=100000000" in out2  # started 100ms late in sim time
+    assert "tick 0 t=350000000" in out2
+
+
+def test_start_time_and_exit_code():
+    _, p = run_one([TEST_APP, "0"], start_time=1 * SEC)
+    assert p.exit_code == 0
+    assert "start t=1000000000" in b"".join(p.stdout).decode()
+
+
+def test_shim_noop_outside_simulator():
+    """Without SHADOW_SHM_PATH the preloaded shim must stand down."""
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = os.path.join(REPO, "native", "build", "libshadow_shim.so")
+    env.pop("SHADOW_SHM_PATH", None)
+    r = subprocess.run([TEST_APP, "0"], env=env, capture_output=True, timeout=30)
+    assert r.returncode == 0
+    assert b"start t=" in r.stdout  # real clock, but it ran fine
+
+
+def test_native_binary_via_config():
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.cosim import HybridSimulation
+
+    cfg = ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "2 s", "seed": 12},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {
+                "box": {
+                    "network_node_id": 0,
+                    "processes": [
+                        {
+                            "path": TEST_APP,
+                            "args": ["2"],
+                            "expected_final_state": {"exited": 0},
+                        }
+                    ],
+                }
+            },
+        }
+    )
+    sim = HybridSimulation(cfg)
+    report = sim.run()
+    assert report["process_failures"] == 0
+    proc = sim.procs[0]
+    assert "tick 1 t=500000000" in b"".join(proc.stdout).decode()
