@@ -130,6 +130,10 @@ class ExperimentalOptions:
     use_dynamic_runahead: bool = False
     interface_qdisc: str = "fifo"  # "fifo" | "round-robin" (QDiscMode, configuration.rs:960)
     use_codel: bool = True
+    # strace-style per-process syscall logs: "off" | "standard" |
+    # "deterministic" (StraceLoggingMode, configuration.rs:1162;
+    # deterministic omits anything that could differ across machines)
+    strace_logging_mode: str = "off"
     # --- TPU engine static shapes ---
     event_queue_capacity: int = 64  # per-host pending-event slots
     sends_per_host_round: int = 8  # per-host round send budget (drop above)
@@ -146,9 +150,15 @@ class ExperimentalOptions:
         for f in (
             "scheduler",
             "interface_qdisc",
+            "strace_logging_mode",
         ):
             if f in d:
                 setattr(e, f, str(d.pop(f)))
+        if e.strace_logging_mode not in ("off", "standard", "deterministic"):
+            raise ConfigError(
+                f"experimental.strace_logging_mode must be off|standard|"
+                f"deterministic, got {e.strace_logging_mode!r}"
+            )
         for f in ("use_dynamic_runahead", "use_codel"):
             if f in d:
                 setattr(e, f, bool(d.pop(f)))

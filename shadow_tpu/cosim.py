@@ -53,6 +53,7 @@ from shadow_tpu.models.hybrid import (
     PW_KEY,
     PW_SIZE,
 )
+from shadow_tpu.obs import PcapWriter, PerfTimers, StraceLogger
 from shadow_tpu.ops import merge_flat_events, next_time, pack_order
 from shadow_tpu.programs import get_program
 from shadow_tpu.simtime import NS_PER_SEC, TIME_MAX
@@ -170,6 +171,33 @@ class HybridSimulation:
                     h.schedule(p["shutdown_time"], proc.kill)
                 self.procs.append(proc)
 
+        # observability (reference §5.1: pcap per interface, strace per
+        # process, perf timers around the hot phases)
+        self.perf = PerfTimers()
+        self._pcaps = []
+        self._strace_files = []
+        data_dir = cfg.general.data_directory
+        strace_mode = cfg.experimental.strace_logging_mode
+        for s, h in zip(self.specs, self.hosts):
+            host_dir = os.path.join(data_dir, "hosts", s.name)
+            if s.pcap_enabled:
+                os.makedirs(host_dir, exist_ok=True)
+                h.pcap_lo = PcapWriter(
+                    os.path.join(host_dir, "lo.pcap"), s.pcap_capture_size
+                )
+                h.pcap_eth = PcapWriter(
+                    os.path.join(host_dir, "eth0.pcap"), s.pcap_capture_size
+                )
+                self._pcaps += [h.pcap_lo, h.pcap_eth]
+            if strace_mode != "off":
+                os.makedirs(host_dir, exist_ok=True)
+                for p in h.processes.values():
+                    f = open(
+                        os.path.join(host_dir, f"{p.name}.{p.pid}.strace"), "w"
+                    )
+                    self._strace_files.append(f)
+                    p.strace = StraceLogger(f, strace_mode)
+
         # staging + payload store
         self._staged: list[tuple[int, int, int, int, int]] = []  # src,t,dst,size,key
         self._send_seq = np.zeros((ecfg.num_hosts,), np.int64)
@@ -206,6 +234,18 @@ class HybridSimulation:
         return min(h.next_event_time() for h in self.hosts)
 
     def run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
+        try:
+            return self._run(progress=progress, log=log)
+        finally:
+            # flush observability artifacts even when a window raises, so a
+            # determinism byte-compare never sees a truncated file
+            for w in self._pcaps:
+                w.close()
+            for f in self._strace_files:
+                if not f.closed:
+                    f.close()
+
+    def _run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
         cfg = self.cfg
         stop = cfg.general.stop_time
         show_progress = cfg.general.progress if progress is None else progress
@@ -222,14 +262,17 @@ class HybridSimulation:
             if t_next >= stop:
                 break
             window_end = min(t_next + runahead, stop)
-            for h in self.hosts:  # deterministic host order
-                h.execute(window_end)
+            with self.perf.time("host_plane"):
+                for h in self.hosts:  # deterministic host order
+                    h.execute(window_end)
             # drain ALL staged sends for this window (multiple passes when a
             # burst exceeds the staging cap) so no send ever carries a stale
             # timestamp into a later window
             while True:
-                self.state = self._inject_and_run(window_end)
-                self._drain_captures()
+                with self.perf.time("device_window"):
+                    self.state = self._inject_and_run(window_end)
+                with self.perf.time("drain_captures"):
+                    self._drain_captures()
                 if not self._staged:
                     break
             windows += 1
@@ -368,6 +411,7 @@ class HybridSimulation:
             "process_failures": failures,
             "processes_exited": len(zombies),
             "determinism_digest": f"{int(np.bitwise_xor.reduce(jax.device_get(self.state.stats.digest)[:n])):016x}",
+            "perf": self.perf.report(),
             "model_report": self.model.report(
                 jax.device_get(self.state.model), None
             ),

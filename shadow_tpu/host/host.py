@@ -54,6 +54,10 @@ class CpuHost:
         self._next_pid = 1000
         # wired by the network layer: fn(host, NetPacket)
         self.egress: Callable[["CpuHost", NetPacket], None] | None = None
+        # pcap capture per interface (reference lo.pcap/eth0.pcap,
+        # pcap_writer.rs + network_interface.c); set by the sim driver
+        self.pcap_lo = None
+        self.pcap_eth = None
         # name -> ip resolution (DNS); wired by the simulation driver
         self.resolver: Callable[[str], str] | None = None
         # counters (tracker.c analogue)
@@ -115,18 +119,27 @@ class CpuHost:
         self.counters["pkts_sent"] += 1
         self.counters["bytes_sent"] += pkt.size_bytes
         if pkt.dst_ip in ("127.0.0.1", self.ip):
+            if self.pcap_lo is not None:
+                self.pcap_lo.write(self._now, pkt)
             self.schedule(
                 self._now + self.cfg.loopback_latency_ns,
-                lambda: self.deliver_packet(pkt),
+                lambda: self.deliver_packet(pkt, iface="lo"),
             )
             return
+        if self.pcap_eth is not None:
+            self.pcap_eth.write(self._now, pkt)
         if self.egress is None:
             raise RuntimeError(f"host {self.name}: no egress wired for {pkt}")
         self.egress(self, pkt)
 
-    def deliver_packet(self, pkt: NetPacket):
+    def deliver_packet(self, pkt: NetPacket, iface: str = "eth"):
+        """`iface` is set by the delivery path (loopback tags itself "lo"),
+        not re-derived from headers — a socket bound to 127.0.0.1 must never
+        show up on the eth0 capture."""
         self.counters["pkts_recv"] += 1
         self.counters["bytes_recv"] += pkt.size_bytes
+        if iface == "eth" and self.pcap_eth is not None:
+            self.pcap_eth.write(self._now, pkt)
         CallbackQueue.run(lambda q: self.netns.deliver(pkt))
 
     # ---- the event loop ----------------------------------------------------

@@ -1,0 +1,13 @@
+"""Observability plane: pcap capture, strace logging, perf timers.
+
+Reference: §5.1 of SURVEY.md — `utility/pcap_writer.rs:6-90` (per-interface
+lo/eth0 captures), the strace formatter (`host/syscall/formatter.rs`,
+modes off/standard/deterministic at configuration.rs:1162), and the
+`perf_timers` feature (host.rs:721-729).
+"""
+
+from shadow_tpu.obs.pcap import PcapWriter, packet_bytes
+from shadow_tpu.obs.strace import StraceLogger
+from shadow_tpu.obs.perf import PerfTimers
+
+__all__ = ["PcapWriter", "PerfTimers", "StraceLogger", "packet_bytes"]
