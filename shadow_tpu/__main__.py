@@ -48,7 +48,14 @@ def main(argv: list[str] | None = None) -> int:
             "e.g. --general.stop_time='10 s' --experimental.rounds_per_chunk=128"
         ),
     )
-    p.add_argument("config", help="YAML simulation config ('-' = stdin)")
+    p.add_argument(
+        "config", nargs="?", help="YAML simulation config ('-' = stdin)"
+    )
+    p.add_argument(
+        "--shm-cleanup", action="store_true",
+        help="remove orphaned shadow-ipc shared-memory files and exit "
+             "(reference: shadow --shm-cleanup, utility/shm_cleanup.rs)",
+    )
     p.add_argument("--version", action="version", version=__version__)
     p.add_argument("--progress", action="store_true", help="print a progress line")
     p.add_argument(
@@ -60,6 +67,14 @@ def main(argv: list[str] | None = None) -> int:
         help="print the sim-stats JSON to stdout after the run",
     )
     args, extra = p.parse_known_args(argv)
+
+    if args.shm_cleanup:
+        from shadow_tpu.native_plane import shm_cleanup
+
+        print(f"removed {shm_cleanup()} orphaned shm file(s)", file=sys.stderr)
+        return 0
+    if args.config is None:
+        p.error("config is required (or use --shm-cleanup)")
 
     try:
         cfg = load_config(args.config)

@@ -53,6 +53,7 @@ from shadow_tpu.models.hybrid import (
     PW_KEY,
     PW_SIZE,
 )
+from shadow_tpu.net.dns import Dns
 from shadow_tpu.obs import PcapWriter, PerfTimers, StraceLogger
 from shadow_tpu.ops import merge_flat_events, next_time, pack_order
 from shadow_tpu.programs import get_program
@@ -126,18 +127,22 @@ class HybridSimulation:
         # CPU side
         self.hosts: list[CpuHost] = []
         self.ip_to_gid: dict[str, int] = {}
-        names = {}
+        self.dns = Dns()
         for s in self.specs:
-            names[s.name] = s.ip
+            self.dns.register(s.name, s.ip)
             self.ip_to_gid[s.ip] = s.host_id
         for s in self.specs:
             h = CpuHost(
                 HostConfig(
-                    name=s.name, ip=s.ip, seed=cfg.general.seed, host_id=s.host_id
+                    name=s.name,
+                    ip=s.ip,
+                    seed=cfg.general.seed,
+                    host_id=s.host_id,
+                    model_unblocked_latency=cfg.general.model_unblocked_syscall_latency,
                 )
             )
             h.egress = self._stage_send
-            h.resolver = names.get
+            h.resolver = self.dns.resolve
             self.hosts.append(h)
         self.procs = []
         for s, h in zip(self.specs, self.hosts):
@@ -424,6 +429,8 @@ class HybridSimulation:
             yaml.safe_dump(self.cfg.to_dict(), f, sort_keys=False)
         with open(os.path.join(data_dir, "sim-stats.json"), "w") as f:
             json.dump(report or self.stats_report(), f, indent=2)
+        with open(os.path.join(data_dir, "hosts.txt"), "w") as f:
+            f.write(self.dns.hosts_file())  # reference per-host hostname files
         for spec, host in zip(self.specs, self.hosts):
             hd = os.path.join(data_dir, "hosts", spec.name)
             os.makedirs(hd, exist_ok=True)

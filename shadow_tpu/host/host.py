@@ -35,6 +35,14 @@ class HostConfig:
     seed: int = 0
     host_id: int = 0
     loopback_latency_ns: int = 0  # loopback relays same-round in reference
+    # unblocked-syscall CPU-latency model (reference handler/mod.rs:268-318 +
+    # `model_unblocked_syscall_latency`): after `unblocked_syscall_limit`
+    # consecutive non-blocking syscalls a process is charged
+    # `unblocked_syscall_latency_ns` of simulated time, so busy-loops that
+    # poll without blocking cannot freeze the simulated clock
+    model_unblocked_latency: bool = False
+    unblocked_syscall_limit: int = 1024
+    unblocked_syscall_latency_ns: int = 1_000
 
 
 class CpuHost:

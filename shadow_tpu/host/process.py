@@ -115,17 +115,34 @@ class Process:
         """Run until the program blocks or exits (Thread::resume)."""
         CallbackQueue.run(lambda q: self._resume_inner())
 
+    _unblocked_run = 0  # consecutive syscalls completed without blocking
+
     def _resume_inner(self):
+        cfg = self.host.cfg
         while self.state == ProcState.RUNNING:
             if self._current is None:
                 self._current = self._advance(self._send_value, None)
                 if self._current is None:
                     return
                 self._send_value = None
+            if (
+                cfg.model_unblocked_latency
+                and self._unblocked_run >= cfg.unblocked_syscall_limit
+            ):
+                # charge CPU latency: park, then re-run this same syscall
+                self._unblocked_run = 0
+                self._block(
+                    Blocked(timeout=self.host.now() + cfg.unblocked_syscall_latency_ns)
+                )
+                return
             try:
                 res = self.host.syscalls.execute(self, self._current)
             except OSError as e:
-                # errno surfaces in the program as a raised exception
+                # errno surfaces in the program as a raised exception; it
+                # still counts toward the unblocked-syscall charge (an
+                # error-polling retry loop is exactly the busy loop the
+                # latency model exists to escape)
+                self._unblocked_run += 1
                 if self.strace is not None:
                     self.strace(
                         self.host.now(), self.pid, self._current.name,
@@ -134,8 +151,10 @@ class Process:
                 self._current = self._advance(None, e)
                 continue
             if isinstance(res, Blocked):
+                self._unblocked_run = 0
                 self._block(res)
                 return
+            self._unblocked_run += 1
             if self.strace is not None:
                 self.strace(
                     self.host.now(), self.pid, self._current.name,

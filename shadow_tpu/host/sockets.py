@@ -21,6 +21,7 @@ PROTO_UDP = 17
 PROTO_TCP = 6
 
 UDP_RCVBUF_PACKETS = 256
+UDP_MAX_PAYLOAD = 65507  # IPv4 datagram limit (65535 - 20 IP - 8 UDP)
 
 
 @dataclass
@@ -85,6 +86,8 @@ class UdpSocket(_SocketBase):
         self.peer_port = port
 
     def sendto(self, data: bytes, addr: tuple[str, int] | None = None) -> int:
+        if len(data) > UDP_MAX_PAYLOAD:
+            raise OSError(f"EMSGSIZE: datagram of {len(data)} bytes")
         if addr is None:
             if self.peer_ip is None:
                 raise OSError("EDESTADDRREQ")

@@ -93,6 +93,26 @@ def _vm_write(pid: int, addr: int, data: bytes) -> int:
     return got
 
 
+def shm_cleanup() -> int:
+    """Unlink IPC files whose owning simulator process is gone (reference
+    `shadow --shm-cleanup`, utility/shm_cleanup.rs — which also checks
+    creator-PID liveness). Returns the number removed."""
+    import glob
+    import re
+
+    removed = 0
+    for path in glob.glob("/dev/shm/shadow-ipc-*"):
+        m = re.match(r".*/shadow-ipc-(\d+)-", path)
+        if m and os.path.exists(f"/proc/{m.group(1)}"):
+            continue  # owner still alive
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
 # ---- build helper ----------------------------------------------------------
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
@@ -122,7 +142,11 @@ class IpcBlock:
     """One shared-memory block (file-backed) mirroring native/ipc.h."""
 
     def __init__(self):
-        fd, self.path = tempfile.mkstemp(prefix="shadow-ipc-", dir="/dev/shm")
+        # owner pid is embedded in the name so shm_cleanup() can check
+        # liveness before unlinking (reference utility/shm_cleanup.rs)
+        fd, self.path = tempfile.mkstemp(
+            prefix=f"shadow-ipc-{os.getpid()}-", dir="/dev/shm"
+        )
         os.ftruncate(fd, IPC_SIZE)
         self._mm = mmap.mmap(fd, IPC_SIZE)
         os.close(fd)

@@ -39,8 +39,11 @@ def _checksum(data: bytes) -> int:
 
 
 def packet_bytes(pkt: NetPacket) -> bytes:
-    """Synthesize an Ethernet+IPv4+{UDP,TCP} frame for `pkt`."""
-    payload = pkt.payload
+    """Synthesize an Ethernet+IPv4+{UDP,TCP} frame for `pkt`.
+
+    Payloads are truncated to what IPv4 length fields can carry — the
+    capture path must never be able to abort a simulation."""
+    payload = pkt.payload[:65495]
     if pkt.proto == PROTO_UDP:
         transport = struct.pack(
             "!HHHH", pkt.src_port, pkt.dst_port, 8 + len(payload), 0

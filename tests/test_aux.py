@@ -1,0 +1,257 @@
+"""Auxiliary subsystems: DNS, checkpoint/resume, unblocked-syscall latency
+model, parse/plot tools, shm-cleanup (SURVEY.md §5 + §2.1 dns.c/tracker)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.net.dns import Dns, DnsError
+
+MS = 1_000_000
+SEC = 1_000_000_000
+
+
+# --------------------------------------------------------------------- dns
+
+
+def test_dns_register_resolve_reverse():
+    d = Dns()
+    d.register("alpha", "10.0.0.1")
+    d.register("beta", "10.0.0.2")
+    assert d.resolve("alpha") == "10.0.0.1"
+    assert d.resolve("10.0.0.9") == "10.0.0.9"  # literal passthrough
+    assert d.resolve("gamma") is None
+    assert d.reverse("10.0.0.2") == "beta"
+    with pytest.raises(DnsError):
+        d.register("alpha", "10.0.0.3")
+    with pytest.raises(DnsError):
+        d.register("other", "10.0.0.1")
+    hosts = d.hosts_file()
+    assert "10.0.0.1 alpha" in hosts and hosts.startswith("127.0.0.1 localhost")
+
+
+# -------------------------------------------------------------- checkpoint
+
+
+def _model_cfg(stop="4 s"):
+    return ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": stop, "seed": 17},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {
+                "n": {
+                    "count": 16,
+                    "network_node_id": 0,
+                    "processes": [
+                        {
+                            "model": "phold",
+                            "model_args": {
+                                "population": 2,
+                                "mean_delay": "100 ms",
+                                "size_bytes": 64,
+                            },
+                        }
+                    ],
+                }
+            },
+        }
+    )
+
+
+def test_checkpoint_roundtrip_resumes_identically(tmp_path):
+    from shadow_tpu.core.checkpoint import load_checkpoint, save_checkpoint
+    from shadow_tpu.sim import Simulation
+
+    # run A: straight to the end
+    a = Simulation(_model_cfg(), world=1)
+    a.run(progress=False)
+    digest_a = a.stats_report()["determinism_digest"]
+
+    # run B: stop half-way (engine chunks of 64 rounds), checkpoint, restore
+    # into a FRESH simulation, continue to the end
+    b = Simulation(_model_cfg(), world=1)
+    b.state = b.engine.run_chunk(b.state, b.params)  # partial progress
+    assert not bool(b.state.done)
+    ckpt = str(tmp_path / "sim.npz")
+    save_checkpoint(ckpt, b)
+
+    c = Simulation(_model_cfg(), world=1)
+    load_checkpoint(ckpt, c)
+    assert int(c.state.now) == int(b.state.now)
+    c.run(progress=False)
+    assert c.stats_report()["determinism_digest"] == digest_a
+
+
+def test_checkpoint_rejects_mismatched_config(tmp_path):
+    from shadow_tpu.core.checkpoint import (
+        CheckpointError,
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from shadow_tpu.sim import Simulation
+
+    a = Simulation(_model_cfg(), world=1)
+    ckpt = str(tmp_path / "sim.npz")
+    save_checkpoint(ckpt, a)
+    other = _model_cfg(stop="9 s")  # different engine config
+    b = Simulation(other, world=1)
+    with pytest.raises(CheckpointError):
+        load_checkpoint(ckpt, b)
+
+
+# ------------------------------------------- unblocked-syscall latency model
+
+
+def test_busy_polling_program_advances_clock_when_modeled():
+    from shadow_tpu.host import CpuHost, HostConfig
+
+    def poller(ctx):
+        # getpid in a tight loop never blocks; without the latency model the
+        # simulated clock would freeze (reference handler/mod.rs:268-318)
+        for _ in range(3000):
+            yield ("getpid",)
+        t = yield ("clock_gettime",)
+        assert t > 0, "clock never advanced under busy polling"
+        yield ("exit", 0)
+
+    h = CpuHost(
+        HostConfig(
+            name="h",
+            ip="10.0.0.1",
+            model_unblocked_latency=True,
+            unblocked_syscall_limit=1000,
+            unblocked_syscall_latency_ns=1000,
+        )
+    )
+    p = h.spawn(poller)
+    h.execute(1 * SEC)
+    assert p.exit_code == 0, p.stderr
+    assert h.now() >= 2000  # at least two forced charges
+
+
+def test_busy_polling_freezes_clock_when_not_modeled():
+    from shadow_tpu.host import CpuHost, HostConfig
+
+    seen = []
+
+    def poller(ctx):
+        for _ in range(3000):
+            yield ("getpid",)
+        seen.append((yield ("clock_gettime",)))
+        yield ("exit", 0)
+
+    h = CpuHost(HostConfig(name="h", ip="10.0.0.1"))
+    h.spawn(poller)
+    h.execute(1 * SEC)
+    assert seen == [0]
+
+
+# ------------------------------------------------------------------- tools
+
+
+def test_parse_and_plot_tools(tmp_path):
+    from shadow_tpu.cosim import HybridSimulation
+
+    cfg = ConfigOptions.from_dict(
+        {
+            "general": {
+                "stop_time": "1 s",
+                "seed": 2,
+                "data_directory": str(tmp_path / "data"),
+            },
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {
+                "server": {
+                    "network_node_id": 0,
+                    "processes": [{"path": "udp_echo_server"}],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server", "count=2"],
+                            "expected_final_state": {"exited": 0},
+                        }
+                    ],
+                },
+            },
+        }
+    )
+    sim = HybridSimulation(cfg)
+    sim.write_outputs(report=sim.run())
+    log = tmp_path / "run.log"
+    log.write_text(
+        "[heartbeat] sim_time=0.500s wall=1.20s windows=10 ratio=0.42x\n"
+        "[heartbeat] sim_time=1.000s wall=2.50s windows=20 ratio=0.40x\n"
+    )
+    parsed = tmp_path / "parsed.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            "tools/parse_shadow.py",
+            str(tmp_path / "data"),
+            "--log",
+            str(log),
+            "-o",
+            str(parsed),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    data = json.loads(parsed.read_text())
+    assert data["sim_stats"]["process_failures"] == 0
+    assert set(data["hosts"]) == {"server", "client"}
+    assert len(data["heartbeats"]) == 2
+    assert data["heartbeats"][0]["sim"] == 0.5
+
+    plot = subprocess.run(
+        [
+            sys.executable,
+            "tools/plot_shadow.py",
+            str(parsed),
+            "-o",
+            str(tmp_path / "plot.png"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert plot.returncode in (0, 3)  # 3 = matplotlib unavailable
+    if plot.returncode == 0:
+        assert (tmp_path / "plot.png").exists()
+
+
+def test_shm_cleanup_liveness(tmp_path):
+    import os
+
+    from shadow_tpu.native_plane import shm_cleanup
+
+    dead = "/dev/shm/shadow-ipc-999999999-junk"  # pid can't exist
+    alive = f"/dev/shm/shadow-ipc-{os.getpid()}-held"
+    open(dead, "w").write("x")
+    open(alive, "w").write("x")
+    try:
+        shm_cleanup()
+        assert not os.path.exists(dead)  # orphan removed
+        assert os.path.exists(alive)  # live owner's file kept
+    finally:
+        for p in (dead, alive):
+            if os.path.exists(p):
+                os.unlink(p)
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu", "--shm-cleanup"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0
+    assert "removed" in r.stderr

@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Parse a simulation data directory (or driver log) into one summary JSON.
+
+Reference: `src/tools/parse-shadow.py` — parses Shadow's log + data dir
+into a json blob for plotting. Inputs here: the data dir written by
+`shadow_tpu` (sim-stats.json, hosts/<name>/host-stats.json, *.stdout) and
+optionally a stderr log with `[heartbeat] ...` lines.
+
+Usage: parse_shadow.py DATA_DIR [--log run.stderr] [-o out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+HEARTBEAT_RE = re.compile(
+    r"\[heartbeat\] sim_time=(?P<sim>[\d.]+)s wall=(?P<wall>[\d.]+)s "
+    r"(?:events=(?P<events>\d+) )?(?:rounds=(?P<rounds>\d+) |windows=(?P<windows>\d+) )?"
+    r"ratio=(?P<ratio>[\d.]+)x"
+)
+
+
+def parse_heartbeats(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            m = HEARTBEAT_RE.search(line)
+            if m:
+                d = {k: v for k, v in m.groupdict().items() if v is not None}
+                out.append(
+                    {k: float(v) if "." in v else int(v) for k, v in d.items()}
+                )
+    return out
+
+
+def parse_data_dir(data_dir: str) -> dict:
+    out: dict = {"data_dir": os.path.abspath(data_dir)}
+    stats_path = os.path.join(data_dir, "sim-stats.json")
+    if os.path.exists(stats_path):
+        out["sim_stats"] = json.load(open(stats_path))
+    hosts_dir = os.path.join(data_dir, "hosts")
+    hosts = {}
+    if os.path.isdir(hosts_dir):
+        for name in sorted(os.listdir(hosts_dir)):
+            hd = os.path.join(hosts_dir, name)
+            entry: dict = {}
+            hs = os.path.join(hd, "host-stats.json")
+            if os.path.exists(hs):
+                entry["stats"] = json.load(open(hs))
+            entry["stdout_files"] = sorted(
+                f for f in os.listdir(hd) if f.endswith(".stdout")
+            )
+            entry["strace_files"] = sorted(
+                f for f in os.listdir(hd) if f.endswith(".strace")
+            )
+            entry["pcap_files"] = sorted(
+                f for f in os.listdir(hd) if f.endswith(".pcap")
+            )
+            hosts[name] = entry
+    out["hosts"] = hosts
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("data_dir")
+    p.add_argument("--log", help="driver stderr log with [heartbeat] lines")
+    p.add_argument("-o", "--output", help="write JSON here (default stdout)")
+    args = p.parse_args(argv)
+    result = parse_data_dir(args.data_dir)
+    if args.log:
+        result["heartbeats"] = parse_heartbeats(args.log)
+    text = json.dumps(result, indent=2)
+    if args.output:
+        open(args.output, "w").write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

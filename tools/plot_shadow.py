@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Plot parsed simulation summaries (reference: src/tools/plot-shadow.py).
+
+Takes one or more JSON files from parse_shadow.py and renders:
+  - sim-time vs wall-time progress (heartbeats), one line per run
+  - per-host packet counters as a bar chart
+
+Usage: plot_shadow.py parsed.json [parsed2.json ...] -o out.png
+Requires matplotlib (optional dependency; exits 3 with a message if absent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+")
+    p.add_argument("-o", "--output", default="shadow-plot.png")
+    args = p.parse_args(argv)
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib not available; cannot plot", file=sys.stderr)
+        return 3
+
+    runs = [(path, json.load(open(path))) for path in args.inputs]
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12, 4.5))
+
+    for path, data in runs:
+        hb = data.get("heartbeats") or []
+        if hb:
+            ax1.plot(
+                [h["wall"] for h in hb],
+                [h["sim"] for h in hb],
+                marker="o",
+                markersize=2.5,
+                label=path,
+            )
+    ax1.set_xlabel("wall time (s)")
+    ax1.set_ylabel("simulated time (s)")
+    ax1.set_title("progress")
+    if any(d.get("heartbeats") for _, d in runs):
+        ax1.legend(fontsize=7)
+
+    path, data = runs[0]
+    hosts = data.get("hosts") or {}
+    names, sent = [], []
+    for name, entry in hosts.items():
+        st = entry.get("stats") or {}
+        key = "packets_sent" if "packets_sent" in st else "pkts_sent"
+        if key in st:
+            names.append(name)
+            sent.append(st[key])
+    if names:
+        ax2.bar(range(len(names)), sent)
+        ax2.set_xticks(range(len(names)), names, rotation=60, fontsize=7)
+        ax2.set_ylabel("packets sent")
+        ax2.set_title(f"per-host traffic ({path})")
+
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
