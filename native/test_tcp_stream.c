@@ -1,0 +1,76 @@
+/* Real-binary TCP streamer: `server PORT` accepts one connection and drains
+ * it; `client IP PORT BYTES` streams BYTES and half-closes. Exercises the
+ * emulated TCP socket surface end to end (handshake, flow control,
+ * retransmission under loss, FIN). */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int serve(int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    a.sin_addr.s_addr = INADDR_ANY;
+    if (bind(fd, (struct sockaddr *)&a, sizeof a)) { perror("bind"); return 1; }
+    if (listen(fd, 8)) { perror("listen"); return 1; }
+    printf("listening\n");
+    fflush(stdout);
+    struct sockaddr_in peer;
+    socklen_t plen = sizeof peer;
+    int c = accept(fd, (struct sockaddr *)&peer, &plen);
+    if (c < 0) { perror("accept"); return 1; }
+    char ip[32];
+    inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof ip);
+    long total = 0, sum = 0;
+    char buf[65536];
+    ssize_t n;
+    while ((n = recv(c, buf, sizeof buf, 0)) > 0) {
+        total += n;
+        for (ssize_t i = 0; i < n; i++) sum += (unsigned char)buf[i];
+    }
+    if (n < 0) { perror("recv"); return 1; }
+    printf("from %s got %ld bytes sum %ld\n", ip, total, sum);
+    close(c);
+    return 0;
+}
+
+static int run_client(const char *ip, int port, long bytes) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    inet_pton(AF_INET, ip, &a.sin_addr);
+    if (connect(fd, (struct sockaddr *)&a, sizeof a)) { perror("connect"); return 1; }
+    printf("connected\n");
+    fflush(stdout);
+    char block[16384];
+    for (size_t i = 0; i < sizeof block; i++) block[i] = (char)(i % 251);
+    long sent = 0, sum = 0;
+    while (sent < bytes) {
+        size_t want = sizeof block;
+        if ((long)want > bytes - sent) want = bytes - sent;
+        ssize_t n = send(fd, block, want, 0);
+        if (n < 0) { perror("send"); return 1; }
+        for (ssize_t i = 0; i < n; i++) sum += (unsigned char)block[i];
+        sent += n;
+    }
+    shutdown(fd, SHUT_WR);
+    printf("sent %ld bytes sum %ld\n", sent, sum);
+    close(fd);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc >= 2 && strcmp(argv[1], "server") == 0)
+        return serve(argc > 2 ? atoi(argv[2]) : 8080);
+    if (argc >= 4)
+        return run_client(argv[1], atoi(argv[2]), atol(argv[3]));
+    fprintf(stderr, "usage: %s server PORT | IP PORT BYTES\n", argv[0]);
+    return 2;
+}

@@ -1,0 +1,46 @@
+/* Real-binary UDP client: sends pings to a server over the SIMULATED
+ * network and verifies the echoed replies + the simulated RTT. */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000L + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    const char *ip = argc > 1 ? argv[1] : "127.0.0.1";
+    int port = argc > 2 ? atoi(argv[2]) : 9000;
+    int count = argc > 3 ? atoi(argv[3]) : 3;
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in dst = {0};
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons(port);
+    if (inet_pton(AF_INET, ip, &dst.sin_addr) != 1) { perror("inet_pton"); return 1; }
+    if (connect(fd, (struct sockaddr *)&dst, sizeof dst)) { perror("connect"); return 1; }
+    char buf[512];
+    for (int i = 0; i < count; i++) {
+        char msg[64];
+        int n = snprintf(msg, sizeof msg, "ping %d", i);
+        long t0 = now_ns();
+        if (send(fd, msg, n, 0) != n) { perror("send"); return 1; }
+        ssize_t got = recv(fd, buf, sizeof buf, 0);
+        if (got < 0) { perror("recv"); return 1; }
+        long rtt = now_ns() - t0;
+        buf[got] = 0;
+        printf("reply %d: %s rtt_ns=%ld\n", i, buf, rtt);
+        fflush(stdout);
+        struct timespec d = {0, 100 * 1000 * 1000};
+        nanosleep(&d, NULL);
+    }
+    printf("client done\n");
+    return 0;
+}

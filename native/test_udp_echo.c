@@ -1,0 +1,40 @@
+/* Real-binary UDP echo server: binds a port, echoes datagrams upper-cased.
+ * The analogue of the reference's socket test servers (src/test/socket/). */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <ctype.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    int port = argc > 1 ? atoi(argv[1]) : 9000;
+    int count = argc > 2 ? atoi(argv[2]) : 0; /* 0 = serve forever */
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    struct sockaddr_in addr = {0};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    addr.sin_addr.s_addr = INADDR_ANY;
+    if (bind(fd, (struct sockaddr *)&addr, sizeof addr)) { perror("bind"); return 1; }
+    printf("listening on %d\n", port);
+    fflush(stdout);
+    char buf[2048];
+    int served = 0;
+    while (count == 0 || served < count) {
+        struct sockaddr_in src;
+        socklen_t slen = sizeof src;
+        ssize_t n = recvfrom(fd, buf, sizeof buf, 0, (struct sockaddr *)&src, &slen);
+        if (n < 0) { perror("recvfrom"); return 1; }
+        for (ssize_t i = 0; i < n; i++) buf[i] = toupper((unsigned char)buf[i]);
+        if (sendto(fd, buf, n, 0, (struct sockaddr *)&src, slen) != n) {
+            perror("sendto"); return 1;
+        }
+        served++;
+    }
+    printf("served %d\n", served);
+    return 0;
+}

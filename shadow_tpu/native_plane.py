@@ -122,9 +122,16 @@ def shim_path() -> str:
     return os.path.join(_NATIVE_DIR, "build", "libshadow_shim.so")
 
 
+_ARTIFACTS = (
+    "libshadow_shim.so", "test_app", "test_busy", "test_udp_echo",
+    "test_udp_client", "test_tcp_stream",
+)
+
+
 def ensure_built() -> bool:
     """Build the native plane if needed; False if no toolchain."""
-    if os.path.exists(shim_path()):
+    build = os.path.join(_NATIVE_DIR, "build")
+    if all(os.path.exists(os.path.join(build, a)) for a in _ARTIFACTS):
         return True
     try:
         subprocess.run(
@@ -133,7 +140,7 @@ def ensure_built() -> bool:
         )
     except (subprocess.SubprocessError, FileNotFoundError):
         return False
-    return os.path.exists(shim_path())
+    return all(os.path.exists(os.path.join(build, a)) for a in _ARTIFACTS)
 
 
 # ---- IPC block -------------------------------------------------------------
@@ -232,6 +239,10 @@ SYS = {
     "kill": 62, "tgkill": 234, "madvise": 28, "poll": 7, "ppoll": 271,
     "pipe2": 293, "dup": 32, "getuid": 102, "getgid": 104, "geteuid": 107,
     "getegid": 108, "getppid": 110,
+    # sockets
+    "socket": 41, "connect": 42, "accept": 43, "sendto": 44, "recvfrom": 45,
+    "shutdown": 48, "bind": 49, "listen": 50, "getsockname": 51,
+    "getpeername": 52, "setsockopt": 54, "getsockopt": 55, "accept4": 288,
 }
 _N2NAME = {v: k for k, v in SYS.items()}
 
@@ -242,14 +253,63 @@ _NATIVE_OK = {
     for n in (
         "mmap", "mprotect", "munmap", "brk", "madvise", "rt_sigprocmask",
         "sigaltstack", "arch_prctl", "set_tid_address", "set_robust_list",
-        "rseq", "prlimit64", "futex", "openat", "close", "fstat", "newfstatat",
+        "rseq", "prlimit64", "futex", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
-        "getdents64", "uname", "fcntl", "getuid", "getgid", "geteuid",
+        "getdents64", "uname", "getuid", "getgid", "geteuid",
         "getegid", "dup", "pipe2",
     )
 }
 
+# emulated sockets hand out fds in this range so the two fd spaces (the
+# child's real kernel fds vs the simulator's virtual sockets) can't collide
+VFD_BASE = 1000
+
+AF_INET = 2
+SOCK_STREAM = 1
+SOCK_DGRAM = 2
+SOCK_TYPE_MASK = 0xFF
+SOCK_NONBLOCK = 0x800
+EAGAIN = 11
+EBADF = 9
+ENOTCONN = 107
+ECONNREFUSED = 111
+ECONNRESET = 104
+EAFNOSUPPORT = 97
+EINVAL = 22
+EMSGSIZE = 90
+
+
+def _errno_of(e: OSError) -> int:
+    """Map host-plane OSErrors (message-prefixed like 'EMSGSIZE: ...', the
+    reference errno-name convention) to a negative errno for the child."""
+    name = str(e).split(":")[0].strip()
+    return -getattr(errno, name, errno.EINVAL)
+
+
+def _parse_sockaddr_in(raw: bytes) -> tuple[str, int] | None:
+    if len(raw) < 8:
+        return None
+    family, port = struct.unpack_from("<H", raw, 0)[0], struct.unpack_from(">H", raw, 2)[0]
+    if family != AF_INET:
+        return None
+    ip = ".".join(str(b) for b in raw[4:8])
+    return ip, port
+
+
+def _build_sockaddr_in(ip: str, port: int) -> bytes:
+    parts = bytes(int(x) for x in (ip or "0.0.0.0").split("."))
+    return struct.pack("<H", AF_INET) + struct.pack(">H", port or 0) + parts + b"\x00" * 8
+
 NS_PER_SEC = 1_000_000_000
+
+_SOCKET_SYSCALLS = {
+    SYS[n]
+    for n in (
+        "socket", "connect", "accept", "accept4", "sendto", "recvfrom",
+        "shutdown", "bind", "listen", "getsockname", "getpeername",
+        "setsockopt", "getsockopt",
+    )
+}
 
 
 class NativeProcess:
@@ -279,6 +339,12 @@ class NativeProcess:
         self.syscall_count = 0
         self.expected_final_state = "running"
         self.strace = None  # fn(t, pid, name, args, ret)
+        # virtual fds: emulated sockets living in the host's netns
+        self._vfds: dict[int, object] = {}
+        self._vfd_flags: dict[int, int] = {}  # O_NONBLOCK etc.
+        self._next_vfd = VFD_BASE
+        self._wake: list = []  # (file, listener) pairs while blocked
+        self._poll_deadline: int | None = None  # absolute poll timeout
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -306,6 +372,10 @@ class NativeProcess:
     def _die(self, code: int):
         self.state = "zombie"
         self.exit_code = code
+        self._clear_wake()
+        for sock in self._vfds.values():  # peers see HUP/RST, not silence
+            sock.close()
+        self._vfds.clear()
         if self._child is not None and self._child.poll() is None:
             self._child.kill()
             self._child.wait()
@@ -343,6 +413,44 @@ class NativeProcess:
         self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
         self._service_loop()
 
+    # ---- blocking on emulated files ---------------------------------------
+
+    def _block_on(self, files_masks, num: int, args: list[int],
+                  timeout_ns: int | None = None):
+        """Park this process until any watched file shows its mask (or the
+        timeout fires), then RE-RUN the same syscall — the reference's
+        SyscallCondition semantics (condition.rs:36-108)."""
+        from shadow_tpu.host.filestate import StatusListener
+
+        def wake(_s=None, _c=None):
+            if not self._wake:
+                return
+            self._clear_wake()
+            self.host.schedule(self.host.now(), retry)
+
+        def retry():
+            if self.state != "running":
+                return
+            self.ipc.set_time(self.host.now())
+            if not self._handle(num, args):
+                self._service_loop()
+
+        for f, mask in files_masks:
+            lst = StatusListener(mask, wake)
+            f.add_listener(lst)
+            self._wake.append((f, lst))
+        if timeout_ns is not None:
+            token = self.host.schedule(self.host.now() + timeout_ns, wake)
+            self._wake.append((None, token))
+
+    def _clear_wake(self):
+        for f, l in self._wake:
+            if f is None:
+                self.host.cancel(l)
+            else:
+                f.remove_listener(l)
+        self._wake = []
+
     # ---- dispatch ----------------------------------------------------------
 
     def _handle(self, num: int, args: list[int]) -> bool:
@@ -352,6 +460,31 @@ class NativeProcess:
         if self.strace is not None:
             self.strace(self.host.now(), self.pid, name, tuple(args[:3]), None)
 
+        if num in _SOCKET_SYSCALLS:
+            return self._handle_socket(num, args)
+        if num == SYS["close"]:
+            if args[0] in self._vfds:
+                sock = self._vfds.pop(args[0])
+                self._vfd_flags.pop(args[0], None)
+                sock.close()
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            else:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if num == SYS["fcntl"]:
+            if args[0] not in self._vfds:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+                return False
+            F_GETFL, F_SETFL = 3, 4
+            if args[1] == F_SETFL:
+                self._vfd_flags[args[0]] = args[2]
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            elif args[1] == F_GETFL:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, self._vfd_flags.get(args[0], 0))
+            else:
+                # F_DUPFD etc: unsupported on emulated sockets — fail loudly
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
         if num in _NATIVE_OK:
             self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
@@ -374,6 +507,11 @@ class NativeProcess:
             (self.stdout if args[0] == 1 else self.stderr).append(data)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
             return False
+
+        if num == SYS["write"] and args[0] in self._vfds:
+            return self._handle_socket(SYS["sendto"], [args[0], args[1], args[2], 0, 0, 0])
+        if num == SYS["read"] and args[0] in self._vfds:
+            return self._handle_socket(SYS["recvfrom"], [args[0], args[1], args[2], 0, 0, 0])
 
         if num == SYS["read"]:
             if args[0] == 0:
@@ -423,29 +561,326 @@ class NativeProcess:
         if num in (SYS["exit_group"], SYS["exit"]):
             self.state = "zombie"
             self.exit_code = args[0] & 0xFF
+            self._clear_wake()
+            for sock in self._vfds.values():
+                sock.close()
+            self._vfds.clear()
             self.ipc.reply(MSG_SYSCALL_NATIVE)  # let it really exit
             self._child.wait(timeout=10)
             self.ipc.close()
             self.host.on_process_exit(self)
             return True
         if num in (SYS["poll"], SYS["ppoll"]):
-            # no pollable emulated fds yet: sleep for the timeout, return 0
-            timeout_ms = args[2] if num == SYS["poll"] else -1
-            if num == SYS["ppoll"] and args[2]:
-                raw = _vm_read(cpid, args[2], 16)
-                if len(raw) == 16:
-                    s, ns = struct.unpack("<qq", raw)
-                    timeout_ms = (s * NS_PER_SEC + ns) // 1_000_000
-            if timeout_ms is None or timeout_ms < 0:
-                self._die(99)  # infinite poll with no fds we emulate: stuck
-                return True
-            self.host.schedule(
-                self.host.now() + timeout_ms * 1_000_000, self._resume_after_sleep
-            )
-            return True
+            return self._handle_poll(num, args)
 
         # default: refuse with ENOSYS (surface unknown syscalls loudly)
         self.ipc.reply(MSG_SYSCALL_COMPLETE, -38)
+        return False
+
+    def _handle_poll(self, num: int, args: list[int]) -> bool:
+        """poll/ppoll over emulated-socket vfds (reference poll.c/select.c
+        handlers). Real kernel fds in the set are reported with revents=0;
+        only vfds are pollable here."""
+        from shadow_tpu.host.filestate import FileState
+
+        POLLIN, POLLOUT, POLLERR, POLLHUP = 1, 4, 8, 0x10
+        cpid = self._child.pid
+        nfds = min(args[1], 64)
+        raw = _vm_read(cpid, args[0], nfds * 8)
+        fds = [
+            struct.unpack_from("<ihh", raw, i * 8) for i in range(len(raw) // 8)
+        ]
+        timeout_ms = args[2] if num == SYS["poll"] else -1
+        if num == SYS["ppoll"] and args[2]:
+            ts = _vm_read(cpid, args[2], 16)
+            if len(ts) == 16:
+                s, ns = struct.unpack("<qq", ts)
+                timeout_ms = (s * NS_PER_SEC + ns) // 1_000_000
+
+        ready = 0
+        out = bytearray(raw)
+        watch = []
+        for i, (fd, events, _) in enumerate(fds):
+            revents = 0
+            sock = self._vfds.get(fd)
+            if sock is not None:
+                st = sock.state
+                if events & POLLIN and st & (
+                    FileState.READABLE | FileState.ACCEPTABLE
+                ):
+                    revents |= POLLIN
+                if events & POLLOUT and st & FileState.WRITABLE:
+                    revents |= POLLOUT
+                if st & FileState.ERROR:
+                    revents |= POLLERR
+                if st & (FileState.HUP | FileState.CLOSED):
+                    revents |= POLLHUP
+                mask = FileState.ERROR | FileState.HUP | FileState.CLOSED
+                if events & POLLIN:
+                    mask |= FileState.READABLE | FileState.ACCEPTABLE
+                if events & POLLOUT:
+                    mask |= FileState.WRITABLE
+                watch.append((sock, mask))
+            struct.pack_into("<h", out, i * 8 + 6, revents)
+            if revents:
+                ready += 1
+        now = self.host.now()
+        if ready:
+            self._poll_deadline = None
+            _vm_write(cpid, args[0], bytes(out))
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, ready)
+            return False
+        if timeout_ms == 0 or (
+            self._poll_deadline is not None and now >= self._poll_deadline
+        ):
+            self._poll_deadline = None
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if not watch and timeout_ms < 0:
+            self._die(99)  # infinite poll with nothing we can ever signal
+            return True
+        if timeout_ms < 0:
+            self._block_on(watch, num, args)
+        else:
+            # absolute deadline survives re-runs so a timeout wake that
+            # finds nothing ready reports 0 instead of re-arming in full
+            if self._poll_deadline is None:
+                self._poll_deadline = now + timeout_ms * 1_000_000
+            self._block_on(watch, num, args,
+                           timeout_ns=self._poll_deadline - now)
+        return True
+
+    # ---- emulated sockets (the real-binary face of host/sockets.py;
+    # reference: the inet syscall family, handler/mod.rs socket arms) ------
+
+    def _nonblock(self, fd: int) -> bool:
+        O_NONBLOCK = 0x800
+        return bool(self._vfd_flags.get(fd, 0) & O_NONBLOCK)
+
+    def _sock(self, fd: int):
+        return self._vfds.get(fd)
+
+    def _handle_socket(self, num: int, args: list[int]) -> bool:
+        from shadow_tpu.host.filestate import FileState
+        from shadow_tpu.host.sockets import (
+            TcpListenerSocket,
+            TcpSocket,
+            UdpSocket,
+        )
+
+        cpid = self._child.pid
+        S = SYS
+        reply = self.ipc.reply
+
+        if num == S["socket"]:
+            domain, typ = args[0], args[1]
+            if domain != AF_INET:
+                reply(MSG_SYSCALL_COMPLETE, -EAFNOSUPPORT)
+                return False
+            kind = typ & SOCK_TYPE_MASK
+            if kind == SOCK_DGRAM:
+                sock = UdpSocket(self.host.netns)
+            elif kind == SOCK_STREAM:
+                sock = TcpSocket(self.host.netns)
+            else:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            fd = self._next_vfd
+            self._next_vfd += 1
+            self._vfds[fd] = sock
+            if typ & SOCK_NONBLOCK:
+                self._vfd_flags[fd] = 0x800
+            reply(MSG_SYSCALL_COMPLETE, fd)
+            return False
+
+        fd = args[0]
+        sock = self._sock(fd)
+        if sock is None:
+            reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+
+        if num == S["bind"]:
+            addr = _parse_sockaddr_in(_vm_read(cpid, args[1], min(args[2], 16)))
+            if addr is None:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            try:
+                sock.bind(addr[0], addr[1])
+            except OSError:
+                reply(MSG_SYSCALL_COMPLETE, -98)  # EADDRINUSE
+                return False
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num == S["listen"]:
+            if isinstance(sock, TcpListenerSocket):
+                reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
+            if not isinstance(sock, TcpSocket):
+                reply(MSG_SYSCALL_COMPLETE, -errno.EOPNOTSUPP)
+                return False
+            lst = TcpListenerSocket(self.host.netns, cfg=sock.cfg,
+                                    backlog=max(args[1], 1))
+            lst.local_ip, lst.local_port = sock.local_ip, sock.local_port
+            if lst.local_port is None:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            self.host.netns._ports[(lst.PROTO, lst.local_port)] = lst
+            self._vfds[fd] = lst
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num in (S["accept"], S["accept4"]):
+            if not isinstance(sock, TcpListenerSocket):
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            child = sock.accept()
+            if child is None:
+                if self._nonblock(fd):
+                    reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                    return False
+                self._block_on(
+                    [(sock, FileState.ACCEPTABLE | FileState.CLOSED)], num, args
+                )
+                return True
+            nfd = self._next_vfd
+            self._next_vfd += 1
+            self._vfds[nfd] = child
+            if num == S["accept4"] and args[3] & SOCK_NONBLOCK:
+                self._vfd_flags[nfd] = 0x800
+            if args[1]:
+                sa = _build_sockaddr_in(child.peer_ip, child.peer_port)
+                _vm_write(cpid, args[1], sa)
+                if args[2]:
+                    _vm_write(cpid, args[2], struct.pack("<I", 16))
+            reply(MSG_SYSCALL_COMPLETE, nfd)
+            return False
+
+        if num == S["connect"]:
+            addr = _parse_sockaddr_in(_vm_read(cpid, args[1], min(args[2], 16)))
+            if addr is None:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            if isinstance(sock, UdpSocket):
+                sock.connect(addr[0], addr[1])
+                reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
+            from shadow_tpu.tcp import State as TS
+
+            if sock.tcp.state == TS.ESTABLISHED:
+                reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
+            if sock.tcp.error is not None:
+                reply(MSG_SYSCALL_COMPLETE, -ECONNREFUSED)
+                return False
+            if sock.peer_ip is None:
+                sock.connect(addr[0], addr[1])
+                if sock.tcp.state == TS.ESTABLISHED:  # loopback fast path
+                    reply(MSG_SYSCALL_COMPLETE, 0)
+                    return False
+                if self._nonblock(fd):
+                    reply(MSG_SYSCALL_COMPLETE, -errno.EINPROGRESS)
+                    return False
+            elif self._nonblock(fd):
+                reply(MSG_SYSCALL_COMPLETE, -errno.EALREADY)
+                return False
+            self._block_on(
+                [(sock, FileState.WRITABLE | FileState.ERROR | FileState.CLOSED)],
+                num, args,
+            )
+            return True
+
+        if num == S["sendto"]:
+            data = _vm_read(cpid, args[1], min(args[2], 1 << 20))
+            if isinstance(sock, UdpSocket):
+                addr = None
+                if args[4]:
+                    addr = _parse_sockaddr_in(_vm_read(cpid, args[4], 16))
+                try:
+                    n = sock.sendto(data, addr)
+                except OSError as e:
+                    reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+                    return False
+                reply(MSG_SYSCALL_COMPLETE, n)
+                return False
+            # TCP stream send
+            try:
+                n = sock.write(data)
+            except (ConnectionResetError, BrokenPipeError):
+                reply(MSG_SYSCALL_COMPLETE, -ECONNRESET)
+                return False
+            if n is None:
+                if self._nonblock(fd):
+                    reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                    return False
+                self._block_on(
+                    [(sock, FileState.WRITABLE | FileState.ERROR | FileState.CLOSED)],
+                    num, args,
+                )
+                return True
+            reply(MSG_SYSCALL_COMPLETE, n)
+            return False
+
+        if num == S["recvfrom"]:
+            wait_mask = (
+                FileState.READABLE | FileState.HUP | FileState.ERROR | FileState.CLOSED
+            )
+            if isinstance(sock, UdpSocket):
+                r = sock.recvfrom(min(args[2], 1 << 20))
+                if r is None:
+                    if self._nonblock(fd):
+                        reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                        return False
+                    self._block_on([(sock, wait_mask)], num, args)
+                    return True
+                data, addr = r
+                _vm_write(cpid, args[1], data)
+                if args[4]:
+                    _vm_write(cpid, args[4], _build_sockaddr_in(addr[0], addr[1]))
+                    if args[5]:
+                        _vm_write(cpid, args[5], struct.pack("<I", 16))
+                reply(MSG_SYSCALL_COMPLETE, len(data))
+                return False
+            data = sock.read(min(args[2], 1 << 20))
+            if data is None:
+                if self._nonblock(fd):
+                    reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                    return False
+                self._block_on([(sock, wait_mask)], num, args)
+                return True
+            _vm_write(cpid, args[1], data)
+            reply(MSG_SYSCALL_COMPLETE, len(data))
+            return False
+
+        if num == S["shutdown"]:
+            if isinstance(sock, TcpSocket):
+                sock.shutdown_write()
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num == S["getsockname"]:
+            sa = _build_sockaddr_in(sock.local_ip or "0.0.0.0", sock.local_port or 0)
+            _vm_write(cpid, args[1], sa)
+            if args[2]:
+                _vm_write(cpid, args[2], struct.pack("<I", 16))
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num == S["getpeername"]:
+            if sock.peer_ip is None:
+                reply(MSG_SYSCALL_COMPLETE, -ENOTCONN)
+                return False
+            _vm_write(cpid, args[1], _build_sockaddr_in(sock.peer_ip, sock.peer_port))
+            if args[2]:
+                _vm_write(cpid, args[2], struct.pack("<I", 16))
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num in (S["setsockopt"], S["getsockopt"]):
+            reply(MSG_SYSCALL_COMPLETE, 0)  # accepted and ignored
+            return False
+
+        reply(MSG_SYSCALL_COMPLETE, -EINVAL)
         return False
 
     def _gather_write(self, cpid: int, num: int, args: list[int]) -> bytes:
