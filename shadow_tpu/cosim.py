@@ -351,8 +351,18 @@ class HybridSimulation:
         )
 
     def _drain_captures(self):
-        ms = jax.device_get(self.state.model)
-        cap_n = ms["cap_n"]
+        # cheap guard first: the count vector is H ints vs the full rings
+        # being H x cap x 4 words — most windows deliver nothing
+        cap_n = np.asarray(jax.device_get(self.state.model["cap_n"]))
+        if not cap_n.any():
+            return
+        m = self.state.model
+        ms = dict(
+            zip(
+                ("cap_t", "cap_src", "cap_key"),
+                jax.device_get((m["cap_t"], m["cap_src"], m["cap_key"])),
+            )
+        )
         for gid in np.nonzero(cap_n > 0)[0]:
             host = self.hosts[int(gid)]
             for j in range(int(cap_n[gid])):
