@@ -1,0 +1,73 @@
+/* Real-binary epoll event loop: UDP echo + periodic timerfd ticks, the
+ * canonical production-server shape (reference test families epoll/,
+ * timerfd/). Exits after `pings` datagrams and `ticks` timer fires. */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/timerfd.h>
+#include <time.h>
+#include <unistd.h>
+
+static long now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000L + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+    int port = argc > 1 ? atoi(argv[1]) : 9000;
+    int want_pings = argc > 2 ? atoi(argv[2]) : 2;
+    int want_ticks = argc > 3 ? atoi(argv[3]) : 3;
+
+    int sfd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    a.sin_addr.s_addr = INADDR_ANY;
+    if (bind(sfd, (struct sockaddr *)&a, sizeof a)) { perror("bind"); return 1; }
+
+    int tfd = timerfd_create(CLOCK_MONOTONIC, 0);
+    struct itimerspec its = {{0, 200 * 1000 * 1000}, {0, 200 * 1000 * 1000}};
+    if (timerfd_settime(tfd, 0, &its, NULL)) { perror("timerfd_settime"); return 1; }
+
+    int ep = epoll_create1(0);
+    struct epoll_event ev = {0};
+    ev.events = EPOLLIN;
+    ev.data.fd = sfd;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, sfd, &ev)) { perror("ctl sfd"); return 1; }
+    ev.data.fd = tfd;
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, tfd, &ev)) { perror("ctl tfd"); return 1; }
+
+    int pings = 0, ticks = 0;
+    char buf[2048];
+    while (pings < want_pings || ticks < want_ticks) {
+        struct epoll_event evs[8];
+        int n = epoll_wait(ep, evs, 8, -1);
+        if (n < 0) { perror("epoll_wait"); return 1; }
+        for (int i = 0; i < n; i++) {
+            if (evs[i].data.fd == tfd) {
+                uint64_t expir;
+                if (read(tfd, &expir, 8) != 8) { perror("read tfd"); return 1; }
+                ticks += (int)expir;
+                printf("tick %d t=%ld\n", ticks, now_ns());
+            } else {
+                struct sockaddr_in src;
+                socklen_t sl = sizeof src;
+                ssize_t g = recvfrom(sfd, buf, sizeof buf, 0,
+                                     (struct sockaddr *)&src, &sl);
+                if (g < 0) { perror("recvfrom"); return 1; }
+                sendto(sfd, buf, g, 0, (struct sockaddr *)&src, sl);
+                pings++;
+                printf("ping %d t=%ld\n", pings, now_ns());
+            }
+            fflush(stdout);
+        }
+    }
+    printf("done pings=%d ticks=%d\n", pings, ticks);
+    return 0;
+}

@@ -124,7 +124,7 @@ def shim_path() -> str:
 
 _ARTIFACTS = (
     "libshadow_shim.so", "test_app", "test_busy", "test_udp_echo",
-    "test_udp_client", "test_tcp_stream",
+    "test_udp_client", "test_tcp_stream", "test_epoll_server",
 )
 
 
@@ -243,6 +243,11 @@ SYS = {
     "socket": 41, "connect": 42, "accept": 43, "sendto": 44, "recvfrom": 45,
     "shutdown": 48, "bind": 49, "listen": 50, "getsockname": 51,
     "getpeername": 52, "setsockopt": 54, "getsockopt": 55, "accept4": 288,
+    # epoll / timerfd / eventfd
+    "epoll_create": 213, "epoll_wait": 232, "epoll_ctl": 233,
+    "epoll_pwait": 281, "epoll_create1": 291,
+    "timerfd_create": 283, "timerfd_settime": 286, "timerfd_gettime": 287,
+    "eventfd2": 290, "eventfd": 284,
 }
 _N2NAME = {v: k for k, v in SYS.items()}
 
@@ -308,6 +313,15 @@ _SOCKET_SYSCALLS = {
         "socket", "connect", "accept", "accept4", "sendto", "recvfrom",
         "shutdown", "bind", "listen", "getsockname", "getpeername",
         "setsockopt", "getsockopt",
+    )
+}
+
+_EPOLL_SYSCALLS = {
+    SYS[n]
+    for n in (
+        "epoll_create", "epoll_create1", "epoll_ctl", "epoll_wait",
+        "epoll_pwait", "timerfd_create", "timerfd_settime", "timerfd_gettime",
+        "eventfd", "eventfd2",
     )
 }
 
@@ -462,6 +476,8 @@ class NativeProcess:
 
         if num in _SOCKET_SYSCALLS:
             return self._handle_socket(num, args)
+        if num in _EPOLL_SYSCALLS:
+            return self._handle_epoll(num, args)
         if num == SYS["close"]:
             if args[0] in self._vfds:
                 sock = self._vfds.pop(args[0])
@@ -509,8 +525,44 @@ class NativeProcess:
             return False
 
         if num == SYS["write"] and args[0] in self._vfds:
+            f = self._vfds[args[0]]
+            if not hasattr(f, "PROTO"):  # eventfd counters etc.
+                try:
+                    data = _vm_read(cpid, args[1], min(args[2], 16))
+                    n = f.write(data)
+                except (OSError, AttributeError) as e:
+                    code = _errno_of(e) if isinstance(e, OSError) else -EINVAL
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, code)
+                    return False
+                if n is None:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                else:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+                return False
             return self._handle_socket(SYS["sendto"], [args[0], args[1], args[2], 0, 0, 0])
         if num == SYS["read"] and args[0] in self._vfds:
+            f = self._vfds[args[0]]
+            if not hasattr(f, "PROTO"):  # timerfd/eventfd 8-byte reads
+                from shadow_tpu.host.filestate import FileState
+
+                try:
+                    out = f.read(min(args[2], 1 << 16))
+                except (OSError, AttributeError) as e:
+                    code = _errno_of(e) if isinstance(e, OSError) else -EINVAL
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, code)
+                    return False
+                if out is None:
+                    if self._nonblock(args[0]):
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                        return False
+                    self._block_on(
+                        [(f, FileState.READABLE | FileState.ERROR | FileState.CLOSED)],
+                        num, args,
+                    )
+                    return True
+                _vm_write(cpid, args[1], out)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, len(out))
+                return False
             return self._handle_socket(SYS["recvfrom"], [args[0], args[1], args[2], 0, 0, 0])
 
         if num == SYS["read"]:
@@ -649,6 +701,160 @@ class NativeProcess:
             self._block_on(watch, num, args,
                            timeout_ns=self._poll_deadline - now)
         return True
+
+    def _handle_epoll(self, num: int, args: list[int]) -> bool:
+        """epoll/timerfd/eventfd for real binaries, backed by the host-plane
+        files (host/epoll.py, timerfd.py, eventfd.py — reference epoll.c,
+        timerfd.rs, eventfd.rs)."""
+        from shadow_tpu.host.epoll import Epoll
+        from shadow_tpu.host.eventfd import EventFd
+        from shadow_tpu.host.filestate import FileState
+        from shadow_tpu.host.timerfd import TimerFd
+
+        cpid = self._child.pid
+        S = SYS
+        reply = self.ipc.reply
+
+        def new_vfd(obj) -> int:
+            fd = self._next_vfd
+            self._next_vfd += 1
+            self._vfds[fd] = obj
+            return fd
+
+        O_NONBLOCK = 0x800  # == TFD_NONBLOCK == EFD_NONBLOCK
+        if num in (S["epoll_create"], S["epoll_create1"]):
+            reply(MSG_SYSCALL_COMPLETE, new_vfd(Epoll()))
+            return False
+        if num == S["timerfd_create"]:
+            fd = new_vfd(TimerFd(self.host))
+            if args[1] & O_NONBLOCK:
+                self._vfd_flags[fd] = O_NONBLOCK
+            reply(MSG_SYSCALL_COMPLETE, fd)
+            return False
+        if num in (S["eventfd"], S["eventfd2"]):
+            EFD_SEMAPHORE = 1
+            flags = args[1] if num == S["eventfd2"] else 0  # legacy: no flags
+            fd = new_vfd(EventFd(args[0], bool(flags & EFD_SEMAPHORE)))
+            if flags & O_NONBLOCK:
+                self._vfd_flags[fd] = O_NONBLOCK
+            reply(MSG_SYSCALL_COMPLETE, fd)
+            return False
+
+        f = self._vfds.get(args[0])
+        if f is None:
+            reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+
+        if num == S["epoll_ctl"]:
+            EPOLL_CTL_ADD, EPOLL_CTL_DEL, EPOLL_CTL_MOD = 1, 2, 3
+            if not isinstance(f, Epoll):
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            target = self._vfds.get(args[2])
+            if target is None:
+                reply(MSG_SYSCALL_COMPLETE, -EBADF)
+                return False
+            events = data = 0
+            if args[1] != EPOLL_CTL_DEL and args[3]:
+                raw = _vm_read(cpid, args[3], 12)
+                if len(raw) == 12:
+                    events = struct.unpack_from("<I", raw, 0)[0]
+                    data = struct.unpack_from("<Q", raw, 4)[0]
+            try:
+                if args[1] == EPOLL_CTL_ADD:
+                    f.add(args[2], target, events, data)
+                elif args[1] == EPOLL_CTL_MOD:
+                    f.modify(args[2], events, data)
+                elif args[1] == EPOLL_CTL_DEL:
+                    f.remove(args[2])
+                else:
+                    reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                    return False
+            except OSError as e:
+                reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+                return False
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num in (S["epoll_wait"], S["epoll_pwait"]):
+            if not isinstance(f, Epoll):
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            if args[2] <= 0:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            maxev = min(args[2], 64)
+            evs = f.wait(maxev)
+            now = self.host.now()
+            if evs is not None:
+                self._poll_deadline = None
+                out = bytearray()
+                for e in evs:
+                    out += struct.pack("<I", e.events) + struct.pack("<Q", e.data)
+                _vm_write(cpid, args[1], bytes(out))
+                reply(MSG_SYSCALL_COMPLETE, len(evs))
+                return False
+            timeout_ms = args[3]
+            if timeout_ms == 0 or (
+                self._poll_deadline is not None and now >= self._poll_deadline
+            ):
+                self._poll_deadline = None
+                reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
+            if timeout_ms < 0:
+                self._block_on([(f, FileState.READABLE)], num, args)
+            else:
+                if self._poll_deadline is None:
+                    self._poll_deadline = now + timeout_ms * 1_000_000
+                self._block_on([(f, FileState.READABLE)], num, args,
+                               timeout_ns=self._poll_deadline - now)
+            return True
+
+        if num == S["timerfd_settime"]:
+            TFD_TIMER_ABSTIME = 1
+            if not isinstance(f, TimerFd):
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            raw = _vm_read(cpid, args[2], 32)  # struct itimerspec
+            if len(raw) != 32:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            i_sec, i_ns, v_sec, v_ns = struct.unpack("<qqqq", raw)
+            interval = i_sec * NS_PER_SEC + i_ns
+            value = v_sec * NS_PER_SEC + v_ns
+            now = self.host.now()
+            if value == 0:
+                deadline = None
+            elif args[1] & TFD_TIMER_ABSTIME:
+                deadline = value
+            else:
+                deadline = now + value
+            old_rem, old_itv = f.settime(deadline, interval)
+            if args[3]:
+                _vm_write(
+                    cpid, args[3],
+                    struct.pack("<qqqq", old_itv // NS_PER_SEC,
+                                old_itv % NS_PER_SEC, old_rem // NS_PER_SEC,
+                                old_rem % NS_PER_SEC),
+                )
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num == S["timerfd_gettime"]:
+            if not isinstance(f, TimerFd):
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            rem, itv = f.gettime()
+            _vm_write(
+                cpid, args[1],
+                struct.pack("<qqqq", itv // NS_PER_SEC, itv % NS_PER_SEC,
+                            rem // NS_PER_SEC, rem % NS_PER_SEC),
+            )
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+        return False
 
     # ---- emulated sockets (the real-binary face of host/sockets.py;
     # reference: the inet syscall family, handler/mod.rs socket arms) ------

@@ -97,6 +97,28 @@ def test_real_tcp_connection_refused():
     assert b"connect" in b"".join(cli.stderr)
 
 
+def test_real_epoll_timerfd_event_loop():
+    """A production-shaped epoll event loop (UDP socket + periodic timerfd)
+    in a real binary, fully under simulated time (reference epoll/ +
+    timerfd/ test families)."""
+    EPOLL_SRV = os.path.join(REPO, "native", "build", "test_epoll_server")
+    hosts, net = two_hosts(lat_ms=20)
+    srv = spawn_native(hosts[0], [EPOLL_SRV, "9000", "2", "3"])
+    cli = spawn_native(
+        hosts[1], [UDP_CLIENT, "10.0.0.1", "9000", "2"], start_time=50 * MS
+    )
+    net.run(5 * SEC)
+    assert srv.exit_code == 0, b"".join(srv.stderr)
+    assert cli.exit_code == 0
+    out = b"".join(srv.stdout).decode()
+    # timer ticks land exactly on the 200ms grid of SIMULATED time
+    assert "tick 1 t=200000000" in out
+    assert "tick 3 t=600000000" in out
+    # first ping: client start (50ms) + one-way latency (20ms)
+    assert "ping 1 t=70000000" in out
+    assert "done pings=2 ticks=3" in out
+
+
 def test_real_binaries_over_device_plane():
     """The full story: real Linux processes exchanging packets through the
     TPU device network plane (cosim bridge)."""
