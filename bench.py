@@ -27,6 +27,22 @@ SIM_S = 2 if SMALL else 10
 CPU_SIM_S = 1 if SMALL else 2  # ratio is time-normalized; keep CPU leg short
 
 
+# The reference's PHOLD topology (src/test/phold/phold.yaml: one graph node,
+# 50 ms latency, 1 Gbit): the 50 ms lookahead is what makes PHOLD a fair
+# PDES benchmark — windows span 50 ms of simulated time per barrier.
+PHOLD_GML = """
+graph [
+  directed 0
+  node [
+    id 0
+    host_bandwidth_down "1 Gbit"
+    host_bandwidth_up "1 Gbit"
+  ]
+  edge [ source 0 target 0 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
 def bench_config(num_hosts: int, stop_s: int) -> dict:
     # PHOLD (SURVEY.md §4.4: the reference's in-repo PDES workload) scaled to
     # the 10k-host point: every host holds jobs, matures them after an
@@ -34,7 +50,14 @@ def bench_config(num_hosts: int, stop_s: int) -> dict:
     # steady-state round-loop + cross-shard exchange stress.
     return {
         "general": {"stop_time": f"{stop_s} s", "seed": 1},
-        "network": {"graph": {"type": "1_gbit_switch"}},
+        "network": {"graph": {"type": "gml", "inline": PHOLD_GML}},
+        "experimental": {
+            # static shapes sized to the workload: Poisson(~0.5) events per
+            # host per 50 ms window, budgeted with head-room
+            "event_queue_capacity": 16,
+            "sends_per_host_round": 6,
+            "rounds_per_chunk": 32,
+        },
         "hosts": {
             "node": {
                 "count": num_hosts,
@@ -54,8 +77,12 @@ def bench_config(num_hosts: int, stop_s: int) -> dict:
     }
 
 
-def measure(num_hosts: int, stop_s: int) -> float:
-    """sim-seconds advanced per wall-second, excluding the compile chunk."""
+def measure(num_hosts: int, stop_s: int, wall_budget_s: float = 90.0) -> float:
+    """sim-seconds advanced per wall-second, excluding the compile chunk.
+
+    Bounded by `wall_budget_s` of measurement wall time so the bench always
+    terminates regardless of platform speed — the rate is the metric, so a
+    partial run measures the same quantity."""
     import jax
 
     from shadow_tpu.config.options import ConfigOptions
@@ -66,16 +93,26 @@ def measure(num_hosts: int, stop_s: int) -> float:
     state, params, engine = sim.state, sim.params, sim.engine
     state = engine.run_chunk(state, params)  # compile + first chunk
     jax.block_until_ready(state)
+    if bool(state.done):
+        # whole sim fit in the compile chunk: rebuild (compile is cached)
+        # and time a clean full run
+        sim = Simulation(cfg, world=1)
+        t0 = time.monotonic()
+        state = sim.state
+        while not bool(state.done):
+            state = sim.engine.run_chunk(state, sim.params)
+            jax.block_until_ready(state)
+        return stop_s / max(time.monotonic() - t0, 1e-9)
     sim0 = int(state.now)
     t0 = time.monotonic()
     while not bool(state.done):
         state = engine.run_chunk(state, params)
-    jax.block_until_ready(state)
-    wall = time.monotonic() - t0
+        jax.block_until_ready(state)
+        if time.monotonic() - t0 >= wall_budget_s:
+            break
+    wall = max(time.monotonic() - t0, 1e-9)
     sim_advanced_s = (int(state.now) - sim0) / 1e9
-    if sim_advanced_s <= 0:  # everything fit in the compile chunk; retime whole
-        return stop_s / max(wall, 1e-9)
-    return sim_advanced_s / max(wall, 1e-9)
+    return sim_advanced_s / wall
 
 
 def main() -> int:
@@ -84,7 +121,9 @@ def main() -> int:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        print(measure(NUM_HOSTS, CPU_SIM_S if "--cpu" in sys.argv else SIM_S))
+            print(measure(NUM_HOSTS, CPU_SIM_S, wall_budget_s=60.0))
+        else:
+            print(measure(NUM_HOSTS, SIM_S))
         return 0
 
     value = measure(NUM_HOSTS, SIM_S)
@@ -94,7 +133,7 @@ def main() -> int:
             [sys.executable, os.path.abspath(__file__), "--self", "--cpu"],
             capture_output=True,
             text=True,
-            timeout=1800,
+            timeout=900,  # covers CPU-backend compile + first chunk too
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
         cpu_ratio = float(out.stdout.strip().splitlines()[-1])

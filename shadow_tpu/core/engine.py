@@ -38,6 +38,7 @@ re-runs on one machine, not cross-machine equality.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, NamedTuple
@@ -157,6 +158,12 @@ class EngineConfig:
     use_dynamic_runahead: bool = False
     tb_interval_ns: int = 1_000_000  # token bucket refill quantum (1 ms)
     use_codel: bool = True
+    # Static shaping skip: when NO host has a bandwidth limit, unlimited
+    # token buckets never delay (depart == arrival) and CoDel's sojourn is
+    # always 0 (never drops), so the whole ingress/egress shaping pipeline
+    # is an exact no-op — eliding it at trace time removes ~40% of the
+    # microstep's ops with bit-identical results (digests unchanged).
+    shaping: bool = True
     queue_capacity: int = 64
     # Per-HOST send budget per round. Budget-drop decisions depend only on a
     # host's own send count, and the shard outbox is sized hosts_per_shard *
@@ -193,6 +200,18 @@ class EngineConfig:
 # --------------------------------------------------------------------------
 # state construction (host side)
 # --------------------------------------------------------------------------
+
+
+def host_build_context():
+    """Run state construction on the host CPU backend. Over a tunneled TPU
+    every individual `jnp.zeros`/`asarray` is a network round-trip; building
+    on CPU and shipping the finished pytree in ONE device_put turns minutes
+    of setup into seconds (measured 187s -> ~2s at 512 hosts)."""
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+        return jax.default_device(cpu)
+    except RuntimeError:
+        return contextlib.nullcontext()
 
 
 def _init_stats(cfg: EngineConfig) -> Stats:
@@ -392,25 +411,26 @@ class Engine:
         """Returns (state, params) — params come back re-device_put with the
         mesh sharding when running multi-device; always use the returned pair."""
         cfg = self.cfg
-        queue, seq = seed_queue(cfg, initial_events)
         self._model_state_spec_tree = self._model_specs(model_state)
         self._model_param_spec_tree = self._model_specs(params.model)
         self._build_run_chunk()
-        state = SimState(
-            now=jnp.zeros((), jnp.int64),
-            done=jnp.zeros((), bool),
-            queue=queue,
-            rng=rng_init(cfg.num_hosts, seed),
-            seq=seq,
-            sent_round=jnp.zeros((cfg.num_hosts,), jnp.int32),
-            tb_egress=tb_init(params.eg_tb),
-            tb_ingress=tb_init(params.in_tb),
-            codel=codel_init(cfg.num_hosts),
-            min_used_lat=jnp.asarray(cfg.static_min_latency, jnp.int64),
-            model=model_state,
-            outbox=_init_outbox(cfg),
-            stats=_init_stats(cfg),
-        )
+        with host_build_context():
+            queue, seq = seed_queue(cfg, initial_events)
+            state = SimState(
+                now=jnp.zeros((), jnp.int64),
+                done=jnp.zeros((), bool),
+                queue=queue,
+                rng=rng_init(cfg.num_hosts, seed),
+                seq=seq,
+                sent_round=jnp.zeros((cfg.num_hosts,), jnp.int32),
+                tb_egress=tb_init(params.eg_tb),
+                tb_ingress=tb_init(params.in_tb),
+                codel=codel_init(cfg.num_hosts),
+                min_used_lat=jnp.asarray(cfg.static_min_latency, jnp.int64),
+                model=model_state,
+                outbox=_init_outbox(cfg),
+                stats=_init_stats(cfg),
+            )
         if self.mesh is not None:
             state = jax.device_put(
                 state,
@@ -424,6 +444,10 @@ class Engine:
                     lambda s: NamedSharding(self.mesh, s), self.param_specs()
                 ),
             )
+        else:
+            dev = jax.devices()[0]
+            state = jax.device_put(state, dev)
+            params = jax.device_put(params, dev)
         return state, params
 
 
@@ -447,6 +471,37 @@ def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EnginePa
         return st, i + 1
 
     state, _ = lax.while_loop(cond, body, (state, jnp.zeros((), jnp.int64)))
+    return state
+
+
+def _run_guarded_chunk(
+    cfg: EngineConfig, model, axis, stop_probe, st: SimState,
+    params: EngineParams, until,
+):
+    """Run rounds while the global min event time stays below `until` AND
+    `stop_probe(model_state)` is False. The co-simulation bridge uses this
+    to batch many device rounds into one dispatch while the CPU plane is
+    idle, exiting as soon as a round produces host-bound deliveries (the
+    probe) so the CPU plane can react — conservative lookahead stays exact
+    because the CPU plane's earliest possible influence is `until` +
+    min-latency (SURVEY.md §7 hard parts 5-6)."""
+
+    def cond(carry):
+        stc, i = carry
+        gmin = _pmin(jnp.min(next_time(stc.queue)), axis)
+        return (
+            (~stc.done)
+            & (i < cfg.rounds_per_chunk)
+            & (gmin < until)
+            & (~stop_probe(stc.model))
+        )
+
+    def body(carry):
+        stc, i = carry
+        stc = _round_step(cfg, model, axis, stc, params)
+        return stc, i + 1
+
+    state, _ = lax.while_loop(cond, body, (st, jnp.zeros((), jnp.int64)))
     return state
 
 
@@ -520,40 +575,46 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     )
 
     is_pkt = (ev.kind & KIND_PKT) != 0
-    needs_ingress = active & is_pkt & ((ev.kind & KIND_INGRESS_DONE) == 0)
 
-    # ---- ingress pipeline: CoDel at the router queue, then the downlink
-    # token bucket. The law sees the delay the packet WOULD experience, and
-    # only survivors consume bandwidth (reference: the relay pulls from the
-    # CoDel queue, so dropped packets are never charged; router/mod.rs:47-62).
-    size_bits = jnp.asarray(ev.payload[:, PAYLOAD_SIZE_WORD], jnp.int64) * 8
-    no_mask = jnp.zeros_like(needs_ingress)
-    _, depart_probe = tb_conforming_remove(
-        st.tb_ingress, params.in_tb, cfg.tb_interval_ns, ev.t, size_bits, no_mask
-    )
-    sojourn = depart_probe - ev.t
-    if cfg.use_codel:
-        codel, codel_drop = codel_on_packet(st.codel, ev.t, sojourn, needs_ingress)
+    if cfg.shaping:
+        needs_ingress = active & is_pkt & ((ev.kind & KIND_INGRESS_DONE) == 0)
+
+        # ---- ingress pipeline: CoDel at the router queue, then the downlink
+        # token bucket. The law sees the delay the packet WOULD experience,
+        # and only survivors consume bandwidth (reference: the relay pulls
+        # from the CoDel queue, so dropped packets are never charged;
+        # router/mod.rs:47-62).
+        size_bits = jnp.asarray(ev.payload[:, PAYLOAD_SIZE_WORD], jnp.int64) * 8
+        no_mask = jnp.zeros_like(needs_ingress)
+        _, depart_probe = tb_conforming_remove(
+            st.tb_ingress, params.in_tb, cfg.tb_interval_ns, ev.t, size_bits, no_mask
+        )
+        sojourn = depart_probe - ev.t
+        if cfg.use_codel:
+            codel, codel_drop = codel_on_packet(st.codel, ev.t, sojourn, needs_ingress)
+        else:
+            codel, codel_drop = st.codel, jnp.zeros_like(needs_ingress)
+        tb_in, depart = tb_conforming_remove(
+            st.tb_ingress,
+            params.in_tb,
+            cfg.tb_interval_ns,
+            ev.t,
+            size_bits,
+            needs_ingress & ~codel_drop,
+        )
+        delay = needs_ingress & ~codel_drop & (depart > ev.t)
+        queue = push_one(
+            queue, delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE, ev.payload
+        )
+        stats = stats._replace(
+            pkts_codel_dropped=stats.pkts_codel_dropped + codel_drop
+        )
+        dispatch = active & ~(needs_ingress & (codel_drop | delay))
     else:
-        codel, codel_drop = st.codel, jnp.zeros_like(needs_ingress)
-    tb_in, depart = tb_conforming_remove(
-        st.tb_ingress,
-        params.in_tb,
-        cfg.tb_interval_ns,
-        ev.t,
-        size_bits,
-        needs_ingress & ~codel_drop,
-    )
-    delay = needs_ingress & ~codel_drop & (depart > ev.t)
-    queue = push_one(
-        queue, delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE, ev.payload
-    )
-    stats = stats._replace(
-        pkts_codel_dropped=stats.pkts_codel_dropped + codel_drop
-    )
+        codel, tb_in = st.codel, st.tb_ingress
+        dispatch = active
 
     # ---- model dispatch (Host::execute -> TaskRef::execute / packet receive)
-    dispatch = active & ~(needs_ingress & (codel_drop | delay))
     stats = stats._replace(pkts_delivered=stats.pkts_delivered + (dispatch & is_pkt))
     ctx = HandlerCtx(
         t=ev.t,
@@ -600,14 +661,17 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         # BEFORE the bandwidth charge: a budget-dropped packet must be
         # side-effect-free (no debited bits, no borrowed refill intervals).
         over_budget = sent_round >= cfg.sends_per_host_round
-        tb_eg, eg_depart = tb_conforming_remove(
-            tb_eg,
-            params.eg_tb,
-            cfg.tb_interval_ns,
-            ev.t,
-            sz.astype(jnp.int64) * 8,
-            mask & ~over_budget,
-        )
+        if cfg.shaping:
+            tb_eg, eg_depart = tb_conforming_remove(
+                tb_eg,
+                params.eg_tb,
+                cfg.tb_interval_ns,
+                ev.t,
+                sz.astype(jnp.int64) * 8,
+                mask & ~over_budget,
+            )
+        else:
+            eg_depart = ev.t  # unlimited uplink: no serialization delay
         dst_raw = jnp.asarray(s.dst, jnp.int64)
         bad_dst = mask & ((dst_raw < 0) | (dst_raw >= cfg.num_hosts))
         dst = jnp.clip(dst_raw, 0, cfg.num_hosts - 1)  # safe gather only
@@ -678,10 +742,21 @@ def _exchange(cfg, axis, st: SimState):
     shard_start = (
         lax.axis_index(axis).astype(jnp.int32) * h_local if axis else jnp.int32(0)
     )
-    local = g.dst - shard_start
-    valid = (g.t != TIME_MAX) & (local >= 0) & (local < h_local)
-    queue = merge_flat_events(
-        st.queue, local, g.t, g.order, g.kind, g.payload, valid, cfg.max_round_inserts
+
+    def do_merge(queue):
+        local = g.dst - shard_start
+        valid = (g.t != TIME_MAX) & (local >= 0) & (local < h_local)
+        return merge_flat_events(
+            queue, local, g.t, g.order, g.kind, g.payload, valid,
+            cfg.max_round_inserts,
+        )
+
+    # the merge's sort dominates round cost; rounds where NO shard sent
+    # anything (timer-heavy workloads, drained phases) skip it entirely.
+    # g.count is identical on all shards post-gather, so the branch is
+    # uniform across the mesh.
+    queue = lax.cond(
+        jnp.sum(g.count) > 0, do_merge, lambda queue: queue, st.queue
     )
     fresh = Outbox(
         dst=jnp.zeros_like(ob.dst),

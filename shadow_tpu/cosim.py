@@ -90,9 +90,14 @@ class HybridSimulation:
             queue_capacity=qcap,
             sends_per_host_round=max(ex.sends_per_host_round, 32),
             max_round_inserts=ex.max_round_inserts or qcap,
-            rounds_per_chunk=1,
+            # bounds the guarded idle-batch (the per-window step itself always
+            # executes exactly one forced window regardless)
+            rounds_per_chunk=ex.rounds_per_chunk,
             microstep_limit=ex.microstep_limit,
             world=1,
+            shaping=any(
+                s.bw_up_bits > 0 or s.bw_down_bits > 0 for s in self.specs
+            ),
         )
         self.engine = Engine(self.engine_cfg, self.model, None)
         self._build()
@@ -112,16 +117,18 @@ class HybridSimulation:
         mparams, mstate, _ = self.model.build(
             [{"host_id": s.host_id} for s in self.specs], cfg.general.seed
         )
-        params = EngineParams(
-            node_of=jnp.asarray(node_of),
-            lat_ns=jnp.asarray(self.graph.lat_ns),
-            loss=jnp.asarray(self.graph.loss),
-            eg_tb=simmod._tb_params(bw_up, ecfg.tb_interval_ns),
-            in_tb=simmod._tb_params(bw_down, ecfg.tb_interval_ns),
-            model=jax.tree.map(jnp.asarray, mparams),
-        )
+        with eng.host_build_context():
+            params = EngineParams(
+                node_of=jnp.asarray(node_of),
+                lat_ns=jnp.asarray(self.graph.lat_ns),
+                loss=jnp.asarray(self.graph.loss),
+                eg_tb=simmod._tb_params(bw_up, ecfg.tb_interval_ns),
+                in_tb=simmod._tb_params(bw_down, ecfg.tb_interval_ns),
+                model=jax.tree.map(jnp.asarray, mparams),
+            )
+            mstate_dev = jax.tree.map(jnp.asarray, mstate)
         self.state, self.params = self.engine.init_state(
-            params, jax.tree.map(jnp.asarray, mstate), [], seed=cfg.general.seed
+            params, mstate_dev, [], seed=cfg.general.seed
         )
 
         # CPU side
@@ -219,6 +226,17 @@ class HybridSimulation:
             functools.partial(eng._window_step, self.engine_cfg, self.model, None),
             donate_argnums=0,
         )
+        self._guarded = jax.jit(
+            functools.partial(
+                eng._run_guarded_chunk,
+                self.engine_cfg,
+                self.model,
+                None,
+                lambda ms: jnp.any(ms["cap_n"] > 0),
+            ),
+            donate_argnums=0,
+        )
+        self._clear_caps = jax.jit(_clear_caps, donate_argnums=0)
 
     # ---- egress staging ----------------------------------------------------
 
@@ -280,6 +298,19 @@ class HybridSimulation:
                     self._drain_captures()
                 if not self._staged:
                     break
+            # batch further device rounds while the CPU plane is idle: the
+            # guarded chunk exits on the first round that captures a
+            # host-bound delivery (or when the device catches up to the CPU
+            # plane's next event)
+            cpu_min = self._cpu_min_next()
+            if cpu_min > window_end:
+                with self.perf.time("device_batch"):
+                    self.state = self._guarded(
+                        self.state, self.params,
+                        jnp.asarray(min(cpu_min, stop), jnp.int64),
+                    )
+                with self.perf.time("drain_captures"):
+                    self._drain_captures()
             windows += 1
             if hb_ns and window_end >= next_hb:
                 wall = time.monotonic() - t0
@@ -363,6 +394,9 @@ class HybridSimulation:
                 jax.device_get((m["cap_t"], m["cap_src"], m["cap_key"])),
             )
         )
+        # rings are drained: clear the device-side counters so the guarded
+        # batch's probe sees a clean slate and nothing is delivered twice
+        self.state = self._clear_caps(self.state)
         for gid in np.nonzero(cap_n > 0)[0]:
             host = self.hosts[int(gid)]
             for j in range(int(cap_n[gid])):
@@ -455,14 +489,19 @@ class HybridSimulation:
         return data_dir
 
 
-def _prepare_window(cfg, model, state, dst, t, order, kind, payload, valid):
-    """Jitted: clear capture rings + merge staged send-requests."""
+def _clear_caps(state):
     ms = dict(state.model)
     ms["cap_n"] = jnp.zeros_like(ms["cap_n"])
+    return state._replace(model=ms)
+
+
+def _prepare_window(cfg, model, state, dst, t, order, kind, payload, valid):
+    """Jitted: clear capture rings + merge staged send-requests."""
+    state = _clear_caps(state)
     queue = merge_flat_events(
         state.queue, dst, t, order, kind, payload, valid, cfg.max_round_inserts
     )
-    return state._replace(model=ms, queue=queue)
+    return state._replace(queue=queue)
 
 
 def run_hybrid(cfg: ConfigOptions, **kw) -> tuple[HybridSimulation, dict]:

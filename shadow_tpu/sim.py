@@ -272,6 +272,11 @@ class Simulation:
             rounds_per_chunk=ex.rounds_per_chunk,
             microstep_limit=ex.microstep_limit,
             world=world,
+            # exact elision: with no bandwidth limits anywhere, token buckets
+            # and CoDel are provable no-ops (see EngineConfig.shaping)
+            shaping=any(
+                h.bw_up_bits > 0 or h.bw_down_bits > 0 for h in self.hosts
+            ),
         )
         mesh = None
         if world > 1:
@@ -308,6 +313,8 @@ class Simulation:
         return jax.tree.map(f, tree)
 
     def _build_state(self):
+        from shadow_tpu.core.engine import host_build_context
+
         cfg, ecfg = self.cfg, self.engine_cfg
         try:
             mparams, mstate, events = self.model.build(
@@ -322,16 +329,18 @@ class Simulation:
             node_of[h.host_id] = h.node_index
             bw_up[h.host_id] = h.bw_up_bits
             bw_down[h.host_id] = h.bw_down_bits
-        params = EngineParams(
-            node_of=jnp.asarray(node_of),
-            lat_ns=jnp.asarray(self.graph.lat_ns),
-            loss=jnp.asarray(self.graph.loss),
-            eg_tb=_tb_params(bw_up, ecfg.tb_interval_ns),
-            in_tb=_tb_params(bw_down, ecfg.tb_interval_ns),
-            model=self._pad(mparams),
-        )
+        with host_build_context():
+            params = EngineParams(
+                node_of=jnp.asarray(node_of),
+                lat_ns=jnp.asarray(self.graph.lat_ns),
+                loss=jnp.asarray(self.graph.loss),
+                eg_tb=_tb_params(bw_up, ecfg.tb_interval_ns),
+                in_tb=_tb_params(bw_down, ecfg.tb_interval_ns),
+                model=self._pad(mparams),
+            )
+            padded_state = self._pad(mstate)
         self.state, self.params = self.engine.init_state(
-            params, self._pad(mstate), events, seed=cfg.general.seed
+            params, padded_state, events, seed=cfg.general.seed
         )
 
     # ---- run --------------------------------------------------------------
