@@ -134,6 +134,10 @@ class ExperimentalOptions:
     # "deterministic" (StraceLoggingMode, configuration.rs:1162;
     # deterministic omits anything that could differ across machines)
     strace_logging_mode: str = "off"
+    # queue-overflow shed policy at the exchange merge: "urgency" keeps the
+    # most urgent events (tested contract); "append" is cheaper on TPU and
+    # identical whenever queues are sized to never overflow
+    overflow_shed: str = "urgency"
     # --- TPU engine static shapes ---
     event_queue_capacity: int = 64  # per-host pending-event slots
     sends_per_host_round: int = 8  # per-host round send budget (drop above)
@@ -154,10 +158,17 @@ class ExperimentalOptions:
         ):
             if f in d:
                 setattr(e, f, str(d.pop(f)))
+        if "overflow_shed" in d:
+            e.overflow_shed = str(d.pop("overflow_shed"))
         if e.strace_logging_mode not in ("off", "standard", "deterministic"):
             raise ConfigError(
                 f"experimental.strace_logging_mode must be off|standard|"
                 f"deterministic, got {e.strace_logging_mode!r}"
+            )
+        if e.overflow_shed not in ("urgency", "append"):
+            raise ConfigError(
+                f"experimental.overflow_shed must be urgency|append, "
+                f"got {e.overflow_shed!r}"
             )
         for f in ("use_dynamic_runahead", "use_codel"):
             if f in d:

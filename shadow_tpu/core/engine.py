@@ -164,6 +164,11 @@ class EngineConfig:
     # is an exact no-op — eliding it at trace time removes ~40% of the
     # microstep's ops with bit-identical results (digests unchanged).
     shaping: bool = True
+    # Cheap overflow-shed: the exchange merge groups by destination with a
+    # 2xi32 sort (append-order shed) instead of the 3-key urgency sort —
+    # identical results whenever queues never overflow (see
+    # ops/merge.py merge_flat_events). Opt-in for sized workloads.
+    cheap_shed: bool = False
     queue_capacity: int = 64
     # Per-HOST send budget per round. Budget-drop decisions depend only on a
     # host's own send count, and the shard outbox is sized hosts_per_shard *
@@ -748,7 +753,7 @@ def _exchange(cfg, axis, st: SimState):
         valid = (g.t != TIME_MAX) & (local >= 0) & (local < h_local)
         return merge_flat_events(
             queue, local, g.t, g.order, g.kind, g.payload, valid,
-            cfg.max_round_inserts,
+            cfg.max_round_inserts, shed_urgency=not cfg.cheap_shed,
         )
 
     # the merge's sort dominates round cost; rounds where NO shard sent

@@ -277,6 +277,7 @@ class Simulation:
             shaping=any(
                 h.bw_up_bits > 0 or h.bw_down_bits > 0 for h in self.hosts
             ),
+            cheap_shed=ex.overflow_shed == "append",
         )
         mesh = None
         if world > 1:
