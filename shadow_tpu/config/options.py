@@ -138,6 +138,10 @@ class ExperimentalOptions:
     # most urgent events (tested contract); "append" is cheaper on TPU and
     # identical whenever queues are sized to never overflow
     overflow_shed: str = "urgency"
+    # CPU model: simulated computation time charged per handled event
+    # (reference host/cpu.rs; 0 = off). Applies to device-modeled hosts;
+    # the pure-CPU oracle scheduler does not model it.
+    cpu_delay: int = 0  # ns
     # --- TPU engine static shapes ---
     event_queue_capacity: int = 64  # per-host pending-event slots
     sends_per_host_round: int = 8  # per-host round send budget (drop above)
@@ -160,6 +164,8 @@ class ExperimentalOptions:
                 setattr(e, f, str(d.pop(f)))
         if "overflow_shed" in d:
             e.overflow_shed = str(d.pop("overflow_shed"))
+        if "cpu_delay" in d:
+            e.cpu_delay = parse_time_ns(d.pop("cpu_delay"), TimeUnit.MS)
         if e.strace_logging_mode not in ("off", "standard", "deterministic"):
             raise ConfigError(
                 f"experimental.strace_logging_mode must be off|standard|"

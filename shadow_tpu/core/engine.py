@@ -122,6 +122,7 @@ class SimState(NamedTuple):
     rng: RngState
     seq: Array  # i64[H] per-host emission counter (order-key seq)
     sent_round: Array  # i32[H] sends staged this round (budget accounting)
+    cpu_busy_until: Array  # i64[H] CPU model: host busy below this time
     tb_egress: TBState
     tb_ingress: TBState
     codel: Any  # CodelState
@@ -169,6 +170,11 @@ class EngineConfig:
     # identical results whenever queues never overflow (see
     # ops/merge.py merge_flat_events). Opt-in for sized workloads.
     cheap_shed: bool = False
+    # CPU model (reference host/cpu.rs + host.rs:820-847): every handled
+    # event charges `cpu_delay_ns` of simulated CPU time; events that pop
+    # while the host CPU is still busy are deferred to busy_until instead of
+    # executing. 0 = off (statically elided).
+    cpu_delay_ns: int = 0
     queue_capacity: int = 64
     # Per-HOST send budget per round. Budget-drop decisions depend only on a
     # host's own send count, and the shard outbox is sized hosts_per_shard *
@@ -371,6 +377,7 @@ class Engine:
             rng=RngState(s=sh),
             seq=sh,
             sent_round=sh,
+            cpu_busy_until=sh,
             tb_egress=TBState(tokens=sh, last_itv=sh),
             tb_ingress=TBState(tokens=sh, last_itv=sh),
             codel=jax.tree.map(lambda _: sh, codel_init(1)),
@@ -428,6 +435,7 @@ class Engine:
                 rng=rng_init(cfg.num_hosts, seed),
                 seq=seq,
                 sent_round=jnp.zeros((cfg.num_hosts,), jnp.int32),
+                cpu_busy_until=jnp.zeros((cfg.num_hosts,), jnp.int64),
                 tb_egress=tb_init(params.eg_tb),
                 tb_ingress=tb_init(params.in_tb),
                 codel=codel_init(cfg.num_hosts),
@@ -493,7 +501,7 @@ def _run_guarded_chunk(
 
     def cond(carry):
         stc, i = carry
-        gmin = _pmin(jnp.min(next_time(stc.queue)), axis)
+        gmin = _pmin(jnp.min(_effective_next(cfg, stc)), axis)
         return (
             (~stc.done)
             & (i < cfg.rounds_per_chunk)
@@ -512,7 +520,7 @@ def _run_guarded_chunk(
 
 def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EngineParams):
     # ---- 1-2: barrier + window (controller.rs:88-112)
-    lmin = jnp.min(next_time(st.queue))
+    lmin = jnp.min(_effective_next(cfg, st))
     gmin = _pmin(lmin, axis)
     done = gmin >= cfg.stop_time  # TIME_MAX (empty everywhere) implies done
     gmin_safe = jnp.minimum(gmin, cfg.stop_time)
@@ -544,7 +552,7 @@ def _window_step(
     # ---- 3: microsteps (no collectives inside — shards proceed independently)
     def micro_cond(carry):
         stc, steps = carry
-        return jnp.any(next_time(stc.queue) < window_end) & (
+        return jnp.any(_effective_next(cfg, stc) < window_end) & (
             steps < cfg.effective_microstep_limit
         )
 
@@ -571,8 +579,35 @@ def _window_step(
     )
 
 
+def _effective_next(cfg: EngineConfig, st: SimState):
+    """Per-host next *executable* time: queue head, floored by the CPU
+    model's busy horizon (a busy host keeps its events queued — order
+    intact — and resumes at busy_until, exactly the reference's CPU-delay
+    rescheduling, host.rs:820-847)."""
+    nt = next_time(st.queue)
+    if cfg.cpu_delay_ns > 0:
+        nt = jnp.where(nt == TIME_MAX, nt, jnp.maximum(nt, st.cpu_busy_until))
+    return nt
+
+
 def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
-    queue, ev, active = pop_min(st.queue, window_end)
+    if cfg.cpu_delay_ns > 0:
+        # a still-busy host does not pop at all this window; events stay in
+        # the queue so their (time, order) sequence is preserved verbatim
+        limit_h = jnp.where(
+            st.cpu_busy_until < window_end, window_end, jnp.int64(0)
+        )
+        queue, ev, active = pop_min(st.queue, limit_h)
+        st = st._replace(
+            cpu_busy_until=jnp.where(
+                active,
+                jnp.maximum(st.cpu_busy_until, ev.t) + cfg.cpu_delay_ns,
+                st.cpu_busy_until,
+            )
+        )
+    else:
+        queue, ev, active = pop_min(st.queue, window_end)
+
     stats = st.stats
     stats = stats._replace(
         events=stats.events + active,

@@ -30,6 +30,8 @@ class NetworkNamespace:
         # (proto, local_port, peer_ip, peer_port) -> TcpSocket [flows]
         self._flows: dict[tuple[int, int, str, int], object] = {}
         self._next_ephemeral = EPHEMERAL_START
+        # abstract unix-domain namespace (reference abstract_unix_ns.rs)
+        self.abstract_unix: dict[str, object] = {}
 
     # ---- binding -----------------------------------------------------------
 

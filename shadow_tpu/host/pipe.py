@@ -1,8 +1,11 @@
-"""Pipes over a shared byte buffer.
+"""Pipes over a shared byte buffer + the shared stream-end base.
 
 Reference: `host/descriptor/pipe.rs` (475 LoC) on top of
 `shared_buf.rs` — reader and writer ends share one bounded buffer; state
 bits flip as it fills/drains; closing the peer end raises HUP/EPIPE.
+`StreamEnd` is the generic (rx?, tx?) half over `_SharedBuf`s, reused by
+unix-domain stream sockets (`host/unix.py`), which are exactly a crossed
+pair of these buffers in the reference too.
 """
 
 from __future__ import annotations
@@ -24,83 +27,116 @@ class _SharedBuf:
         return self.capacity - len(self.data)
 
 
-class PipeEnd(File):
-    def __init__(self, buf: _SharedBuf, writable: bool):
+class StreamEnd(File):
+    """One endpoint with an optional read buffer and optional write buffer.
+
+    Subclasses set `_rx` (we read from it) and/or `_tx` (we write into it)
+    plus `peer` for cross-end state refresh. `_err_on_peer_close` controls
+    whether a dead reader marks the writer with ERROR (pipes do: EPIPE is
+    an error condition; unix sockets report plain HUP like Linux)."""
+
+    _err_on_peer_close = False
+
+    def __init__(self):
         super().__init__()
-        self.buf = buf
-        self.is_writer = writable
-        self.peer: "PipeEnd | None" = None
-        if writable:
-            buf.writers += 1
-            self._set_state(on=FileState.WRITABLE)
-        else:
-            buf.readers += 1
+        self._rx: _SharedBuf | None = None
+        self._tx: _SharedBuf | None = None
+        self.peer: "StreamEnd | None" = None
+
+    # ---- state -------------------------------------------------------------
 
     def _sync(self):
-        """Recompute state bits from buffer + peer liveness."""
         if self.closed:
             return
-        if self.is_writer:
-            if self.buf.readers == 0:
-                self._set_state(on=FileState.ERROR | FileState.HUP, off=FileState.WRITABLE)
-            elif self.buf.space() > 0:
-                self._set_state(on=FileState.WRITABLE)
-            else:
-                self._set_state(off=FileState.WRITABLE)
-        else:
-            readable = len(self.buf.data) > 0
-            hup = self.buf.writers == 0
-            on = FileState.NONE
-            off = FileState.NONE
-            if readable:
+        on = FileState.NONE
+        off = FileState.NONE
+        if self._rx is not None:
+            if len(self._rx.data) > 0:
                 on |= FileState.READABLE
             else:
                 off |= FileState.READABLE
-            if hup:
+            if self._rx.writers == 0:
+                on |= FileState.HUP | FileState.READABLE  # EOF is readable
+        if self._tx is not None:
+            if self._tx.readers == 0:
                 on |= FileState.HUP
-                if not readable:
-                    on |= FileState.READABLE  # EOF is readable (read -> b"")
-            self._set_state(on=on, off=off)
+                if self._err_on_peer_close:
+                    on |= FileState.ERROR
+                off |= FileState.WRITABLE
+            elif self._tx.space() > 0:
+                on |= FileState.WRITABLE
+            else:
+                off |= FileState.WRITABLE
+        # `on` wins over `off` (EOF marks an empty buffer readable)
+        self._set_state(on=on, off=off & ~on)
+
+    def _sync_both(self):
+        self._sync()
+        if self.peer is not None:
+            self.peer._sync()
+
+    # ---- I/O ---------------------------------------------------------------
 
     def read(self, n: int) -> bytes | None:
-        if self.is_writer:
-            raise OSError("EBADF: read on write end")
-        if self.buf.data:
-            out = bytes(self.buf.data[:n])
-            del self.buf.data[: len(out)]
-            self._sync()
-            if self.peer is not None:
-                self.peer._sync()
+        if self._rx is None:
+            raise OSError("EBADF: not readable")
+        if self._rx.data:
+            out = bytes(self._rx.data[:n])
+            del self._rx.data[: len(out)]
+            self._sync_both()
             return out
-        if self.buf.writers == 0:
+        if self._rx.writers == 0:
             return b""  # EOF
         return None  # would block
 
     def write(self, data: bytes) -> int | None:
-        if not self.is_writer:
-            raise OSError("EBADF: write on read end")
-        if self.buf.readers == 0:
-            raise BrokenPipeError("EPIPE: no readers")  # + SIGPIPE in reference
-        space = self.buf.space()
+        if self._tx is None:
+            raise OSError("EBADF: not writable")
+        if self._tx.readers == 0:
+            raise BrokenPipeError("EPIPE: no readers")
+        space = self._tx.space()
         if space == 0:
             return None  # would block
         took = bytes(data[:space])
-        self.buf.data += took
-        self._sync()
-        if self.peer is not None:
-            self.peer._sync()
+        self._tx.data += took
+        self._sync_both()
         return len(took)
+
+    def shutdown_write(self):
+        """Half-close the write direction (unix SHUT_WR; pipes via close)."""
+        if self._tx is not None:
+            self._tx.writers -= 1
+            self._tx = None
+            self._sync_both()
 
     def close(self):
         if self.closed:
             return
-        if self.is_writer:
-            self.buf.writers -= 1
-        else:
-            self.buf.readers -= 1
+        if self._tx is not None:
+            self._tx.writers -= 1
+            self._tx = None
+        if self._rx is not None:
+            self._rx.readers -= 1
+            self._rx = None
+        peer = self.peer
         super().close()
-        if self.peer is not None:
-            self.peer._sync()
+        if peer is not None:
+            peer._sync()
+
+
+class PipeEnd(StreamEnd):
+    _err_on_peer_close = True  # EPIPE surfaces as ERROR on the write end
+
+    def __init__(self, buf: _SharedBuf, writable: bool):
+        super().__init__()
+        self.is_writer = writable
+        if writable:
+            self._tx = buf
+            buf.writers += 1
+            self._set_state(on=FileState.WRITABLE)
+        else:
+            self._rx = buf
+            buf.readers += 1
 
 
 Pipe = PipeEnd  # exported name

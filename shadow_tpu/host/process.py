@@ -37,6 +37,7 @@ from shadow_tpu.host.filestate import CallbackQueue, FileState, StatusListener
 from shadow_tpu.host.pipe import create_pipe
 from shadow_tpu.host.sockets import TcpListenerSocket, TcpSocket, UdpSocket
 from shadow_tpu.host.timerfd import TimerFd
+from shadow_tpu.host.unix import UnixStreamSocket
 
 NS_PER_SEC = 1_000_000_000
 
@@ -393,14 +394,28 @@ class SyscallHandler:
             return proc.fds.register(UdpSocket(self.host.netns))
         if kind == "tcp":
             return proc.fds.register(TcpSocket(self.host.netns))
+        if kind == "unix":
+                return proc.fds.register(UnixStreamSocket())
         raise OSError(f"EINVAL: socket kind {kind!r}")
 
-    def sys_bind(self, proc, fd: int, addr: tuple):
-        proc.fds.get(fd).bind(addr[0], addr[1])
+    def sys_socketpair(self, proc):
+        a, b = UnixStreamSocket.make_pair()
+        return (proc.fds.register(a), proc.fds.register(b))
+
+    def sys_bind(self, proc, fd: int, addr):
+        f = proc.fds.get(fd)
+        if isinstance(f, UnixStreamSocket):
+            name = addr if isinstance(addr, str) else addr[0]
+            f.bind_abstract(self.host.netns.abstract_unix, name.lstrip("@"))
+            return 0
+        f.bind(addr[0], addr[1])
         return 0
 
     def sys_listen(self, proc, fd: int, backlog: int = 128):
         f = proc.fds.get(fd)
+        if isinstance(f, UnixStreamSocket):
+            f.listen()
+            return 0
         if isinstance(f, TcpListenerSocket):
             return 0
         if not isinstance(f, TcpSocket):
@@ -419,6 +434,11 @@ class SyscallHandler:
 
     def sys_accept(self, proc, fd: int):
         f = proc.fds.get(fd)
+        if isinstance(f, UnixStreamSocket):
+            child = f.accept()
+            if child is None:
+                return Blocked(file=f, mask=_WAIT_ACCEPT)
+            return (proc.fds.register(child), ("unix", 0))
         if not isinstance(f, TcpListenerSocket):
             raise OSError("EINVAL: accept on non-listener")
         child = f.accept()
@@ -427,8 +447,15 @@ class SyscallHandler:
         cfd = proc.fds.register(child)
         return (cfd, (child.peer_ip, child.peer_port))
 
-    def sys_connect(self, proc, fd: int, addr: tuple):
+    def sys_connect(self, proc, fd: int, addr):
         f = proc.fds.get(fd)
+        if isinstance(f, UnixStreamSocket):
+            name = (addr if isinstance(addr, str) else addr[0]).lstrip("@")
+            listener = self.host.netns.abstract_unix.get(name)
+            if listener is None:
+                raise ConnectionRefusedError(f"ECONNREFUSED: @{name}")
+            f.connect_to(listener)
+            return 0
         if isinstance(f, UdpSocket):
             f.connect(addr[0], addr[1])
             return 0
@@ -467,6 +494,11 @@ class SyscallHandler:
 
     def sys_shutdown(self, proc, fd: int):
         f = proc.fds.get(fd)
+        if isinstance(f, UnixStreamSocket):
+            if not f.connected:
+                raise OSError("ENOTCONN")
+            f.shutdown_write()
+            return 0
         if not isinstance(f, TcpSocket):
             raise OSError("ENOTSOCK")
         f.shutdown_write()
@@ -478,6 +510,10 @@ class SyscallHandler:
 
     def sys_getpeername(self, proc, fd: int):
         f = proc.fds.get(fd)
+        if isinstance(f, UnixStreamSocket):
+            if not f.connected:
+                raise OSError("ENOTCONN")
+            return ("unix", 0)
         if f.peer_ip is None:
             raise OSError("ENOTCONN")
         return (f.peer_ip, f.peer_port)

@@ -1,0 +1,108 @@
+"""Unix-domain stream sockets + socketpair.
+
+Reference: `host/descriptor/socket/unix/` (2419 LoC — connection-oriented
+unix sockets over shared buffers, plus the abstract-name namespace in
+`socket/abstract_unix_ns.rs`). A connected unix stream socket is a crossed
+pair of bounded byte buffers — the generic `StreamEnd` from
+`host/pipe.py` provides the whole stream I/O surface; this module adds
+connection setup (pair/bind/listen/connect/accept) and the abstract
+namespace.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.host.filestate import FileState
+from shadow_tpu.host.pipe import StreamEnd, _SharedBuf
+
+UNIX_BUF = 212992  # Linux default unix-socket buffer
+
+
+class UnixStreamSocket(StreamEnd):
+    """One end of a connected unix stream pair (or a listener)."""
+
+    def __init__(self):
+        super().__init__()
+        # listener state (bound to an abstract name)
+        self.listening = False
+        self.bound_name: str | None = None
+        self._accept_q: list["UnixStreamSocket"] = []
+        self._ns: dict | None = None  # abstract namespace (host-owned)
+
+    @property
+    def connected(self) -> bool:
+        return self._rx is not None or self._tx is not None
+
+    # ---- connection setup --------------------------------------------------
+
+    @staticmethod
+    def make_pair() -> tuple["UnixStreamSocket", "UnixStreamSocket"]:
+        """socketpair(2): two connected ends."""
+        a, b = UnixStreamSocket(), UnixStreamSocket()
+        ab, ba = _SharedBuf(UNIX_BUF), _SharedBuf(UNIX_BUF)
+        for buf in (ab, ba):
+            buf.readers = buf.writers = 1
+        a._tx, a._rx = ab, ba
+        b._tx, b._rx = ba, ab
+        a.peer, b.peer = b, a
+        a._set_state(on=FileState.WRITABLE)
+        b._set_state(on=FileState.WRITABLE)
+        return a, b
+
+    def bind_abstract(self, ns: dict, name: str):
+        if name in ns:
+            raise OSError(f"EADDRINUSE: @{name}")
+        ns[name] = self
+        self._ns = ns
+        self.bound_name = name
+
+    def listen(self):
+        if self.bound_name is None:
+            raise OSError("EINVAL: listen before bind")
+        self.listening = True
+
+    def connect_to(self, listener: "UnixStreamSocket") -> None:
+        """Connect to a listening socket: forks a server-side end into the
+        listener's accept queue (unix connects are immediate — no network
+        latency — same as the reference)."""
+        if self.connected:
+            raise OSError("EISCONN: already connected")
+        if not listener.listening:
+            raise OSError("ECONNREFUSED")
+        server_end, client_end = UnixStreamSocket.make_pair()
+        # graft the client_end's plumbing into *this* socket
+        self._tx, self._rx = client_end._tx, client_end._rx
+        self.peer = server_end
+        server_end.peer = self
+        self._set_state(on=FileState.WRITABLE)
+        listener._accept_q.append(server_end)
+        listener._set_state(on=FileState.ACCEPTABLE | FileState.READABLE)
+
+    def accept(self) -> "UnixStreamSocket | None":
+        if not self._accept_q:
+            return None
+        child = self._accept_q.pop(0)
+        if not self._accept_q:
+            self._set_state(off=FileState.ACCEPTABLE | FileState.READABLE)
+        return child
+
+    # ---- I/O: StreamEnd provides read/write/shutdown_write/_sync ----------
+
+    def read(self, n: int):
+        if not self.connected and not self.listening:
+            raise OSError("ENOTCONN")
+        return super().read(n)
+
+    def write(self, data: bytes):
+        if not self.connected:
+            raise OSError("ENOTCONN")
+        return super().write(data)
+
+    def close(self):
+        if self.closed:
+            return
+        if self.bound_name is not None and self._ns is not None:
+            self._ns.pop(self.bound_name, None)
+        for child in self._accept_q:
+            child.close()
+        self._accept_q.clear()
+        super().close()

@@ -119,6 +119,53 @@ def tgen_client(ctx):
 
 
 @register_program
+def tgen_duration_client(ctx):
+    """Stream to `server` for `duration` seconds, then FIN (the reference's
+    tgen fixed_duration flow, src/test/tgen/fixed_duration)."""
+    server = ctx.args.get("server", "server")
+    port = int(ctx.args.get("port", 8080))
+    duration_ns = int(float(ctx.args.get("duration_s", 5)) * SEC)
+    ip = yield ("resolve", server)
+    fd = yield ("socket", "tcp")
+    yield ("connect", fd, (ip, port))
+    t0 = yield ("clock_gettime",)
+    block = bytes(range(256)) * 256
+    sent = 0
+    while True:
+        now = yield ("clock_gettime",)
+        if now - t0 >= duration_ns:
+            break
+        sent += yield ("send", fd, block)
+    yield ("shutdown", fd)
+    yield (
+        "write_stdout",
+        f"sent={sent} duration_ns={now - t0} "
+        f"goodput_mbps={sent * 8e3 / max(now - t0, 1):.2f}\n".encode(),
+    )
+    yield ("exit", 0)
+
+
+@register_program
+def unix_echo_pair(ctx):
+    """Single-host unix-domain smoke workload: a socketpair echo plus an
+    abstract-namespace listener/connector (reference socket/unix tests)."""
+    a, b = yield ("socketpair",)
+    yield ("write", a, b"ping")
+    data = yield ("read", b, 16)
+    assert data == b"ping", data
+    lst = yield ("socket", "unix")
+    yield ("bind", lst, "@echo")
+    yield ("listen", lst)
+    cli = yield ("socket", "unix")
+    yield ("connect", cli, "@echo")
+    srv, _ = yield ("accept", lst)
+    yield ("write", cli, b"hello-unix")
+    got = yield ("read", srv, 64)
+    yield ("write_stdout", b"unix ok: " + got + b"\n")
+    yield ("exit", 0)
+
+
+@register_program
 def phold_proc(ctx):
     """PHOLD as a managed program (the reference runs PHOLD as a real socket
     binary, src/test/phold/): hold `population` jobs, mature each after an

@@ -278,6 +278,7 @@ class Simulation:
                 h.bw_up_bits > 0 or h.bw_down_bits > 0 for h in self.hosts
             ),
             cheap_shed=ex.overflow_shed == "append",
+            cpu_delay_ns=ex.cpu_delay,
         )
         mesh = None
         if world > 1:

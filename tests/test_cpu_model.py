@@ -1,0 +1,67 @@
+"""Device-side CPU delay model tests (reference host/cpu.rs +
+host.rs:820-847 CPU-delay event rescheduling)."""
+
+from __future__ import annotations
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+MS = 1_000_000
+
+
+def _cfg(cpu_delay: str | int = 0):
+    return ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "2 s", "seed": 3},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "experimental": {"cpu_delay": cpu_delay},
+            "hosts": {
+                "n": {
+                    "count": 8,
+                    "network_node_id": 0,
+                    "processes": [
+                        {"model": "timer", "model_args": {"interval": "100 ms"}}
+                    ],
+                }
+            },
+        }
+    )
+
+
+def test_cpu_delay_off_by_default_and_deterministic():
+    a = Simulation(_cfg(), world=1)
+    a.run(progress=False)
+    b = Simulation(_cfg(), world=1)
+    b.run(progress=False)
+    assert (
+        a.stats_report()["determinism_digest"]
+        == b.stats_report()["determinism_digest"]
+    )
+
+
+def test_cpu_delay_below_event_spacing_is_invisible():
+    """A CPU charge smaller than the event spacing never defers anything:
+    the run is bit-identical to the delay-free one (the reference's CPU
+    model likewise only bites when the CPU is still busy at pop time)."""
+    base = Simulation(_cfg(0), world=1)
+    base.run(progress=False)
+    delayed = Simulation(_cfg("1 ms"), world=1)
+    delayed.run(progress=False)
+    rb = base.stats_report()
+    rd = delayed.stats_report()
+    assert rd["events_processed"] == rb["events_processed"]
+    assert rd["determinism_digest"] == rb["determinism_digest"]
+
+
+def test_cpu_delay_throttles_dense_events():
+    """A CPU delay LARGER than the event spacing must throttle execution:
+    fewer events fit in the simulated horizon (the busy CPU pushes work
+    past stop_time), exactly the reference's busy-CPU deferral."""
+    base = Simulation(_cfg(0), world=1)
+    base.run(progress=False)
+    slow = Simulation(_cfg("300 ms"), world=1)  # 3x the timer interval
+    slow.run(progress=False)
+    assert (
+        slow.stats_report()["events_processed"]
+        < base.stats_report()["events_processed"]
+    )

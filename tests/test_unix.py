@@ -1,0 +1,111 @@
+"""Unix-domain socket tests (reference host/descriptor/socket/unix/ +
+abstract_unix_ns.rs test families)."""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.host import CpuHost, FileState, HostConfig
+from shadow_tpu.host.unix import UnixStreamSocket
+
+SEC = 1_000_000_000
+
+
+def test_socketpair_duplex_and_eof():
+    a, b = UnixStreamSocket.make_pair()
+    assert a.write(b"x" * 10) == 10
+    assert b.read(4) == b"x" * 4
+    assert b.write(b"reply") == 5
+    assert a.read(64) == b"reply"
+    a.close()
+    assert b.read(64) == b"xxxxxx"  # drains remaining buffered bytes
+    assert b.read(64) == b""  # then EOF
+    assert b.state & FileState.HUP
+    with pytest.raises(BrokenPipeError):
+        b.write(b"dead")
+
+
+def test_socketpair_backpressure():
+    a, b = UnixStreamSocket.make_pair()
+    total = 0
+    while (n := a.write(b"y" * 65536)) is not None:
+        total += n
+    assert not (a.state & FileState.WRITABLE)
+    b.read(1000)
+    assert a.state & FileState.WRITABLE
+
+
+def test_abstract_namespace_listen_connect():
+    ns: dict = {}
+    lst = UnixStreamSocket()
+    lst.bind_abstract(ns, "svc")
+    lst.listen()
+    with pytest.raises(OSError):
+        UnixStreamSocket().bind_abstract(ns, "svc")  # EADDRINUSE
+    cli = UnixStreamSocket()
+    cli.connect_to(lst)
+    srv = lst.accept()
+    assert srv is not None
+    cli.write(b"req")
+    assert srv.read(16) == b"req"
+    srv.write(b"resp")
+    assert cli.read(16) == b"resp"
+    lst.close()
+    assert "svc" not in ns
+
+
+def test_unix_program_end_to_end():
+    h = CpuHost(HostConfig(name="h", ip="10.0.0.1", seed=1))
+    from shadow_tpu.programs import get_program
+
+    p = h.spawn(get_program("unix_echo_pair"))
+    h.execute(1 * SEC)
+    assert p.exit_code == 0, b"".join(p.stderr)
+    assert b"unix ok: hello-unix" in b"".join(p.stdout)
+
+
+def test_reconnect_raises_eisconn():
+    ns: dict = {}
+    lst = UnixStreamSocket()
+    lst.bind_abstract(ns, "svc")
+    lst.listen()
+    cli = UnixStreamSocket()
+    cli.connect_to(lst)
+    with pytest.raises(OSError, match="EISCONN"):
+        cli.connect_to(lst)
+
+
+def test_unix_shutdown_write_delivers_eof():
+    h = CpuHost(HostConfig(name="h", ip="10.0.0.1", seed=1))
+    got = []
+
+    def prog(ctx):
+        a, b = yield ("socketpair",)
+        yield ("write", a, b"bye")
+        yield ("shutdown", a)
+        got.append((yield ("read", b, 16)))
+        got.append((yield ("read", b, 16)))  # EOF after drain
+        got.append((yield ("getpeername", b)))
+        yield ("exit", 0)
+
+    p = h.spawn(prog)
+    h.execute(SEC)
+    assert p.exit_code == 0, b"".join(p.stderr)
+    assert got == [b"bye", b"", ("unix", 0)]
+
+
+def test_connect_unbound_name_refused():
+    h = CpuHost(HostConfig(name="h", ip="10.0.0.1", seed=1))
+    errs = []
+
+    def prog(ctx):
+        fd = yield ("socket", "unix")
+        try:
+            yield ("connect", fd, "@nobody")
+        except OSError as e:
+            errs.append(str(e))
+        yield ("exit", 0)
+
+    h.spawn(prog)
+    h.execute(1 * SEC)
+    assert errs and "ECONNREFUSED" in errs[0]
