@@ -176,6 +176,11 @@ class ExperimentalOptions:
                 f"experimental.overflow_shed must be urgency|append, "
                 f"got {e.overflow_shed!r}"
             )
+        if e.scheduler not in ("tpu", "cpu-reference"):
+            raise ConfigError(
+                f"experimental.scheduler must be tpu|cpu-reference, "
+                f"got {e.scheduler!r}"
+            )
         for f in ("use_dynamic_runahead", "use_codel"):
             if f in d:
                 setattr(e, f, bool(d.pop(f)))

@@ -592,17 +592,23 @@ def _effective_next(cfg: EngineConfig, st: SimState):
 
 def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     if cfg.cpu_delay_ns > 0:
-        # a still-busy host does not pop at all this window; events stay in
-        # the queue so their (time, order) sequence is preserved verbatim
+        # a host busy past the window does not pop at all; events stay in
+        # the queue so their (time, order) sequence is preserved verbatim.
+        # An event popped while the CPU is busy *within* the window executes
+        # at busy_until (host.rs:820-847): rewrite ev.t to the execution
+        # time so every downstream consumer (handler ctx, digest, pushes,
+        # egress departure) sees the delayed clock, never a stale one.
+        # Both busy_until and ev.t are < window_end here, so the execution
+        # time stays inside the window.
         limit_h = jnp.where(
             st.cpu_busy_until < window_end, window_end, jnp.int64(0)
         )
         queue, ev, active = pop_min(st.queue, limit_h)
+        exec_t = jnp.maximum(ev.t, st.cpu_busy_until)
+        ev = ev._replace(t=jnp.where(active, exec_t, ev.t))
         st = st._replace(
             cpu_busy_until=jnp.where(
-                active,
-                jnp.maximum(st.cpu_busy_until, ev.t) + cfg.cpu_delay_ns,
-                st.cpu_busy_until,
+                active, exec_t + cfg.cpu_delay_ns, st.cpu_busy_until
             )
         )
     else:

@@ -395,7 +395,7 @@ class SyscallHandler:
         if kind == "tcp":
             return proc.fds.register(TcpSocket(self.host.netns))
         if kind == "unix":
-                return proc.fds.register(UnixStreamSocket())
+            return proc.fds.register(UnixStreamSocket())
         raise OSError(f"EINVAL: socket kind {kind!r}")
 
     def sys_socketpair(self, proc):
@@ -406,7 +406,7 @@ class SyscallHandler:
         f = proc.fds.get(fd)
         if isinstance(f, UnixStreamSocket):
             name = addr if isinstance(addr, str) else addr[0]
-            f.bind_abstract(self.host.netns.abstract_unix, name.lstrip("@"))
+            f.bind_abstract(self.host.netns.abstract_unix, name.removeprefix("@"))
             return 0
         f.bind(addr[0], addr[1])
         return 0
@@ -450,7 +450,7 @@ class SyscallHandler:
     def sys_connect(self, proc, fd: int, addr):
         f = proc.fds.get(fd)
         if isinstance(f, UnixStreamSocket):
-            name = (addr if isinstance(addr, str) else addr[0]).lstrip("@")
+            name = (addr if isinstance(addr, str) else addr[0]).removeprefix("@")
             listener = self.host.netns.abstract_unix.get(name)
             if listener is None:
                 raise ConnectionRefusedError(f"ECONNREFUSED: @{name}")

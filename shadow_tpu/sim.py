@@ -341,6 +341,8 @@ class Simulation:
                 model=self._pad(mparams),
             )
             padded_state = self._pad(mstate)
+        # kept for the cpu-reference scheduler path (golden engine inputs)
+        self._golden_inputs = (params, padded_state, events)
         self.state, self.params = self.engine.init_state(
             params, padded_state, events, seed=cfg.general.seed
         )
@@ -350,6 +352,8 @@ class Simulation:
     def run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
         """Drive chunks until done. Returns the final stats report dict."""
         cfg = self.cfg
+        if cfg.experimental.scheduler == "cpu-reference":
+            return self._run_golden()
         show_progress = cfg.general.progress if progress is None else progress
         hb_ns = cfg.general.heartbeat_interval
         t0 = time.monotonic()
@@ -379,10 +383,67 @@ class Simulation:
         self._chunks = chunks
         return self.stats_report()
 
+    def _run_golden(self) -> dict:
+        """`experimental.scheduler: cpu-reference` — run the independent
+        pure-Python golden engine instead of the device engine (the
+        reference's two-scheduler determinism capability, src/test/
+        determinism 2a/2b vs 2c: scheduler choice must not change results).
+        """
+        from shadow_tpu.core.golden import run_golden
+
+        if self.engine_cfg.cpu_delay_ns > 0:
+            raise ConfigError(
+                "experimental.cpu_delay is not modeled by the cpu-reference "
+                "scheduler; use scheduler: tpu"
+            )
+        params, mstate, events = self._golden_inputs
+        t0 = time.monotonic()
+        gold = run_golden(
+            self.engine_cfg, self.model, params, mstate, events,
+            seed=self.cfg.general.seed,
+        )
+        self._wall_seconds = time.monotonic() - t0
+        self._chunks = 0
+        self._golden = gold
+        n = self._num_real
+        sim_s = gold.now / NS_PER_SEC
+        self._golden_report = {
+            "simulated_seconds": sim_s,
+            "wall_seconds": self._wall_seconds,
+            "sim_wall_ratio": sim_s / max(self._wall_seconds, 1e-9),
+            "scheduler": "cpu-reference",
+            "rounds": gold.rounds,
+            "microsteps": gold.microsteps,
+            "events_processed": int(gold.stats["events"][:n].sum()),
+            "packets_sent": int(gold.stats["pkts_sent"][:n].sum()),
+            "packets_delivered": int(gold.stats["pkts_delivered"][:n].sum()),
+            "packets_lost": int(gold.stats["pkts_lost"][:n].sum()),
+            "packets_unreachable": int(gold.stats["pkts_unreachable"][:n].sum()),
+            "packets_codel_dropped": int(
+                gold.stats["pkts_codel_dropped"][:n].sum()
+            ),
+            "queue_overflow_dropped": int(gold.stats["dropped"][:n].sum()),
+            "packets_budget_dropped": int(
+                gold.stats["pkts_budget_dropped"][:n].sum()
+            ),
+            "outbox_overflow_dropped": 0,  # golden has no staging outbox
+            "monotonic_violations": int(
+                gold.stats["monotonic_violations"][:n].sum()
+            ),
+            "determinism_digest": f"{int(np.bitwise_xor.reduce(gold.digests[:n])):016x}",
+            "model_report": self.model.report(
+                jax.tree.map(lambda a: np.asarray(a)[:n], gold.model_state),
+                self._model_hosts(),
+            ),
+        }
+        return self._golden_report
+
     # ---- outputs ----------------------------------------------------------
 
     def stats_report(self) -> dict:
         """sim-stats content (reference sim_stats.rs counters + tracker.c)."""
+        if getattr(self, "_golden_report", None) is not None:
+            return self._golden_report  # cpu-reference run: device state unused
         s = jax.device_get(self.state.stats)
         n = self._num_real
         wall = getattr(self, "_wall_seconds", None)
@@ -430,8 +491,16 @@ class Simulation:
             report = self.stats_report()
         with open(os.path.join(data_dir, "sim-stats.json"), "w") as f:
             json.dump(report, f, indent=2)
-        s = jax.device_get(self.state.stats)
-        digests = self.host_digests()
+        gold = getattr(self, "_golden", None)
+        if gold is not None:
+            events_c, sent_c = gold.stats["events"], gold.stats["pkts_sent"]
+            deliv_c, lost_c = gold.stats["pkts_delivered"], gold.stats["pkts_lost"]
+            digests = gold.digests
+        else:
+            s = jax.device_get(self.state.stats)
+            events_c, sent_c = s.events, s.pkts_sent
+            deliv_c, lost_c = s.pkts_delivered, s.pkts_lost
+            digests = self.host_digests()
         for h in self.hosts:
             hd = os.path.join(data_dir, "hosts", h.name)
             os.makedirs(hd, exist_ok=True)
@@ -440,10 +509,10 @@ class Simulation:
                     {
                         "name": h.name,
                         "ip": h.ip,
-                        "events_processed": int(s.events[h.host_id]),
-                        "packets_sent": int(s.pkts_sent[h.host_id]),
-                        "packets_delivered": int(s.pkts_delivered[h.host_id]),
-                        "packets_lost": int(s.pkts_lost[h.host_id]),
+                        "events_processed": int(events_c[h.host_id]),
+                        "packets_sent": int(sent_c[h.host_id]),
+                        "packets_delivered": int(deliv_c[h.host_id]),
+                        "packets_lost": int(lost_c[h.host_id]),
                         "determinism_digest": f"{int(digests[h.host_id]):016x}",
                     },
                     f,

@@ -1,0 +1,64 @@
+"""Config-level dual-scheduler gate (reference determinism test 2:
+thread-per-host vs thread-per-core runs must byte-match; here tpu vs
+cpu-reference — src/test/determinism/CMakeLists.txt:1-74)."""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from shadow_tpu.sim import Simulation
+
+
+def _cfg(scheduler: str, extra_exp: dict | None = None):
+    exp = {"scheduler": scheduler}
+    exp.update(extra_exp or {})
+    return ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "1 s", "seed": 9},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "experimental": exp,
+            "hosts": {
+                "n": {
+                    "count": 6,
+                    "network_node_id": 0,
+                    "processes": [
+                        {
+                            "model": "gossip",
+                            "model_args": {"fanout": 2, "rounds": 6,
+                                           "interval": "100 ms"},
+                        }
+                    ],
+                }
+            },
+        }
+    )
+
+
+def test_scheduler_choice_does_not_change_results(tmp_path):
+    dev = Simulation(_cfg("tpu"), world=1)
+    dev_report = dev.run(progress=False)
+    gold = Simulation(_cfg("cpu-reference"), world=1)
+    gold_report = gold.run(progress=False)
+    assert (
+        dev_report["determinism_digest"] == gold_report["determinism_digest"]
+    )
+    for k in ("events_processed", "packets_sent", "packets_delivered",
+              "packets_lost", "rounds"):
+        assert dev_report[k] == gold_report[k], k
+    # outputs directory works for the golden path too
+    out = gold.write_outputs(str(tmp_path / "gold"), report=gold_report)
+    assert (tmp_path / "gold" / "hosts" / "n1" / "host-stats.json").exists()
+
+
+def test_unknown_scheduler_rejected():
+    with pytest.raises(ConfigError, match="scheduler"):
+        _cfg("gpu")
+
+
+def test_cpu_reference_rejects_cpu_delay():
+    from shadow_tpu.config.options import ConfigError
+
+    sim = Simulation(_cfg("cpu-reference", {"cpu_delay": "1 ms"}), world=1)
+    with pytest.raises(ConfigError, match="cpu_delay"):
+        sim.run(progress=False)
