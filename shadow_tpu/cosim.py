@@ -13,9 +13,11 @@ hard part 6):
        host-side in a by-(src, key) store
     3. one jitted `prepare` op: reset capture rings + merge the staged
        send-requests into the device queues (sorted deterministic scatter)
-    4. one jitted `window` op: the engine's microstep loop + exchange — the
-       full egress pipeline (budget, token bucket, loss, latency, clamp)
-       applies to CPU-origin packets exactly as to modeled traffic
+    4. one jitted guarded round loop: engine rounds — microsteps + the full
+       egress pipeline (budget, token bucket, loss, latency, clamp) +
+       exchange — run back to back on device until a round captures
+       host-bound deliveries (the CPU plane must react) or the device
+       catches up to the CPU plane's next event
     5. drain capture rings; map (src, key) back to bytes; schedule socket
        delivery on each destination CPU host at the captured arrival time
 
@@ -90,9 +92,9 @@ class HybridSimulation:
             queue_capacity=qcap,
             sends_per_host_round=max(ex.sends_per_host_round, 32),
             max_round_inserts=ex.max_round_inserts or qcap,
-            # bounds the guarded idle-batch (the per-window step itself always
-            # executes exactly one forced window regardless)
-            rounds_per_chunk=ex.rounds_per_chunk,
+            # bounds the guarded round loop — the ONLY device execution path,
+            # so it must be >= 1 or nothing would ever advance
+            rounds_per_chunk=max(ex.rounds_per_chunk, 1),
             microstep_limit=ex.microstep_limit,
             world=1,
             shaping=any(
@@ -222,10 +224,6 @@ class HybridSimulation:
             functools.partial(_prepare_window, self.engine_cfg, self.model),
             donate_argnums=0,
         )
-        self._window = jax.jit(
-            functools.partial(eng._window_step, self.engine_cfg, self.model, None),
-            donate_argnums=0,
-        )
         self._guarded = jax.jit(
             functools.partial(
                 eng._run_guarded_chunk,
@@ -288,29 +286,24 @@ class HybridSimulation:
             with self.perf.time("host_plane"):
                 for h in self.hosts:  # deterministic host order
                     h.execute(window_end)
-            # drain ALL staged sends for this window (multiple passes when a
-            # burst exceeds the staging cap) so no send ever carries a stale
-            # timestamp into a later window
+            # inject staged sends, then run device rounds until the first
+            # round that captures host-bound deliveries (the CPU plane must
+            # react) or the device catches up to the CPU plane's next event.
+            # Loops for staging-cap overflow so no send ever carries a stale
+            # timestamp into a later window.
             while True:
-                with self.perf.time("device_window"):
-                    self.state = self._inject_and_run(window_end)
+                with self.perf.time("device_inject"):
+                    self.state = self._inject()
+                until = min(self._cpu_min_next(), stop)
+                with self.perf.time("device_rounds"):
+                    self.state = self._guarded(
+                        self.state, self.params,
+                        jnp.asarray(max(until, window_end), jnp.int64),
+                    )
                 with self.perf.time("drain_captures"):
                     self._drain_captures()
                 if not self._staged:
                     break
-            # batch further device rounds while the CPU plane is idle: the
-            # guarded chunk exits on the first round that captures a
-            # host-bound delivery (or when the device catches up to the CPU
-            # plane's next event)
-            cpu_min = self._cpu_min_next()
-            if cpu_min > window_end:
-                with self.perf.time("device_batch"):
-                    self.state = self._guarded(
-                        self.state, self.params,
-                        jnp.asarray(min(cpu_min, stop), jnp.int64),
-                    )
-                with self.perf.time("drain_captures"):
-                    self._drain_captures()
             windows += 1
             if hb_ns and window_end >= next_hb:
                 wall = time.monotonic() - t0
@@ -341,7 +334,10 @@ class HybridSimulation:
         self._windows = windows
         return self.stats_report()
 
-    def _inject_and_run(self, window_end: int):
+    def _inject(self):
+        """Merge up to staging_cap staged sends into the device queues (and
+        clear the capture rings); the guarded round loop computes its own
+        windows from the queue contents."""
         cap = self.staging_cap
         staged = self._staged[:cap]
         overflow = self._staged[cap:]
@@ -365,7 +361,7 @@ class HybridSimulation:
             payload[i, PW_KEY] = key
             valid[i] = True
         self._window_idx += 1
-        state = self._prepare(
+        return self._prepare(
             self.state,
             jnp.asarray(dstw),
             jnp.asarray(t),
@@ -373,12 +369,6 @@ class HybridSimulation:
             jnp.asarray(kind),
             jnp.asarray(payload),
             jnp.asarray(valid),
-        )
-        return self._window(
-            state,
-            self.params,
-            jnp.asarray(window_end, jnp.int64),
-            jnp.zeros((), bool),
         )
 
     def _drain_captures(self):

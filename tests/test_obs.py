@@ -71,7 +71,7 @@ def test_pcap_and_strace_artifacts(tmp_path):
     assert strace, "no strace file written"
     text = strace[0].read_text()
     assert "sendto(" in text and "recvfrom(" in text and "= " in text
-    assert report["perf"]["device_window"]["calls"] > 0
+    assert report["perf"]["device_rounds"]["calls"] > 0
 
 
 def test_observability_artifacts_bit_identical(tmp_path):
