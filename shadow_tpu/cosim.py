@@ -310,7 +310,8 @@ class HybridSimulation:
                 print(
                     f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s windows={windows} "
-                    f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x",
+                    f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x "
+                    f"{simmod.resource_heartbeat()}",
                     file=log,
                 )
                 next_hb = (window_end // hb_ns + 1) * hb_ns

@@ -370,7 +370,8 @@ class Simulation:
                     f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s events={ev} "
                     f"rounds={int(self.state.stats.rounds)} "
-                    f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x",
+                    f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
+                    f"{resource_heartbeat()}",
                     file=log,
                 )
                 next_hb = (now_ns // hb_ns + 1) * hb_ns
@@ -519,6 +520,34 @@ class Simulation:
                     indent=2,
                 )
         return data_dir
+
+
+def resource_heartbeat() -> str:
+    """Process-resource snippet for heartbeat lines (the reference logs
+    getrusage + /proc/meminfo every interval in a tornettools-parseable
+    format, manager.rs:675-717)."""
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux but bytes on macOS
+        rss_div = (1 << 30) if sys.platform == "darwin" else (1 << 20)
+        rss_gib = ru.ru_maxrss / rss_div
+        mem_avail = ""
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable:"):
+                        mem_avail = f" mem_avail_gib={int(line.split()[1]) / (1 << 20):.1f}"
+                        break
+        except OSError:
+            pass
+        return (
+            f"rss_gib={rss_gib:.2f} utime_min={ru.ru_utime / 60:.1f} "
+            f"stime_min={ru.ru_stime / 60:.1f}{mem_avail}"
+        )
+    except Exception:
+        return ""
 
 
 def run_simulation(cfg: ConfigOptions, **kw) -> tuple[Simulation, dict]:

@@ -21,6 +21,10 @@ HEARTBEAT_RE = re.compile(
     r"\[heartbeat\] sim_time=(?P<sim>[\d.]+)s wall=(?P<wall>[\d.]+)s "
     r"(?:events=(?P<events>\d+) )?(?:rounds=(?P<rounds>\d+) |windows=(?P<windows>\d+) )?"
     r"ratio=(?P<ratio>[\d.]+)x"
+    r"(?: rss_gib=(?P<rss_gib>[\d.]+))?"
+    r"(?: utime_min=(?P<utime_min>[\d.]+))?"
+    r"(?: stime_min=(?P<stime_min>[\d.]+))?"
+    r"(?: mem_avail_gib=(?P<mem_avail_gib>[\d.]+))?"
 )
 
 
