@@ -49,10 +49,14 @@ static uintptr_t g_tramp_page = 0;
 /* ----------------------------------------------------------- trampoline */
 
 /* mov rax,rdi; mov rdi,rsi; mov rsi,rdx; mov rdx,rcx; mov r10,r8;
- * mov r8,r9; mov r9,[rsp+8]; syscall; ret */
+ * mov r8,r9; mov r9,[rsp+8]; syscall; ret
+ * (48 89 ca = mov rdx,rcx — NOT 48 89 ce, which is mov rsi,rcx and
+ * silently swaps syscall args 2/3: write(fd,n,buf), openat(fd,NULL,path),
+ * futex(addr,val,op) — i.e. every pointer re-issue EFAULTs and every
+ * shim-side futex is a no-op) */
 static const unsigned char TRAMP_CODE[] = {
     0x48, 0x89, 0xf8, 0x48, 0x89, 0xf7, 0x48, 0x89, 0xd6, 0x48, 0x89,
-    0xce, 0x4d, 0x89, 0xc2, 0x4d, 0x89, 0xc8, 0x4c, 0x8b, 0x4c, 0x24,
+    0xca, 0x4d, 0x89, 0xc2, 0x4d, 0x89, 0xc8, 0x4c, 0x8b, 0x4c, 0x24,
     0x08, 0x0f, 0x05, 0xc3,
 };
 

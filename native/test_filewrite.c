@@ -1,0 +1,35 @@
+/* Regular-file write passthrough: a managed binary writing its own output
+ * file (logs, results) must hit the native write path, not ENOSYS
+ * (reference regular_file.c passthrough policy). Writes via write(2) and
+ * writev(2), reads the file back, prints the round-tripped content. */
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    const char *path = argc > 1 ? argv[1] : "/tmp/shadow_filewrite.out";
+    int fd = open(path, O_CREAT | O_TRUNC | O_WRONLY, 0644);
+    if (fd < 0) { perror("open"); return 1; }
+    const char *a = "hello ", *b = "file ", *c = "world\n";
+    if (write(fd, a, strlen(a)) != (ssize_t)strlen(a)) { perror("write"); return 2; }
+    struct iovec iov[2] = {
+        {(void *)b, strlen(b)}, {(void *)c, strlen(c)},
+    };
+    ssize_t n = writev(fd, iov, 2);
+    if (n != (ssize_t)(strlen(b) + strlen(c))) { perror("writev"); return 3; }
+    if (close(fd)) { perror("close"); return 4; }
+
+    fd = open(path, O_RDONLY);
+    if (fd < 0) { perror("reopen"); return 5; }
+    char buf[128];
+    n = read(fd, buf, sizeof buf - 1);
+    if (n < 0) { perror("read"); return 6; }
+    buf[n] = 0;
+    close(fd);
+    unlink(path);
+    printf("roundtrip: %s", buf);
+    return 0;
+}

@@ -1,0 +1,44 @@
+/* Kernel value-result semantics for (sockaddr*, socklen_t*): a caller
+ * passing a short buffer must not have adjacent memory overwritten, and the
+ * true address length must be stored back (accept(2) NOTES). */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(void) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in any = {0};
+    any.sin_family = AF_INET;
+    any.sin_port = htons(7777);
+    if (bind(fd, (struct sockaddr *)&any, sizeof any)) { perror("bind"); return 1; }
+
+    struct {
+        char addr[8];     /* deliberately too small for sockaddr_in (16) */
+        char guard[8];    /* must survive untouched */
+    } shortbuf;
+    memset(shortbuf.addr, 0, sizeof shortbuf.addr);
+    memset(shortbuf.guard, 0xAA, sizeof shortbuf.guard);
+    socklen_t len = sizeof shortbuf.addr; /* = 8 */
+    if (getsockname(fd, (struct sockaddr *)shortbuf.addr, &len)) {
+        perror("getsockname");
+        return 2;
+    }
+    int guard_ok = 1;
+    for (unsigned i = 0; i < sizeof shortbuf.guard; i++)
+        if ((unsigned char)shortbuf.guard[i] != 0xAA) guard_ok = 0;
+    /* the stored-back length is the TRUE size, not the truncated one */
+    printf("guard_ok=%d len=%u port=%u\n", guard_ok, (unsigned)len,
+           ntohs(((struct sockaddr_in *)shortbuf.addr)->sin_port));
+
+    /* full-size buffer for comparison */
+    struct sockaddr_in full = {0};
+    socklen_t flen = sizeof full;
+    if (getsockname(fd, (struct sockaddr *)&full, &flen)) { perror("full"); return 3; }
+    printf("full len=%u port=%u\n", (unsigned)flen, ntohs(full.sin_port));
+    close(fd);
+    return 0;
+}

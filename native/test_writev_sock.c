@@ -1,0 +1,33 @@
+/* writev(2) on an emulated socket: a connected-UDP writev with multiple
+ * iovs must go out as ONE datagram (and not ENOSYS — review finding). */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    const char *ip = argc > 1 ? argv[1] : "127.0.0.1";
+    int port = argc > 2 ? atoi(argv[2]) : 9000;
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in dst = {0};
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons(port);
+    if (inet_pton(AF_INET, ip, &dst.sin_addr) != 1) { perror("inet_pton"); return 1; }
+    if (connect(fd, (struct sockaddr *)&dst, sizeof dst)) { perror("connect"); return 1; }
+    char *a = "ping", *b = " 0";
+    struct iovec iov[2] = {{a, strlen(a)}, {b, strlen(b)}};
+    ssize_t n = writev(fd, iov, 2);
+    if (n != (ssize_t)(strlen(a) + strlen(b))) { perror("writev"); return 2; }
+    char buf[256];
+    ssize_t got = recv(fd, buf, sizeof buf - 1, 0);
+    if (got < 0) { perror("recv"); return 3; }
+    buf[got] = 0;
+    printf("echo: %s\n", buf);
+    close(fd);
+    return 0;
+}

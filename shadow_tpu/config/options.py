@@ -141,7 +141,7 @@ class ExperimentalOptions:
     # CPU model: simulated computation time charged per handled event
     # (reference host/cpu.rs; 0 = off). Applies to device-modeled hosts;
     # the pure-CPU oracle scheduler does not model it.
-    cpu_delay: int = 0  # ns
+    cpu_delay: int = 0  # stored ns; bare numbers in YAML/CLI parse as ms
     # --- TPU engine static shapes ---
     event_queue_capacity: int = 64  # per-host pending-event slots
     sends_per_host_round: int = 8  # per-host round send budget (drop above)
@@ -385,7 +385,8 @@ def merge_cli_overrides(cfg: ConfigOptions, overrides: dict[str, str]) -> Config
         try:
             if leaf.endswith("_time") or leaf in ("heartbeat_interval",):
                 val = parse_time_ns(val, TimeUnit.SEC)
-            elif leaf == "runahead":
+            elif leaf in ("runahead", "cpu_delay"):
+                # Same bare-number unit as the YAML path (milliseconds).
                 val = parse_time_ns(val, TimeUnit.MS)
             elif leaf.startswith("bandwidth_"):
                 val = parse_bits_per_sec(val)

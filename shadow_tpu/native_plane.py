@@ -125,6 +125,7 @@ def shim_path() -> str:
 _ARTIFACTS = (
     "libshadow_shim.so", "test_app", "test_busy", "test_udp_echo",
     "test_udp_client", "test_tcp_stream", "test_epoll_server",
+    "test_filewrite", "test_sockaddr_len", "test_writev_sock",
 )
 
 
@@ -261,7 +262,7 @@ _NATIVE_OK = {
         "rseq", "prlimit64", "futex", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
         "getdents64", "uname", "getuid", "getgid", "geteuid",
-        "getegid", "dup", "pipe2",
+        "getegid", "pipe2",
     )
 }
 
@@ -272,6 +273,14 @@ VFD_BASE = 1000
 AF_INET = 2
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
+F_DUPFD = 0
+F_GETFD = 1
+F_SETFD = 2
+F_GETFL = 3
+F_SETFL = 4
+F_DUPFD_CLOEXEC = 1030
+O_WRONLY = 1
+IOV_MAX = 1024
 SOCK_TYPE_MASK = 0xFF
 SOCK_NONBLOCK = 0x800
 EAGAIN = 11
@@ -304,6 +313,22 @@ def _parse_sockaddr_in(raw: bytes) -> tuple[str, int] | None:
 def _build_sockaddr_in(ip: str, port: int) -> bytes:
     parts = bytes(int(x) for x in (ip or "0.0.0.0").split("."))
     return struct.pack("<H", AF_INET) + struct.pack(">H", port or 0) + parts + b"\x00" * 8
+
+
+def _write_sockaddr(cpid: int, addr_ptr: int, len_ptr: int, sa: bytes) -> None:
+    """Kernel value-result semantics for (sockaddr*, socklen_t*) out-params:
+    copy min(*len, len(sa)) bytes into the caller's buffer, then store the
+    true length back through len_ptr (accept(2) NOTES)."""
+    if not addr_ptr:
+        return
+    cap = len(sa)
+    if len_ptr:
+        raw = _vm_read(cpid, len_ptr, 4)
+        if len(raw) == 4:
+            cap = struct.unpack("<I", raw)[0]
+    _vm_write(cpid, addr_ptr, sa[: min(cap, len(sa))])
+    if len_ptr:
+        _vm_write(cpid, len_ptr, struct.pack("<I", len(sa)))
 
 NS_PER_SEC = 1_000_000_000
 
@@ -356,6 +381,7 @@ class NativeProcess:
         # virtual fds: emulated sockets living in the host's netns
         self._vfds: dict[int, object] = {}
         self._vfd_flags: dict[int, int] = {}  # O_NONBLOCK etc.
+        self._stdio_dups: dict[int, int] = {}  # vfd -> 1|2 (dup'd stdio)
         self._next_vfd = VFD_BASE
         self._wake: list = []  # (file, listener) pairs while blocked
         self._poll_deadline: int | None = None  # absolute poll timeout
@@ -479,6 +505,10 @@ class NativeProcess:
         if num in _EPOLL_SYSCALLS:
             return self._handle_epoll(num, args)
         if num == SYS["close"]:
+            if args[0] in self._stdio_dups:
+                del self._stdio_dups[args[0]]
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
             if args[0] in self._vfds:
                 sock = self._vfds.pop(args[0])
                 self._vfd_flags.pop(args[0], None)
@@ -487,11 +517,48 @@ class NativeProcess:
             else:
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
+        if num == SYS["dup"]:
+            # stdio fds are virtualized (captured), so their dups must be
+            # too: glibc's perror dups stderr before writing, and a native
+            # dup would alias the child's real stderr (DEVNULL)
+            tgt = args[0] if args[0] in (1, 2) else self._stdio_dups.get(args[0])
+            if tgt is not None:
+                nfd = self._next_vfd
+                self._next_vfd += 1
+                self._stdio_dups[nfd] = tgt
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
+            elif args[0] in self._vfds:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)  # loud
+            else:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if num == SYS["fcntl"] and (
+            args[1] in (F_DUPFD, F_DUPFD_CLOEXEC)
+            and (args[0] in (1, 2) or args[0] in self._stdio_dups)
+        ):
+            # dup-via-fcntl of a captured stdio fd: must stay virtual, same
+            # as dup(2) — a native dup would alias the child's real
+            # stderr/stdout (DEVNULL) and silently swallow output
+            tgt = args[0] if args[0] in (1, 2) else self._stdio_dups[args[0]]
+            nfd = self._next_vfd
+            self._next_vfd += 1
+            self._stdio_dups[nfd] = tgt
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
+            return False
+        if num == SYS["fcntl"] and args[0] in self._stdio_dups:
+            if args[1] == F_GETFL:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, O_WRONLY)
+            elif args[1] in (F_GETFD, F_SETFD, F_SETFL):
+                # CLOEXEC bookkeeping is meaningless on a virtual fd; accept
+                # (glibc fdopen(..., "we") sets FD_CLOEXEC right after dup)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            else:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
         if num == SYS["fcntl"]:
             if args[0] not in self._vfds:
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
                 return False
-            F_GETFL, F_SETFL = 3, 4
             if args[1] == F_SETFL:
                 self._vfd_flags[args[0]] = args[2]
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
@@ -518,9 +585,12 @@ class NativeProcess:
             self.host.schedule(wake_at, self._resume_after_sleep)
             return True  # parked
 
-        if num in (SYS["write"], SYS["writev"]) and args[0] in (1, 2):
+        if num in (SYS["write"], SYS["writev"]) and (
+            args[0] in (1, 2) or args[0] in self._stdio_dups
+        ):
+            tgt = args[0] if args[0] in (1, 2) else self._stdio_dups[args[0]]
             data = self._gather_write(cpid, num, args)
-            (self.stdout if args[0] == 1 else self.stderr).append(data)
+            (self.stdout if tgt == 1 else self.stderr).append(data)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
             return False
 
@@ -540,6 +610,51 @@ class NativeProcess:
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
                 return False
             return self._handle_socket(SYS["sendto"], [args[0], args[1], args[2], 0, 0, 0])
+        if num == SYS["writev"] and args[0] in self._vfds:
+            sock = self._vfds[args[0]]
+            if args[2] > IOV_MAX:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            data = self._gather_write(cpid, num, args)
+            if not hasattr(sock, "PROTO"):
+                # eventfd/timerfd: same semantics as write(2) on the vfd
+                try:
+                    n = sock.write(data[:16])
+                except (OSError, AttributeError) as e:
+                    code = _errno_of(e) if isinstance(e, OSError) else -EINVAL
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, code)
+                    return False
+                self.ipc.reply(
+                    MSG_SYSCALL_COMPLETE, -EAGAIN if n is None else n
+                )
+                return False
+            from shadow_tpu.host.sockets import UdpSocket
+
+            try:
+                if isinstance(sock, UdpSocket):
+                    # one writev = one datagram (must not split per-iov)
+                    n = sock.sendto(data, None)
+                else:
+                    n = sock.write(data)
+            except (ConnectionResetError, BrokenPipeError):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -ECONNRESET)
+                return False
+            except OSError as e:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+                return False
+            if n is None:
+                if self._nonblock(args[0]):
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                    return False
+                from shadow_tpu.host.filestate import FileState
+
+                self._block_on(
+                    [(sock, FileState.WRITABLE | FileState.ERROR | FileState.CLOSED)],
+                    num, args,
+                )
+                return True
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+            return False
         if num == SYS["read"] and args[0] in self._vfds:
             f = self._vfds[args[0]]
             if not hasattr(f, "PROTO"):  # timerfd/eventfd 8-byte reads
@@ -571,6 +686,13 @@ class NativeProcess:
             else:
                 # real-file fds were opened natively; read them natively too
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+
+        if num in (SYS["write"], SYS["writev"]) and args[0] not in self._vfds:
+            # fd is neither stdio (handled above) nor a vfd: it's a regular
+            # file the child opened natively — write it natively, mirroring
+            # the read/openat passthrough policy (ref regular_file.c).
+            self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
 
         if num == SYS["ioctl"] and args[0] in (0, 1, 2):
@@ -954,11 +1076,10 @@ class NativeProcess:
             self._vfds[nfd] = child
             if num == S["accept4"] and args[3] & SOCK_NONBLOCK:
                 self._vfd_flags[nfd] = 0x800
-            if args[1]:
-                sa = _build_sockaddr_in(child.peer_ip, child.peer_port)
-                _vm_write(cpid, args[1], sa)
-                if args[2]:
-                    _vm_write(cpid, args[2], struct.pack("<I", 16))
+            _write_sockaddr(
+                cpid, args[1], args[2],
+                _build_sockaddr_in(child.peer_ip, child.peer_port),
+            )
             reply(MSG_SYSCALL_COMPLETE, nfd)
             return False
 
@@ -1041,10 +1162,9 @@ class NativeProcess:
                     return True
                 data, addr = r
                 _vm_write(cpid, args[1], data)
-                if args[4]:
-                    _vm_write(cpid, args[4], _build_sockaddr_in(addr[0], addr[1]))
-                    if args[5]:
-                        _vm_write(cpid, args[5], struct.pack("<I", 16))
+                _write_sockaddr(
+                    cpid, args[4], args[5], _build_sockaddr_in(addr[0], addr[1])
+                )
                 reply(MSG_SYSCALL_COMPLETE, len(data))
                 return False
             data = sock.read(min(args[2], 1 << 20))
@@ -1066,9 +1186,7 @@ class NativeProcess:
 
         if num == S["getsockname"]:
             sa = _build_sockaddr_in(sock.local_ip or "0.0.0.0", sock.local_port or 0)
-            _vm_write(cpid, args[1], sa)
-            if args[2]:
-                _vm_write(cpid, args[2], struct.pack("<I", 16))
+            _write_sockaddr(cpid, args[1], args[2], sa)
             reply(MSG_SYSCALL_COMPLETE, 0)
             return False
 
@@ -1076,9 +1194,8 @@ class NativeProcess:
             if sock.peer_ip is None:
                 reply(MSG_SYSCALL_COMPLETE, -ENOTCONN)
                 return False
-            _vm_write(cpid, args[1], _build_sockaddr_in(sock.peer_ip, sock.peer_port))
-            if args[2]:
-                _vm_write(cpid, args[2], struct.pack("<I", 16))
+            sa = _build_sockaddr_in(sock.peer_ip, sock.peer_port)
+            _write_sockaddr(cpid, args[1], args[2], sa)
             reply(MSG_SYSCALL_COMPLETE, 0)
             return False
 
@@ -1093,7 +1210,9 @@ class NativeProcess:
         if num == SYS["write"]:
             return _vm_read(cpid, args[1], min(args[2], 1 << 20))
         out = bytearray()
-        iov_cnt = min(args[2], 64)
+        # IOV_MAX (1024, kernel limit) iovs so a legal writev is never
+        # silently truncated; callers reject counts above it with EINVAL
+        iov_cnt = min(args[2], IOV_MAX)
         raw = _vm_read(cpid, args[1], iov_cnt * 16)
         for i in range(len(raw) // 16):
             base, ln = struct.unpack_from("<QQ", raw, i * 16)

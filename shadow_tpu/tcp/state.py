@@ -343,7 +343,11 @@ class TcpState:
                 self._pending_rst = rst_for(seg)
                 return
             self.state = State.ESTABLISHED
-            self._update_snd_wnd(seg, syn=True)
+            # the handshake-completing ACK carries no SYN, so its window is
+            # already scaled (RFC 7323: only SYN-flagged segments are
+            # unscaled) — but snd_wl1/wl2 are still at their init values, so
+            # the update must be forced, not gated on the wl ordering check
+            self._update_snd_wnd(seg, force=True)
         dup_candidate = (
             seg.seg_len == 0
             and (seg.wnd << self.snd_wscale) == self.snd_wnd
@@ -449,10 +453,14 @@ class TcpState:
         if not self.syn_acked and self.syn_sent:
             self.syn_acked = True
             d -= 1
-        take = min(d, self.nxt_off - self.una_off)
+        # bound by bytes ever transmitted, not nxt_off: after an RTO
+        # go-back-N rewind (nxt_off = una_off) a late ACK may still cover
+        # data sent before the rewind
+        take = min(d, self._max_sent_off - self.una_off)
         if take:
             self.snd_buf.ack_to(self.una_off + take)
             self.una_off += take
+            self.nxt_off = max(self.nxt_off, self.una_off)
             newly_acked_bytes = take
             d -= take
         if d and self.fin_sent and not self.fin_acked:
@@ -471,12 +479,16 @@ class TcpState:
         else:
             self.rto_deadline = None
 
-    def _update_snd_wnd(self, seg: Segment, syn: bool = False):
+    def _update_snd_wnd(self, seg: Segment, syn: bool = False, force: bool = False):
+        """`syn`: the segment's window is unscaled (RFC 7323). `force`:
+        bypass the snd_wl1/wl2 staleness check (used when wl1/wl2 still hold
+        their pre-handshake init values and would reject ~half of ISS space)."""
         if not (seg.flags & ACK) and not syn:
             return
         wnd = seg.wnd if (syn or seg.flags & SYN) else seg.wnd << self.snd_wscale
         if (
             syn
+            or force
             or seq_lt(self.snd_wl1, seg.seq)
             or (self.snd_wl1 == seg.seq and seq_le(self.snd_wl2, seg.ack))
         ):

@@ -87,6 +87,24 @@ def test_cli_overrides():
         merge_cli_overrides(cfg, {"general.no_such": "1"})
 
 
+def test_cli_cpu_delay_unit_matches_yaml():
+    """`cpu_delay: 100` in YAML and `--experimental.cpu_delay=100` must agree
+    (both bare-ms); round 1 had the CLI path fall through to raw int(ns)."""
+    cfg = load_config(
+        "general: {stop_time: 1s}\nexperimental: {cpu_delay: 100}\n"
+        "hosts: {a: {processes: [{model: timer}]}}",
+        is_text=True,
+    )
+    assert cfg.experimental.cpu_delay == 100_000_000
+    cfg2 = load_config(MINIMAL, is_text=True)
+    cfg2 = merge_cli_overrides(cfg2, {"experimental.cpu_delay": "100"})
+    assert cfg2.experimental.cpu_delay == cfg.experimental.cpu_delay
+    cfg3 = merge_cli_overrides(
+        load_config(MINIMAL, is_text=True), {"experimental.cpu_delay": "2 ms"}
+    )
+    assert cfg3.experimental.cpu_delay == 2_000_000
+
+
 def test_host_option_defaults_cascade():
     cfg = load_config(
         """

@@ -1,0 +1,56 @@
+"""Guard the driver integration hooks in __graft_entry__.py.
+
+The driver's only multi-chip evidence is `dryrun_multichip`; round 1 shipped a
+version that asserted on real device count and went red on the driver's box
+(MULTICHIP_r01.json ok=false). This test imports the actual module the driver
+runs so the hooks can never rot silently again.
+"""
+
+import pathlib
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, (state, params) = graft.entry()
+    out = jax.jit(fn)(state, params)
+    jax.block_until_ready(out)
+    assert int(out.stats.rounds) > 0
+    assert int(out.now) > 0
+
+
+def test_dryrun_multichip_8():
+    # conftest already forces the 8-device virtual CPU mesh; dryrun must also
+    # work when invoked cold by the driver, but here we at least prove the
+    # sharded chunk compiles + executes and reports progress.
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_forces_mesh_in_fresh_process():
+    """Run dryrun the way the driver does: a bare `python -c` with no help."""
+    import subprocess
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__; __graft_entry__.dryrun_multichip(8)",
+        ],
+        cwd=repo,
+        env={
+            k: v
+            for k, v in __import__("os").environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        },
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]

@@ -111,3 +111,14 @@ def test_native_binary_via_config():
     assert report["process_failures"] == 0
     proc = sim.procs[0]
     assert "tick 1 t=500000000" in b"".join(proc.stdout).decode()
+
+
+def test_regular_file_write_passthrough(tmp_path):
+    """write/writev to a natively-opened regular file must pass through
+    (advisor finding: fell to ENOSYS while the read path passed through)."""
+    out_path = str(tmp_path / "fw.out")
+    _, p = run_one(
+        [os.path.join(REPO, "native", "build", "test_filewrite"), out_path]
+    )
+    assert p.exit_code == 0, b"".join(p.stdout) + b"".join(p.stderr)
+    assert b"roundtrip: hello file world" in b"".join(p.stdout)

@@ -159,3 +159,38 @@ def test_real_binaries_over_device_plane():
     outs = [b"".join(p.stdout).decode() for p in sim.procs]
     assert any("client done" in o for o in outs)
     assert any("served 2" in o for o in outs)
+
+
+def test_sockaddr_len_value_result():
+    """getsockname with a short caller buffer must truncate the write and
+    store back the TRUE length without clobbering adjacent memory (advisor
+    finding: full 16-byte sockaddr written regardless of addrlen)."""
+    hosts, net = two_hosts()
+    p = spawn_native(
+        hosts[0],
+        [os.path.join(REPO, "native", "build", "test_sockaddr_len")],
+    )
+    net.run(1 * SEC)
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert "guard_ok=1 len=16 port=7777" in out
+    assert "full len=16 port=7777" in out
+
+
+def test_writev_on_socket_single_datagram():
+    """writev with multiple iovs on a connected-UDP vfd must emit ONE
+    datagram (and not ENOSYS) — review finding on the round-2 writev path."""
+    hosts, net = two_hosts(lat_ms=10)
+    srv = spawn_native(hosts[0], [UDP_ECHO, "9000", "1"])
+    cli = spawn_native(
+        hosts[1],
+        [os.path.join(REPO, "native", "build", "test_writev_sock"),
+         "10.0.0.1", "9000"],
+        start_time=50 * MS,
+    )
+    net.run(5 * SEC)
+    assert cli.exit_code == 0, b"".join(cli.stderr)
+    # server echoes the datagram uppercased-prefix style ("PING 0"): both
+    # iovs arrived in one message
+    assert b"echo: PING 0" in b"".join(cli.stdout)
+    assert srv.exit_code == 0

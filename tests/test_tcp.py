@@ -453,3 +453,72 @@ def test_transfer_deterministic():
         return [(t, src, repr(seg)) for t, src, seg in w.sent]
 
     assert trace() == trace()
+
+
+# ---------------------------------------------- advisor-round-1 regressions
+
+
+def test_passive_side_third_ack_window_is_scaled():
+    """RFC 7323: only SYN-flagged segments carry unscaled windows. The
+    handshake-completing ACK must be scaled by snd_wscale on the passive
+    side (advisor finding: it was treated as unscaled, underestimating the
+    peer's window by 2^wscale until the next update)."""
+    c, s, w = handshake()
+    assert c.rcv_wscale > 0  # default 256 KiB recv_buf => wscale 2
+    assert s.snd_wscale == c.rcv_wscale
+    # the third ACK advertised (client window >> wscale); the server's view
+    # must be the re-scaled value, i.e. within one scale-quantum of the
+    # client's real window, not 4x smaller
+    real = c.rcv_buf.window()
+    assert s.snd_wnd >= real - (1 << c.rcv_wscale)
+    assert s.snd_wnd > 0xFFFF  # impossible if the shift was dropped
+
+
+def test_late_ack_after_rto_rewind_advances_una():
+    """An ACK covering data transmitted before an RTO go-back-N rewind must
+    advance una_off/send-buffer even though nxt_off was rewound (advisor
+    finding: capped at nxt_off - una_off, i.e. zero after rewind)."""
+    c, s, w = handshake()
+    payload = bytes(1000)
+    c.send(payload)
+    # deliver data to the server, but swallow everything the server says
+    # until after the client's RTO fires
+    for seg in c.poll_segments(w.now):
+        s.on_segment(w.now, seg)
+    acks = s.poll_segments(w.now)
+    assert acks and any(seq_gt(a.ack, c.iss) for a in acks)
+    # fire the client's retransmission timeout -> go-back-N rewind
+    t = c.next_timer()
+    assert t is not None
+    c.on_timer(t)
+    assert c.nxt_off == c.una_off  # rewound
+    # now the (late) ACK for the original transmission arrives
+    for a in acks:
+        c.on_segment(t, a)
+    assert c.una_off == len(payload)
+    assert c.nxt_off >= c.una_off
+    # nothing left to retransmit: the late ACK covered it all
+    assert not any(seg.payload for seg in c.poll_segments(t + 1))
+
+
+def test_third_ack_window_update_any_iss():
+    """The forced handshake window update must fire for ISS values whose
+    sequence space makes seq_lt(snd_wl1=0, seg.seq) false (~half of all
+    random ISS draws) — review finding on the round-2 scaling fix."""
+    from shadow_tpu.tcp import TcpConfig
+
+    for iss in (1000, (1 << 31) + 5, (1 << 32) - 10):
+        cfg = TcpConfig()
+        client = TcpState(cfg, iss=iss)
+        listener = TcpState(cfg, iss=0)
+        listener.listen()
+        client.connect(0)
+        syn = client.poll_segments(0)[0]
+        server = listener.accept_segment(0, syn, child_iss=5000)
+        wire = Wire(client, server, 10 * MS)
+        wire.run(until=lambda: client.state == State.ESTABLISHED
+                 and server.state == State.ESTABLISHED)
+        assert server.snd_wnd > 0xFFFF, (
+            f"iss={iss}: server snd_wnd={server.snd_wnd} "
+            "(third-ACK window update did not fire or was unscaled)"
+        )

@@ -1,0 +1,110 @@
+"""Microbenchmark candidate exchange-merge strategies on the real chip.
+
+Round-1 profile: the 3-key lax.sort over the 60k-entry gathered outbox is
+~85% of round cost at 10k hosts. Candidates measured here:
+  A. status quo: lax.sort (i32 dst, i64 t, i64 order, i32 idx), 3 keys
+  B. cheap_shed: lax.sort (i32 dst, i32 idx), 2 keys
+  C. packed single-key i32 sort: (dst << 17) | idx
+  D. packed 2-key: (dst,t) in one i64 + order i64
+  E. rank-based merge, no sort: block-local rank (equality matrix) +
+     per-block dst histogram (scatter-add) + cumsum + gathers
+"""
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import shadow_tpu  # noqa: F401  (enables x64)
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+H = 10_000
+N = 60_000
+B = 60  # blocks for rank-based
+BS = N // B
+
+
+def timeit(fn, *args, iters=20):
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3, out
+
+
+def main():
+    print("devices:", jax.devices())
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    dst = jax.random.randint(k1, (N,), 0, H, dtype=jnp.int32)
+    t = jax.random.randint(k2, (N,), 0, 1 << 40, dtype=jnp.int64)
+    order = jax.random.randint(k3, (N,), 0, 1 << 60, dtype=jnp.int64)
+    valid = jnp.arange(N) % 6 < 1  # ~10k valid, like PHOLD
+    dst_key = jnp.where(valid, dst, jnp.int32(H))
+
+    @jax.jit
+    def sort3(dst_key, t, order):
+        return lax.sort((dst_key, t, order, jnp.arange(N, dtype=jnp.int32)), num_keys=3)
+
+    @jax.jit
+    def sort2(dst_key):
+        return lax.sort((dst_key, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+
+    @jax.jit
+    def sort1_packed(dst_key):
+        packed = (dst_key.astype(jnp.int32) << 17) | jnp.arange(N, dtype=jnp.int32)
+        s = lax.sort(packed)
+        return s >> 17, s & 0x1FFFF
+
+    @jax.jit
+    def sort2_packed64(dst_key, t, order):
+        k = (dst_key.astype(jnp.int64) << 48) | t  # t < 2^48
+        sk, so, si = lax.sort((k, order, jnp.arange(N, dtype=jnp.int32)), num_keys=2)
+        return (sk >> 48).astype(jnp.int32), sk & ((1 << 48) - 1), so, si
+
+    @jax.jit
+    def rank_merge(dst_key, valid):
+        d = dst_key.reshape(B, BS)
+        v = valid.reshape(B, BS)
+        eq = (d[:, :, None] == d[:, None, :]) & v[:, None, :]
+        tri = jnp.tril(jnp.ones((BS, BS), jnp.bool_), -1)
+        within = jnp.sum(eq & tri[None], axis=2, dtype=jnp.int32)  # [B, BS]
+        hist = jnp.zeros((B, H + 1), jnp.int32).at[
+            jnp.arange(N, dtype=jnp.int32) // BS, dst_key.reshape(-1)
+        ].add(valid.astype(jnp.int32))
+        chist = jnp.cumsum(hist, axis=0) - hist  # exclusive over blocks
+        rank = within + chist[jnp.arange(B)[:, None], d]
+        return rank.reshape(-1), hist
+
+    @jax.jit
+    def hist_only(dst_key, valid):
+        return jnp.zeros((B, H + 1), jnp.int32).at[
+            jnp.arange(N, dtype=jnp.int32) // BS, dst_key
+        ].add(valid.astype(jnp.int32))
+
+    @jax.jit
+    def within_only(dst_key, valid):
+        d = dst_key.reshape(B, BS)
+        v = valid.reshape(B, BS)
+        eq = (d[:, :, None] == d[:, None, :]) & v[:, None, :]
+        tri = jnp.tril(jnp.ones((BS, BS), jnp.bool_), -1)
+        return jnp.sum(eq & tri[None], axis=2, dtype=jnp.int32)
+
+    for name, fn, args in [
+        ("A sort3", sort3, (dst_key, t, order)),
+        ("B sort2", sort2, (dst_key,)),
+        ("C sort1_packed_i32", sort1_packed, (dst_key,)),
+        ("D sort2_packed64", sort2_packed64, (dst_key, t, order)),
+        ("E rank_merge", rank_merge, (dst_key, valid)),
+        ("E1 hist_only", hist_only, (dst_key, valid)),
+        ("E2 within_only", within_only, (dst_key, valid)),
+    ]:
+        ms, _ = timeit(fn, *args)
+        print(f"{name:24s} {ms:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

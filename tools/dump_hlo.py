@@ -1,0 +1,46 @@
+"""Dump optimized HLO + cost analysis for the bench chunk to find the
+pathological op (all tunnel-side timing is unreliable; read the program)."""
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import collections
+import re
+
+import jax
+
+from bench import bench_config
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+
+def main():
+    cfg = ConfigOptions.from_dict(bench_config(10_000, 100))
+    sim = Simulation(cfg, world=1)
+    lowered = jax.jit(sim.engine._chunk_fn).lower(sim.state, sim.params) \
+        if hasattr(sim.engine, "_chunk_fn") else None
+    if lowered is None:
+        # engine.run_chunk is already a jit-wrapped callable
+        lowered = sim.engine.run_chunk.lower(sim.state, sim.params)
+    compiled = lowered.compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        print("COST:", {k: v for k, v in sorted(ca.items()) if v > 1e6 or k in ("flops", "bytes accessed")})
+    except Exception as e:
+        print("cost_analysis failed:", e)
+    txt = compiled.as_text()
+    print("HLO bytes:", len(txt))
+    ops = collections.Counter()
+    for mline in re.finditer(r"= (\w+)\.?\d* ?\(?", txt):
+        ops[mline.group(1)] += 1
+    for op, n in ops.most_common(40):
+        print(f"{op:30s} {n}")
+    with open("/tmp/chunk_hlo.txt", "w") as f:
+        f.write(txt)
+    print("wrote /tmp/chunk_hlo.txt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Time the engine's building blocks standalone at bench shapes
+(H=10k hosts, C=16 queue slots, N=60k outbox entries)."""
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import shadow_tpu  # noqa: F401  x64
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from shadow_tpu.ops.events import EventQueue, pop_min, push_one, EVENT_PAYLOAD_WORDS
+from shadow_tpu.ops.merge import merge_flat_events
+from shadow_tpu.simtime import TIME_MAX
+
+H, C, N = 10_000, 16, 60_000
+P = EVENT_PAYLOAD_WORDS
+
+
+def timeit(fn, *args, iters=20):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    q = EventQueue(
+        t=jnp.where(jax.random.uniform(ks[0], (H, C)) < 0.3,
+                    jax.random.randint(ks[1], (H, C), 0, 1 << 40, dtype=jnp.int64),
+                    TIME_MAX),
+        order=jax.random.randint(ks[2], (H, C), 0, 1 << 60, dtype=jnp.int64),
+        kind=jnp.zeros((H, C), jnp.int32),
+        payload=jnp.zeros((H, C, P), jnp.int32),
+        dropped=jnp.zeros((H,), jnp.int64),
+    )
+    dst = jax.random.randint(ks[3], (N,), 0, H, dtype=jnp.int32)
+    t = jax.random.randint(ks[4], (N,), 0, 1 << 40, dtype=jnp.int64)
+    order = jax.random.randint(ks[5], (N,), 0, 1 << 60, dtype=jnp.int64)
+    kind = jnp.ones((N,), jnp.int32)
+    payload = jnp.zeros((N, P), jnp.int32)
+    valid = jax.random.uniform(ks[6], (N,)) < 0.17  # ~10k live
+
+    merge_u = jax.jit(lambda *a: merge_flat_events(*a, 16, shed_urgency=True))
+    merge_a = jax.jit(lambda *a: merge_flat_events(*a, 16, shed_urgency=False))
+    print("merge urgency :", timeit(merge_u, q, dst, t, order, kind, payload, valid), "ms")
+    print("merge append  :", timeit(merge_a, q, dst, t, order, kind, payload, valid), "ms")
+
+    popf = jax.jit(lambda q: pop_min(q, jnp.full((H,), 1 << 41, jnp.int64)))
+    print("pop_min       :", timeit(popf, q), "ms")
+
+    mask = jax.random.uniform(ks[7], (H,)) < 0.5
+    tpush = jnp.full((H,), 123456789, jnp.int64)
+    opush = jnp.arange(H, dtype=jnp.int64)
+    kpush = jnp.ones((H,), jnp.int32)
+    ppush = jnp.zeros((H, P), jnp.int32)
+    pushf = jax.jit(lambda q: push_one(q, mask, tpush, opush, kpush, ppush))
+    print("push_one      :", timeit(pushf, q), "ms")
+
+    # merge internals
+    @jax.jit
+    def sort_phase(dst, t, order, valid):
+        dst_key = jnp.where(valid, dst, jnp.int32(H))
+        return lax.sort((dst_key, t, order, jnp.arange(N, dtype=jnp.int32)), num_keys=3)
+
+    @jax.jit
+    def rank_phase(s_dst):
+        seg_start = jnp.searchsorted(s_dst, s_dst, side="left")
+        return jnp.arange(N, dtype=jnp.int64) - seg_start
+
+    @jax.jit
+    def slotmap_phase(qt):
+        free = qt == TIME_MAX
+        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+        scatter_r = jnp.where(free & (free_rank < 16), free_rank, 16)
+        slot_of_rank = jnp.full((H, 16), -1, jnp.int32)
+        hh = jnp.broadcast_to(jnp.arange(H)[:, None], free.shape)
+        cc = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None, :], free.shape)
+        return slot_of_rank.at[hh, scatter_r].set(cc, mode="drop")
+
+    @jax.jit
+    def gather_phase(s_idx, kind, payload):
+        return kind[s_idx], payload[s_idx]
+
+    @jax.jit
+    def final_scatter(qt, h_scatter, s_scatter, s_t):
+        return qt.at[h_scatter, s_scatter].set(s_t, mode="drop")
+
+    s = sort_phase(dst, t, order, valid)
+    print("  sort3       :", timeit(sort_phase, dst, t, order, valid), "ms")
+    print("  searchsorted:", timeit(rank_phase, s[0]), "ms")
+    print("  slotmap     :", timeit(slotmap_phase, q.t), "ms")
+    print("  gather kp   :", timeit(gather_phase, s[3], kind, payload), "ms")
+    hs = jnp.clip(s[0], 0, H - 1)
+    ss = jnp.zeros((N,), jnp.int32)
+    print("  final scat  :", timeit(final_scatter, q.t, hs, ss, s[1]), "ms")
+
+
+if __name__ == "__main__":
+    main()
