@@ -87,13 +87,20 @@ _MIX2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
 
 
 class Outbox(NamedTuple):
-    """Per-shard staging buffer for this round's outgoing packets."""
+    """Per-host staging lanes for this round's outgoing packets.
 
-    dst: Array  # i32[OB] global destination host id
-    t: Array  # i64[OB] arrival time (>= window_end); TIME_MAX = empty
-    order: Array  # i64[OB]
-    kind: Array  # i32[OB]
-    payload: Array  # i32[OB, P]
+    Layout is [H, B] with B = `sends_per_host_round`: host h's k-th send of
+    the round lands in row h, column k (`sent_round` is the column cursor).
+    This makes the append a one-hot masked write — no scatter (TPU scatters
+    into the old flat [OB] buffer were a measured hot spot) — and makes the
+    flattened exchange order host-major, i.e. invariant to mesh shape and
+    microstep interleaving by construction."""
+
+    dst: Array  # i32[H, B] global destination host id
+    t: Array  # i64[H, B] arrival time (>= window_end); TIME_MAX = empty
+    order: Array  # i64[H, B]
+    kind: Array  # i32[H, B]
+    payload: Array  # i32[H, B, P]
     count: Array  # i32[1] entries appended this round (per shard)
 
 
@@ -199,11 +206,6 @@ class EngineConfig:
         return self.num_hosts // self.world
 
     @property
-    def outbox_capacity(self) -> int:
-        """Per-shard staging slots; cannot overflow under the per-host budget."""
-        return self.hosts_per_shard * self.sends_per_host_round
-
-    @property
     def effective_microstep_limit(self) -> int:
         return self.microstep_limit or 2 * self.queue_capacity
 
@@ -250,13 +252,13 @@ def _init_stats(cfg: EngineConfig) -> Stats:
 
 
 def _init_outbox(cfg: EngineConfig) -> Outbox:
-    n = cfg.outbox_capacity * cfg.world
+    h, b = cfg.num_hosts, cfg.sends_per_host_round
     return Outbox(
-        dst=jnp.zeros((n,), jnp.int32),
-        t=jnp.full((n,), TIME_MAX, jnp.int64),
-        order=jnp.zeros((n,), jnp.int64),
-        kind=jnp.zeros((n,), jnp.int32),
-        payload=jnp.zeros((n, EVENT_PAYLOAD_WORDS), jnp.int32),
+        dst=jnp.zeros((h, b), jnp.int32),
+        t=jnp.full((h, b), TIME_MAX, jnp.int64),
+        order=jnp.zeros((h, b), jnp.int64),
+        kind=jnp.zeros((h, b), jnp.int32),
+        payload=jnp.zeros((h, b, EVENT_PAYLOAD_WORDS), jnp.int32),
         count=jnp.zeros((cfg.world,), jnp.int32),
     )
 
@@ -313,22 +315,25 @@ def _digest_update(digest, active, t, kind, order):
     return jnp.where(active, (digest ^ x) * _FNV_PRIME, digest)
 
 
-def _outbox_append(ob: Outbox, cap: int, mask, dst, t, order, kind, payload):
-    """Append up to one entry per host, in host-id order (deterministic)."""
-    cnt = ob.count[0]
-    mask_i = jnp.asarray(mask, jnp.int32)
-    pos = cnt + jnp.cumsum(mask_i) - 1
-    ok = mask & (pos < cap)
-    idx = jnp.where(ok, pos, cap)  # cap = out-of-bounds -> dropped
+def _outbox_append(ob: Outbox, mask, col, dst, t, order, kind, payload):
+    """Write each masked host's entry into its own lane at column `col`
+    (the host's `sent_round` cursor). One-hot masked writes only; `mask`
+    implies `col < B` (the send budget is checked upstream), so `n_lost` is
+    structurally zero — but it is computed, not assumed, so `ob_dropped`
+    remains a real invariant check against future call sites."""
+    b = ob.t.shape[1]
+    oh = mask[:, None] & (jnp.arange(b, dtype=jnp.int32)[None, :] == col[:, None])
+    n_lost = jnp.sum(mask & (col >= b), dtype=jnp.int64)
     new = Outbox(
-        dst=ob.dst.at[idx].set(dst.astype(jnp.int32), mode="drop"),
-        t=ob.t.at[idx].set(t, mode="drop"),
-        order=ob.order.at[idx].set(order, mode="drop"),
-        kind=ob.kind.at[idx].set(kind.astype(jnp.int32), mode="drop"),
-        payload=ob.payload.at[idx].set(payload, mode="drop"),
-        count=(cnt + jnp.sum(mask_i))[None].astype(jnp.int32),
+        dst=jnp.where(oh, dst.astype(jnp.int32)[:, None], ob.dst),
+        t=jnp.where(oh, t[:, None], ob.t),
+        order=jnp.where(oh, order[:, None], ob.order),
+        kind=jnp.where(oh, kind.astype(jnp.int32)[:, None], ob.kind),
+        payload=jnp.where(
+            oh[:, :, None], jnp.asarray(payload, jnp.int32)[:, None, :], ob.payload
+        ),
+        count=ob.count + jnp.sum(mask, dtype=jnp.int32)[None],
     )
-    n_lost = jnp.sum(jnp.asarray(mask & ~ok, jnp.int64))
     return new, n_lost
 
 
@@ -649,8 +654,17 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             needs_ingress & ~codel_drop,
         )
         delay = needs_ingress & ~codel_drop & (depart > ev.t)
-        queue = push_one(
-            queue, delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE, ev.payload
+        # the requeue only fires when a downlink bucket is actually exhausted
+        # (rare at sane rates); cond-skip the full-queue pass. The predicate
+        # is shard-local and the branch has no collectives, so this is safe
+        # under shard_map.
+        queue = lax.cond(
+            jnp.any(delay),
+            lambda q: push_one(
+                q, delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE, ev.payload
+            ),
+            lambda q: q,
+            queue,
         )
         stats = stats._replace(
             pkts_codel_dropped=stats.pkts_codel_dropped + codel_drop
@@ -721,10 +735,17 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         dst_raw = jnp.asarray(s.dst, jnp.int64)
         bad_dst = mask & ((dst_raw < 0) | (dst_raw >= cfg.num_hosts))
         dst = jnp.clip(dst_raw, 0, cfg.num_hosts - 1)  # safe gather only
-        src_node = params.node_of[host_gid]
-        dst_node = params.node_of[dst]
-        lat = params.lat_ns[src_node, dst_node]
-        lossp = params.loss[src_node, dst_node]
+        if params.lat_ns.shape == (1, 1):
+            # single graph node (e.g. the 1-gbit-switch topology): the path
+            # lookup is a constant — elide the node_of/table gathers, which
+            # are a measured per-microstep hot spot on TPU
+            lat = jnp.broadcast_to(params.lat_ns[0, 0], dst.shape)
+            lossp = jnp.broadcast_to(params.loss[0, 0], dst.shape)
+        else:
+            src_node = params.node_of[host_gid]
+            dst_node = params.node_of[dst]
+            lat = params.lat_ns[src_node, dst_node]
+            lossp = params.loss[src_node, dst_node]
         # a model emitting an out-of-range dst is a bug: surface it as
         # unreachable rather than silently delivering to a clamped host
         unreachable = mask & ((lat < 0) | bad_dst)
@@ -732,6 +753,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
         send_ok = mask & ~lost & ~unreachable & ~over_budget
         budget_dropped = mask & ~lost & ~unreachable & over_budget
+        ob_col = sent_round  # lane column for this send (cursor pre-increment)
         sent_round = sent_round + send_ok.astype(jnp.int32)
         # conservative-PDES clamp (worker.rs:411-414): never before round end
         arrive = jnp.maximum(eg_depart + jnp.maximum(lat, 0), window_end)
@@ -740,8 +762,8 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         payload = s.payload.at[:, PAYLOAD_SIZE_WORD].set(sz)
         outbox, n_lost = _outbox_append(
             outbox,
-            cfg.outbox_capacity,
             send_ok,
+            ob_col,
             dst,
             arrive,
             order,
@@ -790,10 +812,15 @@ def _exchange(cfg, axis, st: SimState):
     )
 
     def do_merge(queue):
-        local = g.dst - shard_start
-        valid = (g.t != TIME_MAX) & (local >= 0) & (local < h_local)
+        # flatten the [H, B] lanes host-major: entry order (and therefore
+        # cheap-shed overflow selection) is identical for every mesh shape
+        dst_f = g.dst.reshape(-1)
+        t_f = g.t.reshape(-1)
+        local = dst_f - shard_start
+        valid = (t_f != TIME_MAX) & (local >= 0) & (local < h_local)
         return merge_flat_events(
-            queue, local, g.t, g.order, g.kind, g.payload, valid,
+            queue, local, t_f, g.order.reshape(-1), g.kind.reshape(-1),
+            g.payload.reshape(-1, g.payload.shape[-1]), valid,
             cfg.max_round_inserts, shed_urgency=not cfg.cheap_shed,
         )
 

@@ -588,6 +588,9 @@ class NativeProcess:
         if num in (SYS["write"], SYS["writev"]) and (
             args[0] in (1, 2) or args[0] in self._stdio_dups
         ):
+            if num == SYS["writev"] and args[2] > IOV_MAX:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
             tgt = args[0] if args[0] in (1, 2) else self._stdio_dups[args[0]]
             data = self._gather_write(cpid, num, args)
             (self.stdout if tgt == 1 else self.stderr).append(data)

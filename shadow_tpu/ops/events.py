@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -120,18 +121,49 @@ def pop_min(q: EventQueue, limit) -> tuple[EventQueue, Event, Array]:
     limit = jnp.asarray(limit, jnp.int64)
     tmin = jnp.min(q.t, axis=1)  # [H]
     active = tmin < limit
-    # among slots at the min time, take the smallest order key
-    at_min = q.t == tmin[:, None]
+    # among slots at the min time, take the smallest order key. On TPU the
+    # winner is read back with a one-hot masked SUM over slots, not
+    # argmin+gather: per-row dynamic gathers lower to a slow custom kernel
+    # (~100 us per call at H=10k) while masked reductions are effectively
+    # free. The one-hot is exact because order keys are globally unique
+    # (pack_order) — at most one live slot can match (tmin, omin). On CPU
+    # the gather formulation is faster; both compute the identical event, so
+    # digests do not depend on the backend choice.
+    at_min = (q.t == tmin[:, None]) & (q.t != TIME_MAX)
     cand_order = jnp.where(at_min, q.order, ORDER_MAX)
-    idx = jnp.argmin(cand_order, axis=1)  # [H]
-    hh = jnp.arange(q.t.shape[0])
-    ev = Event(
-        t=jnp.where(active, q.t[hh, idx], TIME_MAX),
-        order=jnp.where(active, q.order[hh, idx], ORDER_MAX),
-        kind=jnp.where(active, q.kind[hh, idx], 0),
-        payload=jnp.where(active[:, None], q.payload[hh, idx], 0),
-    )
-    clear = active[:, None] & (jnp.arange(q.t.shape[1])[None, :] == idx[:, None])
+    omin = jnp.min(cand_order, axis=1)  # [H]
+    onehot = at_min & (q.order == omin[:, None])  # [H, C], <=1 true per row
+
+    if jax.default_backend() == "cpu":
+        idx = jnp.argmin(cand_order, axis=1)  # [H]
+        hh = jnp.arange(q.t.shape[0])
+        ev = Event(
+            t=jnp.where(active, q.t[hh, idx], TIME_MAX),
+            order=jnp.where(active, q.order[hh, idx], ORDER_MAX),
+            kind=jnp.where(active, q.kind[hh, idx], 0),
+            payload=jnp.where(active[:, None], q.payload[hh, idx], 0),
+        )
+    else:
+
+        def sel(v, default):
+            got = jnp.sum(jnp.where(onehot, v, 0), axis=1, dtype=v.dtype)
+            return jnp.where(active, got, default)
+
+        ev = Event(
+            t=sel(q.t, TIME_MAX),
+            order=sel(q.order, ORDER_MAX),
+            kind=sel(q.kind, 0),
+            payload=jnp.where(
+                active[:, None],
+                jnp.sum(
+                    jnp.where(onehot[:, :, None], q.payload, 0),
+                    axis=1,
+                    dtype=q.payload.dtype,
+                ),
+                0,
+            ),
+        )
+    clear = active[:, None] & onehot
     return (
         q._replace(
             t=jnp.where(clear, TIME_MAX, q.t),
