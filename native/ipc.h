@@ -7,11 +7,15 @@
  * The Python side mirrors this layout with struct offsets
  * (shadow_tpu/native_plane.py); keep the two in sync.
  *
- * Protocol: strict ping-pong per thread. The shim writes `to_shadow` only
- * when it is EMPTY (guaranteed: it owns exactly one in-flight request), the
- * simulator replies on `to_shim`. `sim_time_ns` is the shared simulated
- * clock the shim answers time syscalls from without a context switch
- * (HostShmem.sim_time, shim_shmem.rs:91 / shim_sys.c:25-114).
+ * Protocol: strict ping-pong per thread. Each thread of a managed process
+ * owns one channel-pair slot (the reference's one-IPCData-per-ManagedThread,
+ * managed_thread.rs:110): the thread writes `to_shadow` only when it is
+ * EMPTY (it owns exactly one in-flight request), the simulator replies on
+ * `to_shim`. `sim_time_ns` is the shared simulated clock the shim answers
+ * time syscalls from without a context switch (HostShmem.sim_time,
+ * shim_shmem.rs:91 / shim_sys.c:25-114). `doorbell` is bumped (and
+ * futex-woken) after every to_shadow send so the simulator can wait on ONE
+ * word for activity from any thread instead of polling every slot.
  */
 #ifndef SHADOW_NATIVE_IPC_H
 #define SHADOW_NATIVE_IPC_H
@@ -25,6 +29,8 @@ enum MsgKind {
     MSG_START_OK = 3,         /* shadow -> shim: begin running               */
     MSG_SYSCALL_COMPLETE = 4, /* shadow -> shim: emulated, ret in `ret`      */
     MSG_SYSCALL_NATIVE = 5,   /* shadow -> shim: execute natively            */
+    MSG_THREAD_START = 6,     /* shim(new thread) -> shadow: tid in `num`    */
+    MSG_CLONE_DONE = 7,       /* shim(parent) -> shadow: real tid in args[0] */
 };
 
 enum ChanState {
@@ -47,15 +53,23 @@ typedef struct {
     ShimMsg msg;
 } ShimChan; /* 80 bytes */
 
+#define IPC_MAX_THREADS 32
+
+typedef struct {
+    ShimChan to_shadow;
+    ShimChan to_shim;
+} ShimChanPair; /* 160 bytes */
+
 typedef struct {
     int64_t sim_time_ns; /* simulator-maintained simulated clock */
+    uint32_t doorbell;   /* futex word: bumped on every to_shadow send */
     uint32_t _flags;
-    uint32_t _pad;
-    ShimChan to_shadow; /* offset 16 */
-    ShimChan to_shim;   /* offset 96 */
-} IpcBlock; /* 176 bytes */
+    ShimChanPair thread[IPC_MAX_THREADS]; /* slot 0 = main thread */
+} IpcBlock; /* 16 + 32*160 = 5136 bytes */
 
-#define IPC_TO_SHADOW_OFF 16
-#define IPC_TO_SHIM_OFF 96
+#define IPC_DOORBELL_OFF 8
+#define IPC_THREADS_OFF 16
+#define IPC_CHANPAIR_SIZE 160
+#define IPC_TO_SHIM_OFF 80 /* within a pair */
 
 #endif

@@ -4,8 +4,10 @@
  * seccomp filter install + SIGSYS interposition (shim_seccomp.c:36-68,
  * 189-250), local handling of hot time syscalls from the shared simulated
  * clock (shim_sys.c:25-114), the syscall dispatch loop (shim_syscall.c),
- * and the preload-libc symbol overrides (lib/preload-libc) for
- * vdso-destined time calls that raw seccomp cannot trap.
+ * the clone trampoline that starts a new managed thread by rebuilding the
+ * interrupted register context on the new stack (src/lib/shim/src/clone.rs),
+ * and the preload-libc symbol overrides (lib/preload-libc) for vdso-destined
+ * time calls that raw seccomp cannot trap.
  *
  * Mechanism:
  *   1. constructor maps the IPC block (path in SHADOW_SHM_PATH), builds a
@@ -14,9 +16,15 @@
  *      the trampoline page and TRAPs everything else;
  *   2. trapped syscalls hit handle_sigsys(): time syscalls answered from
  *      IpcBlock.sim_time_ns with no context switch; everything else is
- *      shipped over the futex channel and either completed with the
+ *      shipped over the thread's futex channel and either completed with the
  *      simulator's return value or re-executed natively via the trampoline
- *      when the simulator answers MSG_SYSCALL_NATIVE.
+ *      when the simulator answers MSG_SYSCALL_NATIVE;
+ *   3. thread clones (CLONE_VM) are a three-step handshake: the simulator
+ *      allocates a channel slot, the parent re-issues the real clone onto a
+ *      private bootstrap stack, and the child claims the slot, checks in
+ *      (MSG_THREAD_START), then restores the interrupted context with rax=0
+ *      so execution resumes inside the caller's own clone wrapper — the
+ *      caller's calling convention never matters (clone.rs's approach).
  */
 
 #define _GNU_SOURCE 1
@@ -26,6 +34,7 @@
 #include <linux/filter.h>
 #include <linux/futex.h>
 #include <linux/seccomp.h>
+#include <sched.h>
 #include <signal.h>
 #include <stddef.h>
 #include <stdint.h>
@@ -45,6 +54,10 @@ typedef long (*raw_syscall_fn)(long n, long a, long b, long c, long d, long e,
                                long f);
 static raw_syscall_fn g_raw = nullptr;
 static uintptr_t g_tramp_page = 0;
+
+/* this thread's channel slot; initial-exec TLS so no lazy __tls_get_addr
+ * allocation can run inside the SIGSYS handler */
+static __thread int t_slot __attribute__((tls_model("initial-exec"))) = 0;
 
 /* ----------------------------------------------------------- trampoline */
 
@@ -83,6 +96,11 @@ static void futex_wait(uint32_t *addr, uint32_t val) {
     g_raw(SYS_futex, (long)addr, FUTEX_WAIT, val, 0, 0, 0);
 }
 
+static void ring_doorbell(void) {
+    __atomic_fetch_add(&g_ipc->doorbell, 1, __ATOMIC_RELEASE);
+    futex_wake(&g_ipc->doorbell);
+}
+
 static void chan_send(ShimChan *c, const ShimMsg *m) {
     /* ping-pong: our previous message was consumed before we send again */
     while (__atomic_load_n(&c->state, __ATOMIC_ACQUIRE) == CHAN_FULL)
@@ -90,6 +108,7 @@ static void chan_send(ShimChan *c, const ShimMsg *m) {
     c->msg = *m;
     __atomic_store_n(&c->state, CHAN_FULL, __ATOMIC_RELEASE);
     futex_wake(&c->state);
+    ring_doorbell();
 }
 
 static int chan_recv(ShimChan *c, ShimMsg *out) {
@@ -104,6 +123,9 @@ static int chan_recv(ShimChan *c, ShimMsg *out) {
     futex_wake(&c->state);
     return 0;
 }
+
+static ShimChan *to_shadow(int slot) { return &g_ipc->thread[slot].to_shadow; }
+static ShimChan *to_shim(int slot) { return &g_ipc->thread[slot].to_shim; }
 
 /* ----------------------------------------------------- time-from-shmem */
 
@@ -142,21 +164,231 @@ static long emulate_time_syscall(long num, long a, long b) {
 
 /* --------------------------------------------------------------- sigsys */
 
-static long forward_syscall(long num, const long args[6]) {
+static long forward_msg(int kind, long num, const long args[6]) {
     ShimMsg req, resp;
     memset(&req, 0, sizeof req);
-    req.kind = MSG_SYSCALL;
+    req.kind = kind;
     req.num = num;
-    for (int i = 0; i < 6; i++)
-        req.args[i] = args[i];
-    chan_send(&g_ipc->to_shadow, &req);
-    if (chan_recv(&g_ipc->to_shim, &resp) != 0) {
+    if (args)
+        for (int i = 0; i < 6; i++)
+            req.args[i] = args[i];
+    chan_send(to_shadow(t_slot), &req);
+    if (chan_recv(to_shim(t_slot), &resp) != 0) {
         /* simulator went away: die quietly (ProcessDeath analogue) */
         g_raw(SYS_exit_group, 1, 0, 0, 0, 0, 0);
     }
     if (resp.kind == MSG_SYSCALL_NATIVE)
         return g_raw(num, args[0], args[1], args[2], args[3], args[4], args[5]);
     return resp.ret;
+}
+
+static long forward_syscall(long num, const long args[6]) {
+    return forward_msg(MSG_SYSCALL, num, args);
+}
+
+/* ------------------------------------------------------- clone trampoline
+ *
+ * The child of a raw clone resumes at the instruction after `syscall` with
+ * rax=0 on the caller-provided stack. Re-issuing clone from the SIGSYS
+ * handler would resume the child inside OUR trampoline instead of the
+ * app's clone wrapper, with the wrapper's child-path code skipped. So the
+ * child first runs on a private bootstrap stack, checks in with the
+ * simulator on its new channel slot, and then restores the complete
+ * interrupted register context with rax=0 — execution continues at the
+ * app's own `syscall` return point on the app-provided child stack, for
+ * any caller convention (glibc clone.S, musl, raw syscall()). Reference:
+ * src/lib/shim/src/clone.rs.
+ */
+
+struct CloneBoot {
+    uint64_t regs[16]; /* indexed by BOOT_* below */
+    int slot;
+};
+
+enum {
+    B_R8, B_R9, B_R10, B_R11, B_R12, B_R13, B_R14, B_R15,
+    B_RDI, B_RSI, B_RBP, B_RBX, B_RDX, B_RCX, B_RSP, B_RIP,
+};
+
+static CloneBoot *g_pending_boot = nullptr; /* one clone in flight at a time
+                                             * (the simulator defers the
+                                             * parent's clone return until
+                                             * the child has claimed this) */
+/* bootstrap page per slot: reclaimed when the simulator recycles the slot
+ * for a new thread (the previous occupant has fully exited by then) */
+static void *g_boot_pages[IPC_MAX_THREADS] = {nullptr};
+static char g_shm_base[256]; /* SHADOW_SHM_PATH; fork children map
+                              * "<base>.f<id>" for their own block */
+
+extern "C" void shadow_restore_ctx(CloneBoot *b);
+/* restore every register from the saved context, set rax=0 (clone's child
+ * return value), and jump to the interrupted rip on the app child stack */
+asm(".text\n"
+    ".globl shadow_restore_ctx\n"
+    "shadow_restore_ctx:\n"
+    "  movq 0x70(%rdi), %rsp\n"  /* B_RSP: app-provided child stack */
+    "  pushq 0x78(%rdi)\n"       /* B_RIP: return target */
+    "  movq 0x00(%rdi), %r8\n"
+    "  movq 0x08(%rdi), %r9\n"
+    "  movq 0x10(%rdi), %r10\n"
+    "  movq 0x18(%rdi), %r11\n"
+    "  movq 0x20(%rdi), %r12\n"
+    "  movq 0x28(%rdi), %r13\n"
+    "  movq 0x30(%rdi), %r14\n"
+    "  movq 0x38(%rdi), %r15\n"
+    "  movq 0x48(%rdi), %rsi\n"
+    "  movq 0x50(%rdi), %rbp\n"
+    "  movq 0x58(%rdi), %rbx\n"
+    "  movq 0x60(%rdi), %rdx\n"
+    "  movq 0x68(%rdi), %rcx\n"
+    "  movq 0x40(%rdi), %rdi\n"
+    "  xorl %eax, %eax\n"
+    "  ret\n");
+
+extern "C" void shadow_clone_child_entry(void) {
+    CloneBoot *b = g_pending_boot;
+    t_slot = b->slot; /* TLS valid: CLONE_SETTLS ran before any child code */
+    ShimMsg m, resp;
+    memset(&m, 0, sizeof m);
+    m.kind = MSG_THREAD_START;
+    m.num = g_raw(SYS_gettid, 0, 0, 0, 0, 0, 0);
+    chan_send(to_shadow(t_slot), &m);
+    if (chan_recv(to_shim(t_slot), &resp) != 0 || resp.kind != MSG_START_OK)
+        g_raw(SYS_exit, 1, 0, 0, 0, 0, 0);
+    shadow_restore_ctx(b);
+    __builtin_unreachable();
+}
+
+static long do_thread_clone(const long args[6], greg_t *regs) {
+    /* 1. simulator allocates the channel slot (or refuses) */
+    long slot = forward_syscall(SYS_clone, args);
+    if (slot < 0)
+        return slot;
+
+    /* 2. bootstrap area: one RW page = CloneBoot at the base, the rest is
+     * the child's temporary stack (its real stack is restored in step 3) */
+    if (g_boot_pages[slot]) {
+        g_raw(SYS_munmap, (long)g_boot_pages[slot], 16384, 0, 0, 0, 0);
+        g_boot_pages[slot] = nullptr;
+    }
+    void *page = mmap(nullptr, 16384, PROT_READ | PROT_WRITE,
+                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (page == MAP_FAILED) {
+        long done_args[6] = {-ENOMEM, slot, 0, 0, 0, 0};
+        forward_msg(MSG_CLONE_DONE, SYS_clone, done_args);
+        return -ENOMEM;
+    }
+    CloneBoot *boot = (CloneBoot *)page;
+    g_boot_pages[slot] = page;
+    boot->slot = (int)slot;
+    boot->regs[B_R8] = regs[REG_R8];
+    boot->regs[B_R9] = regs[REG_R9];
+    boot->regs[B_R10] = regs[REG_R10];
+    boot->regs[B_R11] = regs[REG_R11];
+    boot->regs[B_R12] = regs[REG_R12];
+    boot->regs[B_R13] = regs[REG_R13];
+    boot->regs[B_R14] = regs[REG_R14];
+    boot->regs[B_R15] = regs[REG_R15];
+    boot->regs[B_RDI] = regs[REG_RDI];
+    boot->regs[B_RSI] = regs[REG_RSI];
+    boot->regs[B_RBP] = regs[REG_RBP];
+    boot->regs[B_RBX] = regs[REG_RBX];
+    boot->regs[B_RDX] = regs[REG_RDX];
+    boot->regs[B_RCX] = regs[REG_RCX];
+    boot->regs[B_RSP] = args[1]; /* the app-provided child stack */
+    boot->regs[B_RIP] = regs[REG_RIP]; /* after the trapped syscall insn */
+    g_pending_boot = boot;
+
+    /* child bootstrap stack: plant the entry address so the raw clone's
+     * child pops it from the trampoline's `ret` */
+    uint64_t *tos = (uint64_t *)((char *)page + 16384 - 64);
+    tos[0] = (uint64_t)&shadow_clone_child_entry;
+
+    /* 3. the real clone: original flags/ptid/ctid/tls, our bootstrap stack */
+    long tid = g_raw(SYS_clone, args[0], (long)tos, args[2], args[3], args[4],
+                     0);
+    /* 4. report the result; the simulator orders parent-then-child resume */
+    long done_args[6] = {tid, slot, 0, 0, 0, 0};
+    return forward_msg(MSG_CLONE_DONE, SYS_clone, done_args);
+}
+
+/* ------------------------------------------------------------------- fork
+ *
+ * Fork-style clones (no CLONE_VM) get a whole new IPC block: the simulator
+ * creates "<base>.f<id>" and replies with the id; both sides map it before
+ * the fork so the child can check in on it (slot 0) while the parent keeps
+ * its own block. CLONE_VFORK is downgraded to plain fork semantics (copied
+ * memory, parent continues) — posix_spawn-style users exec immediately and
+ * never notice. Reference: Shadow emulates fork/vfork in handle_clone
+ * (host/syscall/handler/process.rs) with the same downgrade. */
+
+static long do_fork(long num, const long args[6]) {
+    long fork_id = forward_msg(MSG_SYSCALL, num, args);
+    if (fork_id < 0)
+        return fork_id;
+
+    char path[300];
+    size_t bl = strlen(g_shm_base);
+    memcpy(path, g_shm_base, bl);
+    path[bl] = '.';
+    path[bl + 1] = 'f';
+    /* decimal fork_id */
+    char digits[24];
+    int nd = 0;
+    long v = fork_id;
+    do {
+        digits[nd++] = (char)('0' + (v % 10));
+        v /= 10;
+    } while (v);
+    for (int i = 0; i < nd; i++)
+        path[bl + 2 + i] = digits[nd - 1 - i];
+    path[bl + 2 + nd] = 0;
+
+    long fd = g_raw(SYS_open, (long)path, O_RDWR | O_CLOEXEC, 0, 0, 0, 0);
+    if (fd < 0) {
+        long done_args[6] = {-ENOMEM, fork_id, 1, 0, 0, 0};
+        return forward_msg(MSG_CLONE_DONE, num, done_args);
+    }
+    long mem = g_raw(SYS_mmap, 0, sizeof(IpcBlock), PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    g_raw(SYS_close, fd, 0, 0, 0, 0, 0);
+    if ((unsigned long)mem >= (unsigned long)-4095) {
+        long done_args[6] = {-ENOMEM, fork_id, 1, 0, 0, 0};
+        return forward_msg(MSG_CLONE_DONE, num, done_args);
+    }
+    IpcBlock *nb = (IpcBlock *)mem;
+
+    /* plain fork; keep glibc's tid-cache flags if the caller passed them */
+    long keep = 0;
+    long ctid = 0;
+    if (num == SYS_clone) {
+        keep = args[0] &
+               (CLONE_CHILD_SETTID | CLONE_CHILD_CLEARTID | 0xffl);
+        ctid = args[3];
+    } else {
+        keep = SIGCHLD;
+    }
+    long rc = g_raw(SYS_clone, keep, 0, 0, ctid, 0, 0);
+    if (rc == 0) {
+        /* child: fresh block, main slot, check in as a new process */
+        g_ipc = nb;
+        t_slot = 0;
+        ShimMsg m, resp;
+        memset(&m, 0, sizeof m);
+        m.kind = MSG_START;
+        m.num = g_raw(SYS_getpid, 0, 0, 0, 0, 0, 0);
+        chan_send(to_shadow(0), &m);
+        if (chan_recv(to_shim(0), &resp) != 0 || resp.kind != MSG_START_OK)
+            g_raw(SYS_exit_group, 96, 0, 0, 0, 0, 0);
+        /* update the env var so execve re-inits onto the child's block */
+        setenv("SHADOW_SHM_PATH", path, 1);
+        memcpy(g_shm_base, path, strlen(path) + 1);
+        return 0;
+    }
+    /* parent: drop the child's mapping, report the real pid */
+    g_raw(SYS_munmap, mem, sizeof(IpcBlock), 0, 0, 0, 0);
+    long done_args[6] = {rc, fork_id, 1, 0, 0, 0};
+    return forward_msg(MSG_CLONE_DONE, num, done_args);
 }
 
 extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
@@ -185,6 +417,21 @@ extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
         ret = 0;
         break;
     }
+    case SYS_clone3:
+        /* glibc falls back to clone(2) on ENOSYS; one trap path to handle */
+        ret = -ENOSYS;
+        break;
+    case SYS_clone:
+        if ((args[0] & CLONE_VM) && !(args[0] & CLONE_VFORK)) {
+            ret = do_thread_clone(args, regs);
+        } else {
+            ret = do_fork(num, args);
+        }
+        break;
+    case SYS_fork:
+    case SYS_vfork:
+        ret = do_fork(num, args);
+        break;
     default:
         ret = forward_syscall(num, args);
         break;
@@ -271,6 +518,10 @@ __attribute__((constructor)) static void shadow_shim_init(void) {
     const char *path = getenv("SHADOW_SHM_PATH");
     if (!path)
         return; /* not under the simulator: run natively */
+    size_t plen = strlen(path);
+    if (plen >= sizeof(g_shm_base) - 8)
+        _exit(90);
+    memcpy(g_shm_base, path, plen + 1);
     int fd = open(path, O_RDWR | O_CLOEXEC);
     if (fd < 0)
         _exit(91);
@@ -298,7 +549,7 @@ __attribute__((constructor)) static void shadow_shim_init(void) {
     start.num = getpid();
     if (install_seccomp())
         _exit(95);
-    chan_send(&g_ipc->to_shadow, &start);
-    if (chan_recv(&g_ipc->to_shim, &resp) != 0 || resp.kind != MSG_START_OK)
+    chan_send(to_shadow(0), &start);
+    if (chan_recv(to_shim(0), &resp) != 0 || resp.kind != MSG_START_OK)
         _exit(96);
 }

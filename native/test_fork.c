@@ -1,0 +1,71 @@
+/* fork workout: a fork-based one-shot UDP server. The parent binds an
+ * emulated UDP socket, forks; the child sends it a datagram (inheriting
+ * nothing but the fd table) and exits with a distinctive code; the parent
+ * receives in simulated time and reaps the child with wait4. Exercises
+ * fork, fd-table inheritance, getpid/getppid virtualization, cross-process
+ * emulated sockets, and wait-status plumbing (reference: src/test/clone +
+ * fork tests). */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+int main(void) {
+    int srv = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof addr);
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(9000);
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (bind(srv, (struct sockaddr *)&addr, sizeof addr)) {
+        printf("bind failed\n");
+        return 1;
+    }
+    printf("parent %d: bound t=%ldms\n", getpid() > 0, now_ms());
+
+    pid_t child = fork();
+    if (child < 0) {
+        printf("fork failed\n");
+        return 1;
+    }
+    if (child == 0) {
+        /* child: note the inherited fd still works, then message parent */
+        struct timespec d = {0, 30 * 1000 * 1000};
+        nanosleep(&d, NULL);
+        int c = socket(AF_INET, SOCK_DGRAM, 0);
+        struct sockaddr_in dst;
+        memset(&dst, 0, sizeof dst);
+        dst.sin_family = AF_INET;
+        dst.sin_port = htons(9000);
+        dst.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        char msg[64];
+        snprintf(msg, sizeof msg, "hello-from-child ppid_ok=%d",
+                 getppid() != getpid());
+        sendto(c, msg, strlen(msg), 0, (struct sockaddr *)&dst, sizeof dst);
+        close(c);
+        printf("child: sent t=%ldms\n", now_ms());
+        return 7;
+    }
+
+    char buf[128];
+    ssize_t n = recvfrom(srv, buf, sizeof buf - 1, 0, NULL, NULL);
+    buf[n > 0 ? n : 0] = 0;
+    printf("parent: got \"%s\" t=%ldms\n", buf, now_ms());
+
+    int status = 0;
+    pid_t got = wait4(-1, &status, 0, NULL);
+    printf("parent: reaped match=%d exit=%d t=%ldms\n", got == child,
+           WIFEXITED(status) ? WEXITSTATUS(status) : -1, now_ms());
+    return 0;
+}

@@ -1,0 +1,30 @@
+/* Sequential create/join churn: more total threads than IPC slots proves
+ * slot recycling (and the serialized clone handshake) works. */
+#include <pthread.h>
+#include <stdio.h>
+#include <time.h>
+
+static void *bump(void *arg) {
+    long *p = (long *)arg;
+    struct timespec d = {0, 1000000}; /* 1ms */
+    nanosleep(&d, NULL);
+    (*p)++;
+    return NULL;
+}
+
+int main(void) {
+    long counter = 0;
+    for (int i = 0; i < 40; i++) {
+        pthread_t th;
+        if (pthread_create(&th, NULL, bump, &counter)) {
+            printf("create %d failed\n", i);
+            return 1;
+        }
+        pthread_join(th, NULL);
+    }
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    printf("churn done counter=%ld t=%ldms\n", counter,
+           ts.tv_sec * 1000 + ts.tv_nsec / 1000000);
+    return 0;
+}

@@ -26,6 +26,7 @@ import os
 import struct
 import subprocess
 import tempfile
+import time
 
 # ---- layout mirror of native/ipc.h ----------------------------------------
 
@@ -34,13 +35,19 @@ MSG_SYSCALL = 2
 MSG_START_OK = 3
 MSG_SYSCALL_COMPLETE = 4
 MSG_SYSCALL_NATIVE = 5
+MSG_THREAD_START = 6
+MSG_CLONE_DONE = 7
 
 CHAN_EMPTY, CHAN_FULL, CHAN_CLOSED = 0, 1, 2
 
-# message wire format is "<ii q 6q q" at channel offset + 8 (see ipc.h)
-TO_SHADOW_OFF = 16
-TO_SHIM_OFF = 96
-IPC_SIZE = 176
+# message wire format is "<ii q 6q q" at channel offset + 8 (see ipc.h).
+# One channel-pair slot per thread (slot 0 = main thread).
+IPC_MAX_THREADS = 32
+DOORBELL_OFF = 8
+THREADS_OFF = 16
+CHANPAIR_SIZE = 160
+PAIR_TO_SHIM_OFF = 80
+IPC_SIZE = THREADS_OFF + IPC_MAX_THREADS * CHANPAIR_SIZE
 
 _libc = ctypes.CDLL(None, use_errno=True)
 SYS_futex = 202
@@ -126,6 +133,7 @@ _ARTIFACTS = (
     "libshadow_shim.so", "test_app", "test_busy", "test_udp_echo",
     "test_udp_client", "test_tcp_stream", "test_epoll_server",
     "test_filewrite", "test_sockaddr_len", "test_writev_sock",
+    "test_threads", "test_fork", "test_thread_churn",
 )
 
 
@@ -147,25 +155,47 @@ def ensure_built() -> bool:
 # ---- IPC block -------------------------------------------------------------
 
 class IpcBlock:
-    """One shared-memory block (file-backed) mirroring native/ipc.h."""
+    """One shared-memory block (file-backed) mirroring native/ipc.h.
 
-    def __init__(self):
-        # owner pid is embedded in the name so shm_cleanup() can check
-        # liveness before unlinking (reference utility/shm_cleanup.rs)
-        fd, self.path = tempfile.mkstemp(
-            prefix=f"shadow-ipc-{os.getpid()}-", dir="/dev/shm"
-        )
+    Holds IPC_MAX_THREADS channel-pair slots; slot 0 is the main thread.
+    `recv_any` waits on the shared doorbell futex (bumped by the shim after
+    every send) instead of polling per-channel — one wait covers every
+    thread. `cur_slot` tracks the slot whose request is being serviced so
+    the ~70 `reply()` call sites in the syscall handlers stay slot-agnostic.
+    """
+
+    def __init__(self, path: str | None = None):
+        if path is None:
+            # owner pid is embedded in the name so shm_cleanup() can check
+            # liveness before unlinking (reference utility/shm_cleanup.rs)
+            fd, self.path = tempfile.mkstemp(
+                prefix=f"shadow-ipc-{os.getpid()}-", dir="/dev/shm"
+            )
+        else:
+            # fork blocks live at "<parent>.f<id>" — the shim derives the
+            # same name from the fork id, so no string crosses the channel
+            fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
+            self.path = path
         os.ftruncate(fd, IPC_SIZE)
         self._mm = mmap.mmap(fd, IPC_SIZE)
         os.close(fd)
-        self._state_addrs = {}
-        base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
-        for name, off in (("to_shadow", TO_SHADOW_OFF), ("to_shim", TO_SHIM_OFF)):
-            self._state_addrs[name] = base + off
+        self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
+        self.cur_slot = 0
+
+    @staticmethod
+    def _shadow_off(slot: int) -> int:
+        return THREADS_OFF + slot * CHANPAIR_SIZE
+
+    @staticmethod
+    def _shim_off(slot: int) -> int:
+        return THREADS_OFF + slot * CHANPAIR_SIZE + PAIR_TO_SHIM_OFF
 
     def close(self):
-        ch_off = TO_SHADOW_OFF
-        self.set_chan_state(ch_off + 0, CHAN_CLOSED, wake=True)
+        # close every channel (threads parked in chan_recv/chan_send see
+        # CHAN_CLOSED and exit) before tearing down the mapping
+        for slot in range(IPC_MAX_THREADS):
+            for off in (self._shadow_off(slot), self._shim_off(slot)):
+                self.set_chan_state(off, CHAN_CLOSED, wake=True)
         try:
             self._mm.close()
         except BufferError:
@@ -180,43 +210,46 @@ class IpcBlock:
         self._mm[0:8] = struct.pack("<q", t_ns)
 
     # -- channel primitives (Python is the "shadow" side)
-    def _chan_off(self, name: str) -> int:
-        return TO_SHADOW_OFF if name == "to_shadow" else TO_SHIM_OFF
-
-    def chan_state(self, name: str) -> int:
-        off = self._chan_off(name)
+    def chan_state_at(self, off: int) -> int:
         return struct.unpack_from("<I", self._mm, off)[0]
 
-    def set_chan_state(self, off_or_name, state: int, wake: bool = False):
-        off = (
-            self._chan_off(off_or_name)
-            if isinstance(off_or_name, str)
-            else off_or_name
-        )
+    def set_chan_state(self, off: int, state: int, wake: bool = False):
         struct.pack_into("<I", self._mm, off, state)
         if wake:
-            addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm)) + off
-            _futex(addr, FUTEX_WAKE, 1 << 30)
+            _futex(self._base + off, FUTEX_WAKE, 1 << 30)
 
-    def recv_syscall(self, timeout_s: float) -> tuple[int, list[int]] | None:
-        """Wait for a message on to_shadow; returns (num, args) or None."""
-        off = TO_SHADOW_OFF
-        addr = ctypes.addressof(ctypes.c_char.from_buffer(self._mm)) + off
-        deadline_attempts = max(1, int(timeout_s / 0.05))
-        for _ in range(deadline_attempts):
-            state = self.chan_state("to_shadow")
-            if state == CHAN_FULL:
-                kind, _pad, num, *rest = struct.unpack_from(
-                    "<ii q 6q q", self._mm, off + 8
-                )
-                args = list(rest[:6])
-                self.set_chan_state(off, CHAN_EMPTY, wake=True)
-                return (kind, num, args)
-            _futex(addr, FUTEX_WAIT, state, 0.05)
-        return None
+    def recv_any(
+        self, timeout_s: float
+    ) -> tuple[int, int, list[int]] | None:
+        """Wait for a message on any slot's to_shadow channel; returns
+        (kind, num, args) or None on timeout. The source slot is recorded
+        in `cur_slot`."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            bell = struct.unpack_from("<I", self._mm, DOORBELL_OFF)[0]
+            for slot in range(IPC_MAX_THREADS):
+                off = self._shadow_off(slot)
+                if self.chan_state_at(off) == CHAN_FULL:
+                    kind, _pad, num, *rest = struct.unpack_from(
+                        "<ii q 6q q", self._mm, off + 8
+                    )
+                    args = list(rest[:6])
+                    self.set_chan_state(off, CHAN_EMPTY, wake=True)
+                    self.cur_slot = slot
+                    return (kind, num, args)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            _futex(
+                self._base + DOORBELL_OFF, FUTEX_WAIT, bell,
+                min(remaining, 0.2),
+            )
 
     def reply(self, kind: int, ret: int = 0):
-        off = TO_SHIM_OFF
+        self.reply_slot(self.cur_slot, kind, ret)
+
+    def reply_slot(self, slot: int, kind: int, ret: int = 0):
+        off = self._shim_off(slot)
         struct.pack_into(
             "<ii q 6q q", self._mm, off + 8, kind, 0, 0, 0, 0, 0, 0, 0, 0,
             ctypes.c_int64(ret).value,
@@ -239,7 +272,8 @@ SYS = {
     "clock_getres": 229, "getdents64": 217, "sched_getaffinity": 204,
     "kill": 62, "tgkill": 234, "madvise": 28, "poll": 7, "ppoll": 271,
     "pipe2": 293, "dup": 32, "getuid": 102, "getgid": 104, "geteuid": 107,
-    "getegid": 108, "getppid": 110,
+    "getegid": 108, "getppid": 110, "clone": 56, "clone3": 435, "tkill": 200,
+    "fork": 57, "vfork": 58, "wait4": 61,
     # sockets
     "socket": 41, "connect": 42, "accept": 43, "sendto": 44, "recvfrom": 45,
     "shutdown": 48, "bind": 49, "listen": 50, "getsockname": 51,
@@ -259,12 +293,69 @@ _NATIVE_OK = {
     for n in (
         "mmap", "mprotect", "munmap", "brk", "madvise", "rt_sigprocmask",
         "sigaltstack", "arch_prctl", "set_tid_address", "set_robust_list",
-        "rseq", "prlimit64", "futex", "openat", "fstat", "newfstatat",
+        "rseq", "prlimit64", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
         "getdents64", "uname", "getuid", "getgid", "geteuid",
         "getegid", "pipe2",
     )
 }
+# NOTE: futex is deliberately NOT native: a thread futex-blocking in the
+# kernel is invisible to the simulator (it never syscalls again), deadlocking
+# the one-runner-at-a-time scheduler — so futex is emulated (reference
+# handler/futex.c for exactly this reason).
+
+# clone(2) flag bits the thread plane interprets
+CLONE_VM = 0x100
+CLONE_PARENT_SETTID = 0x00100000
+CLONE_CHILD_CLEARTID = 0x00200000
+CLONE_CHILD_SETTID = 0x01000000
+
+# futex ops (cmd = op & 0x7f)
+FUTEX_CMD_WAIT = 0
+FUTEX_CMD_WAKE = 1
+FUTEX_CMD_REQUEUE = 3
+FUTEX_CMD_CMP_REQUEUE = 4
+FUTEX_CMD_WAIT_BITSET = 9
+FUTEX_CMD_WAKE_BITSET = 10
+FUTEX_BITSET_ALL = 0xFFFFFFFF
+
+
+class _Thread:
+    """Per-thread bookkeeping (the reference's Thread + ManagedThread pair,
+    thread.rs:221-245 / managed_thread.rs). One channel slot each; the
+    simulator runs exactly one thread at a time (hosts are single-CPU in
+    sim time), so states form a tiny scheduler:
+
+      starting    slot allocated by clone, child not yet checked in
+      start-ready child sent MSG_THREAD_START, owes a MSG_START_OK
+      running     we replied; executing natively until its next trap
+      blocked     parked mid-syscall on a file/timer/futex condition
+      wake-ready  wake fired; owes a MSG_SYSCALL_COMPLETE(pending_reply)
+      dead        exited
+    """
+
+    __slots__ = (
+        "slot", "state", "vtid", "rtid", "clone_flags", "ptid_addr",
+        "ctid_addr", "wake", "poll_deadline", "pending_reply",
+        "blocked_num", "blocked_args", "parent_owed",
+    )
+
+    def __init__(self, slot: int, vtid: int):
+        self.slot = slot
+        self.vtid = vtid
+        self.rtid = 0
+        self.state = "starting"
+        self.clone_flags = 0
+        self.ptid_addr = 0
+        self.ctid_addr = 0
+        self.wake = []  # (file, listener) / (None, timer token) while blocked
+        self.poll_deadline = None  # absolute poll/epoll_wait timeout
+        self.pending_reply = 0
+        self.blocked_num = 0
+        self.blocked_args = []
+        self.parent_owed = None  # (parent slot, ret) reply deferred until
+        # this child checks in — serializes clone bootstraps (see
+        # _finish_clone)
 
 # emulated sockets hand out fds in this range so the two fd spaces (the
 # child's real kernel fds vs the simulator's virtual sockets) can't collide
@@ -351,6 +442,37 @@ _EPOLL_SYSCALLS = {
 }
 
 
+class _Adopted:
+    """Popen-shaped wrapper for a fork child we did not spawn (it is our
+    grandchild, so waitpid is unavailable: liveness comes from /proc and
+    the real zombie is left to its real parent)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: int | None = None
+
+    def poll(self):
+        try:
+            with open(f"/proc/{self.pid}/stat", "rb") as f:
+                if f.read().split(b") ", 1)[1][:1] == b"Z":
+                    self.returncode = 0
+        except OSError:
+            self.returncode = 0
+        return self.returncode
+
+    def wait(self, timeout=None):
+        deadline = time.monotonic() + (timeout or 10)
+        while self.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return self.returncode
+
+    def kill(self):
+        try:
+            os.kill(self.pid, 9)
+        except OSError:
+            pass
+
+
 class NativeProcess:
     """A real Linux binary co-opted into a CpuHost's simulated time."""
 
@@ -363,7 +485,7 @@ class NativeProcess:
     WALL_TIMEOUT_S = 60.0
 
     def __init__(self, host, pid: int, name: str, argv: list[str],
-                 env: dict | None = None):
+                 env: dict | None = None, ipc_path: str | None = None):
         self.host = host
         self.pid = pid  # virtual pid
         self.name = name
@@ -373,7 +495,7 @@ class NativeProcess:
         self.exit_code: int | None = None
         self.stdout: list[bytes] = []
         self.stderr: list[bytes] = []
-        self.ipc = IpcBlock()
+        self.ipc = IpcBlock(path=ipc_path)
         self._child: subprocess.Popen | None = None
         self.syscall_count = 0
         self.expected_final_state = "running"
@@ -383,8 +505,21 @@ class NativeProcess:
         self._vfd_flags: dict[int, int] = {}  # O_NONBLOCK etc.
         self._stdio_dups: dict[int, int] = {}  # vfd -> 1|2 (dup'd stdio)
         self._next_vfd = VFD_BASE
-        self._wake: list = []  # (file, listener) pairs while blocked
-        self._poll_deadline: int | None = None  # absolute poll timeout
+        # threads: slot -> _Thread; slot 0 = main (vtid == pid, Linux-style)
+        self.threads: dict[int, _Thread] = {0: _Thread(0, pid)}
+        self.threads[0].state = "running"
+        self._runner: _Thread | None = self.threads[0]
+        self._cur: _Thread = self.threads[0]  # thread being serviced
+        self._next_slot = 1
+        self._free_slots: list[int] = []  # recycled after clean thread exit
+        # emulated futex table: addr -> FIFO [(thread, bitset)]
+        self._futexes: dict[int, list] = {}
+        # fork bookkeeping
+        self.parent: NativeProcess | None = None
+        self.children: list[NativeProcess] = []
+        self._pending_forks: dict[int, NativeProcess] = {}
+        self._next_fork_id = 1
+        self._wait_waiters: list[_Thread] = []  # threads parked in wait4
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -402,24 +537,39 @@ class NativeProcess:
             stdin=subprocess.DEVNULL,
         )
         self.state = "running"
-        msg = self.ipc.recv_syscall(timeout_s=10.0)
+        msg = self.ipc.recv_any(timeout_s=10.0)
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return
-        self.ipc.reply(MSG_START_OK)
+        self.ipc.reply_slot(0, MSG_START_OK)
         self._service_loop()
+
+    @staticmethod
+    def _drop_vfd(sock):
+        """Refcounted close: fork children share the parent's emulated fd
+        objects; the descriptor dies only with its last holder."""
+        refs = getattr(sock, "_nrefs", 1)
+        if refs > 1:
+            sock._nrefs = refs - 1
+        else:
+            sock.close()
 
     def _die(self, code: int):
         self.state = "zombie"
         self.exit_code = code
         self._clear_wake()
         for sock in self._vfds.values():  # peers see HUP/RST, not silence
-            sock.close()
+            self._drop_vfd(sock)
         self._vfds.clear()
         if self._child is not None and self._child.poll() is None:
             self._child.kill()
             self._child.wait()
         self.ipc.close()
+        if self.parent is not None and self.parent.state == "running":
+            parent = self.parent
+            self.host.schedule(
+                self.host.now(), lambda: parent._child_exited(self)
+            )
         self.host.on_process_exit(self)
 
     def kill(self):
@@ -429,67 +579,425 @@ class NativeProcess:
     # ---- the service loop --------------------------------------------------
 
     def _service_loop(self):
-        """Handle syscalls until the child blocks in sim time or exits
-        (ManagedThread::resume's event loop, managed_thread.rs:187-324)."""
-        while True:
-            msg = self.ipc.recv_syscall(timeout_s=self.WALL_TIMEOUT_S)
+        """Handle syscalls until every thread blocks in sim time or the
+        process exits (ManagedThread::resume's event loop,
+        managed_thread.rs:187-324). Exactly one thread runs at a time —
+        the reference's host-is-single-CPU invariant — so syscall service
+        order is simulator-chosen and deterministic."""
+        while self.state == "running":
+            if self._runner is None:
+                nxt = self._pick_ready()
+                if nxt is None:
+                    return  # all threads parked: back to the host event loop
+                self._resume_thread(nxt)
+            msg = self.ipc.recv_any(timeout_s=self.WALL_TIMEOUT_S)
             if msg is None:
                 if self._child.poll() is not None:
                     self._die(self._child.returncode)
                 else:
                     self._die(98)  # hung child: reap (watchdog analogue)
                 return
-            _, num, args = msg
+            kind, num, args = msg
+            slot = self.ipc.cur_slot
+            t = self.threads.get(slot)
+            if t is None:
+                continue  # message on a freed slot (late death)
+            if kind == MSG_THREAD_START:
+                # new thread checked in from the clone bootstrap; it stays
+                # parked until the scheduler picks it (START_OK owed)
+                t.rtid = num
+                if t.state == "starting":
+                    t.state = "start-ready"
+                if t.parent_owed is not None:
+                    # parent's clone return was deferred until this check-in
+                    pslot, ret = t.parent_owed
+                    t.parent_owed = None
+                    self.ipc.reply_slot(pslot, MSG_SYSCALL_COMPLETE, ret)
+                continue
+            if kind == MSG_CLONE_DONE:
+                if args[2]:  # fork-style (shim's do_fork)
+                    self._finish_fork(t, args)
+                else:
+                    self._finish_clone(t, args)
+                continue
             self.syscall_count += 1
             self.host.counters["syscalls"] += 1
-            stop = self._handle(num, args)
-            if stop:
-                return
+            self._cur = t
+            self._handle(num, args)
+            if t.state != "running":
+                self._runner = None  # parked/dead: schedule someone else
 
-    def _resume_after_sleep(self):
+    # ---- thread scheduling -------------------------------------------------
+
+    def _pick_ready(self) -> _Thread | None:
+        """Lowest-slot thread owing a resume — deterministic order."""
+        for slot in sorted(self.threads):
+            t = self.threads[slot]
+            if t.state in ("start-ready", "wake-ready"):
+                return t
+        return None
+
+    def _resume_thread(self, t: _Thread):
+        self.ipc.set_time(self.host.now())
+        if t.state == "start-ready":
+            self.ipc.reply_slot(t.slot, MSG_START_OK)
+        else:  # wake-ready
+            self.ipc.reply_slot(t.slot, MSG_SYSCALL_COMPLETE, t.pending_reply)
+        t.state = "running"
+        self._runner = t
+
+    def _wake_thread(self, t: _Thread, ret: int):
+        """Make a parked thread runnable with `ret` as its syscall result."""
+        if self.state != "running" or t.state != "blocked":
+            return
+        self._clear_wake(t)
+        t.state = "wake-ready"
+        t.pending_reply = ret
+        self._kick()
+
+    def _kick(self):
+        """Re-enter the service loop if it is not already running (wakes
+        arrive from host events only while every thread is parked)."""
+        if self.state == "running" and self._runner is None:
+            self._service_loop()
+
+    def _finish_clone(self, parent: _Thread, args: list[int]):
+        """Parent reported the real clone result (MSG_CLONE_DONE)."""
+        tid, slot = args[0], args[1]
+        child = self.threads.get(slot)
+        if tid < 0 or child is None:
+            if child is not None and child.state == "starting":
+                del self.threads[slot]
+            self.ipc.reply_slot(parent.slot, MSG_SYSCALL_COMPLETE, tid)
+            return
+        checked_in = child.state != "starting"  # THREAD_START already seen?
+        child.rtid = tid if tid > 0 else child.rtid
+        # virtualize the tid the kernel wrote (PARENT_SETTID targets the
+        # pthread descriptor's tid field): real tids vary run to run, the
+        # virtual tid is deterministic. Safe from racing the child: it is
+        # parked in the clone bootstrap until we grant MSG_START_OK.
+        addrs = set()
+        if child.clone_flags & CLONE_PARENT_SETTID and child.ptid_addr:
+            addrs.add(child.ptid_addr)
+        if child.clone_flags & CLONE_CHILD_SETTID and child.ctid_addr:
+            addrs.add(child.ctid_addr)
+        for addr in addrs:
+            try:
+                _vm_write(self._child.pid, addr, struct.pack("<i", child.vtid))
+            except OSError:
+                pass
+        if checked_in:
+            self.ipc.reply_slot(parent.slot, MSG_SYSCALL_COMPLETE, child.vtid)
+        else:
+            # hold the parent until the child has claimed its bootstrap
+            # (g_pending_boot) and checked in. This (a) closes the window
+            # where a second pthread_create would overwrite the shim's
+            # single in-flight CloneBoot, and (b) keeps the service loop
+            # listening — if the parent were resumed and then parked with
+            # the child not yet checked in, the loop could return with the
+            # late MSG_THREAD_START unheard forever.
+            child.parent_owed = (parent.slot, child.vtid)
+
+    # ---- threads + futex ---------------------------------------------------
+
+    def _handle_clone(self, num: int, args: list[int]) -> bool:
+        """Slot/block-allocation half of the clone handshakes (the shim's
+        do_thread_clone / do_fork step 1; reference native_clone,
+        managed_thread.rs:351-379 + handler/process.rs fork emulation)."""
+        flags = args[0] if num == SYS["clone"] else 0
+        CLONE_VFORK = 0x4000
+        if num in (SYS["fork"], SYS["vfork"]) or not (flags & CLONE_VM) or (
+            flags & CLONE_VFORK
+        ):
+            return self._handle_fork(num, args)
+        if self._free_slots:
+            slot = self._free_slots.pop(0)
+        elif self._next_slot < IPC_MAX_THREADS:
+            slot = self._next_slot
+            self._next_slot += 1
+        else:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EAGAIN)
+            return False
+        child = _Thread(slot, self.pid * 1000 + slot)
+        child.clone_flags = flags
+        child.ptid_addr = args[2]
+        child.ctid_addr = args[3]
+        self.threads[slot] = child
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, slot)
+        return False
+
+    def _handle_fork(self, num: int, args: list[int]) -> bool:
+        """Create the fork child's IPC block + process object; the shim maps
+        '<our block>.f<id>', forks for real, and the child checks in with
+        MSG_START on its own block (serviced by the child object's loop)."""
+        fork_id = self._next_fork_id
+        self._next_fork_id += 1
+        self.host._next_pid += 1
+        child = NativeProcess(
+            self.host, self.host._next_pid, f"{self.name}.f{fork_id}",
+            self.argv, self.env,
+            # the child's block must live at the shim-derivable path
+            ipc_path=self.ipc.path + f".f{fork_id}",
+        )
+        child.parent = self
+        # fd table is inherited: same emulated objects, refcounted so a
+        # close in one process does not tear the other's descriptor down
+        child._vfds = dict(self._vfds)
+        child._vfd_flags = dict(self._vfd_flags)
+        child._stdio_dups = dict(self._stdio_dups)
+        child._next_vfd = self._next_vfd
+        for sock in child._vfds.values():
+            sock._nrefs = getattr(sock, "_nrefs", 1) + 1
+        self._pending_forks[fork_id] = child
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, fork_id)
+        return False
+
+    def _finish_fork(self, parent_thr: _Thread, args: list[int]):
+        rc, fork_id = args[0], args[1]
+        child = self._pending_forks.pop(fork_id, None)
+        if child is None or rc < 0:
+            if child is not None:
+                child.ipc.close()
+            self.ipc.reply_slot(parent_thr.slot, MSG_SYSCALL_COMPLETE,
+                                min(rc, -1) if rc < 0 else -errno.EAGAIN)
+            return
+        child._child = _Adopted(rc)
+        child.state = "running"
+        self.children.append(child)
+        self.host.processes[child.pid] = child
+        # the child's service loop starts when the host event fires (i.e.
+        # once the parent's loop yields) — its MSG_START waits in the block
+        self.host.schedule(self.host.now(), child._adopt_run)
+        self.ipc.reply_slot(parent_thr.slot, MSG_SYSCALL_COMPLETE, child.pid)
+
+    def _adopt_run(self):
+        """First service entry for a fork child: answer its MSG_START."""
         if self.state != "running":
             return
         self.ipc.set_time(self.host.now())
-        self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+        msg = self.ipc.recv_any(timeout_s=10.0)
+        if msg is None or msg[0] != MSG_START:
+            self._die(97)
+            return
+        self.ipc.reply_slot(0, MSG_START_OK)
         self._service_loop()
+
+    def _handle_wait4(self, args: list[int]) -> bool:
+        """wait4: reap a zombie child (vpid + status), or park until one
+        exits. WNOHANG honored; rusage ignored (zeroed)."""
+        WNOHANG = 1
+        want = ctypes.c_int32(args[0] & 0xFFFFFFFF).value
+        cpid = self._child.pid
+
+        def match(c):
+            return want in (-1, 0) or want == c.pid
+
+        for c in list(self.children):
+            if c.state == "zombie" and match(c):
+                self.children.remove(c)
+                if args[1]:
+                    status = (c.exit_code or 0) << 8  # WIFEXITED encoding
+                    _vm_write(cpid, args[1], struct.pack("<i", status))
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, c.pid)
+                return False
+        if not any(match(c) for c in self.children):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ECHILD)
+            return False
+        if args[2] & WNOHANG:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        thr = self._cur
+        thr.state = "blocked"
+        thr.blocked_num = SYS["wait4"]
+        thr.blocked_args = list(args)
+        self._wait_waiters.append(thr)
+        return True
+
+    def _child_exited(self, child: NativeProcess):
+        """A fork child died: retry any parked wait4 (deterministically at
+        the current sim time)."""
+        waiters, self._wait_waiters = self._wait_waiters, []
+        for thr in waiters:
+            if thr.state != "blocked":
+                continue
+            thr.state = "running"
+            self.ipc.set_time(self.host.now())
+            self.ipc.cur_slot = thr.slot
+            self._cur = thr
+            self._handle_wait4(thr.blocked_args)
+            if thr.state == "running":
+                self._runner = thr
+                self._kick_runner()
+
+    def _kick_runner(self):
+        """Enter the service loop for an already-resumed runner if we are
+        not inside it (used by wake paths driven from host events)."""
+        if self.state == "running" and self._runner is not None:
+            self._service_loop()
+
+    def _handle_futex(self, args: list[int]) -> bool:
+        """Emulated futex (reference handler/futex.c): threads must block in
+        SIM time, not invisibly in the kernel. Supports WAIT/WAKE (+_BITSET)
+        and (CMP_)REQUEUE — the glibc pthread surface."""
+        addr, op, val = args[0], args[1], args[2] & 0xFFFFFFFF
+        cmd = op & 0x7F
+        cpid = self._child.pid
+        thr = self._cur
+
+        if cmd in (FUTEX_CMD_WAIT, FUTEX_CMD_WAIT_BITSET):
+            try:
+                cur = struct.unpack("<I", _vm_read(cpid, addr, 4))[0]
+            except (OSError, struct.error):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            if cur != val:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EAGAIN)
+                return False
+            bitset = (
+                args[5] & 0xFFFFFFFF
+                if cmd == FUTEX_CMD_WAIT_BITSET
+                else FUTEX_BITSET_ALL
+            ) or FUTEX_BITSET_ALL
+            thr.state = "blocked"
+            self._futexes.setdefault(addr, []).append((thr, bitset))
+            if args[3]:  # timespec pointer
+                raw = _vm_read(cpid, args[3], 16)
+                if len(raw) == 16:
+                    sec, nsec = struct.unpack("<qq", raw)
+                    t_ns = sec * NS_PER_SEC + nsec
+                    # WAIT: relative. WAIT_BITSET: absolute (sim clock).
+                    deadline = (
+                        max(t_ns, self.host.now())
+                        if cmd == FUTEX_CMD_WAIT_BITSET
+                        else self.host.now() + max(0, t_ns)
+                    )
+                    token = self.host.schedule(
+                        deadline,
+                        lambda: self._futex_timeout(addr, thr),
+                    )
+                    thr.wake.append((None, token))
+            return True
+
+        if cmd in (FUTEX_CMD_WAKE, FUTEX_CMD_WAKE_BITSET):
+            bitset = (
+                args[5] & 0xFFFFFFFF
+                if cmd == FUTEX_CMD_WAKE_BITSET
+                else FUTEX_BITSET_ALL
+            ) or FUTEX_BITSET_ALL
+            n = self._futex_wake_addr(addr, val, bitset)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+            return False
+
+        if cmd in (FUTEX_CMD_REQUEUE, FUTEX_CMD_CMP_REQUEUE):
+            if cmd == FUTEX_CMD_CMP_REQUEUE:
+                try:
+                    cur = struct.unpack("<I", _vm_read(cpid, addr, 4))[0]
+                except (OSError, struct.error):
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+                if cur != (args[5] & 0xFFFFFFFF):
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EAGAIN)
+                    return False
+            woken = self._futex_wake_addr(addr, val, FUTEX_BITSET_ALL)
+            moved = 0
+            limit = args[3] & 0xFFFFFFFF  # val2: requeue cap
+            q = self._futexes.get(addr, [])
+            dst = self._futexes.setdefault(args[4], [])
+            while q and moved < limit:
+                dst.append(q.pop(0))
+                moved += 1
+            if not q:
+                self._futexes.pop(addr, None)
+            ret = woken + (moved if cmd == FUTEX_CMD_CMP_REQUEUE else 0)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, ret)
+            return False
+
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, -38)  # unsupported op: loud
+        return False
+
+    def _futex_wake_addr(self, addr: int, n: int, bitset: int) -> int:
+        """Wake up to n emulated waiters on addr (FIFO — park order is
+        simulator-chosen, hence deterministic). Returns the count."""
+        q = self._futexes.get(addr)
+        if not q:
+            return 0
+        woken = 0
+        keep = []
+        for thr, wbits in q:
+            if woken < n and (wbits & bitset) and thr.state == "blocked":
+                self._clear_wake(thr)
+                thr.state = "wake-ready"
+                thr.pending_reply = 0
+                woken += 1
+            elif thr.state == "blocked":
+                keep.append((thr, wbits))
+        if keep:
+            self._futexes[addr] = keep
+        else:
+            self._futexes.pop(addr, None)
+        return woken
+
+    def _futex_timeout(self, addr: int, thr: _Thread):
+        if thr.state != "blocked":
+            return
+        q = self._futexes.get(addr, [])
+        self._futexes[addr] = [(t, b) for t, b in q if t is not thr]
+        if not self._futexes[addr]:
+            self._futexes.pop(addr, None)
+        self._clear_wake(thr)
+        thr.state = "wake-ready"
+        thr.pending_reply = -errno.ETIMEDOUT
+        self._kick()
 
     # ---- blocking on emulated files ---------------------------------------
 
     def _block_on(self, files_masks, num: int, args: list[int],
                   timeout_ns: int | None = None):
-        """Park this process until any watched file shows its mask (or the
-        timeout fires), then RE-RUN the same syscall — the reference's
+        """Park the current thread until any watched file shows its mask (or
+        the timeout fires), then RE-RUN the same syscall — the reference's
         SyscallCondition semantics (condition.rs:36-108)."""
         from shadow_tpu.host.filestate import StatusListener
 
+        thr = self._cur
+        thr.state = "blocked"
+
         def wake(_s=None, _c=None):
-            if not self._wake:
+            if not thr.wake:
                 return
-            self._clear_wake()
+            self._clear_wake(thr)
             self.host.schedule(self.host.now(), retry)
 
         def retry():
+            if self.state != "running" or thr.state != "blocked":
+                return
+            thr.state = "running"  # tentative; _block_on re-parks on EAGAIN
+            self.ipc.set_time(self.host.now())
+            self.ipc.cur_slot = thr.slot
+            self._cur = thr
+            self._handle(num, args)
             if self.state != "running":
                 return
-            self.ipc.set_time(self.host.now())
-            if not self._handle(num, args):
+            if thr.state == "running":  # replied: it is the runner again
+                self._runner = thr
                 self._service_loop()
 
         for f, mask in files_masks:
             lst = StatusListener(mask, wake)
             f.add_listener(lst)
-            self._wake.append((f, lst))
+            thr.wake.append((f, lst))
         if timeout_ns is not None:
             token = self.host.schedule(self.host.now() + timeout_ns, wake)
-            self._wake.append((None, token))
+            thr.wake.append((None, token))
 
-    def _clear_wake(self):
-        for f, l in self._wake:
-            if f is None:
-                self.host.cancel(l)
-            else:
-                f.remove_listener(l)
-        self._wake = []
+    def _clear_wake(self, thr: _Thread | None = None):
+        ts = [thr] if thr is not None else list(self.threads.values())
+        for t in ts:
+            for f, l in t.wake:
+                if f is None:
+                    self.host.cancel(l)
+                else:
+                    f.remove_listener(l)
+            t.wake = []
 
     # ---- dispatch ----------------------------------------------------------
 
@@ -512,7 +1020,7 @@ class NativeProcess:
             if args[0] in self._vfds:
                 sock = self._vfds.pop(args[0])
                 self._vfd_flags.pop(args[0], None)
-                sock.close()
+                self._drop_vfd(sock)
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             else:
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
@@ -582,7 +1090,12 @@ class NativeProcess:
                 wake_at = max(self.host.now(), t)  # absolute deadline
             else:
                 wake_at = self.host.now() + max(0, t)
-            self.host.schedule(wake_at, self._resume_after_sleep)
+            thr = self._cur
+            thr.state = "blocked"
+            token = self.host.schedule(
+                wake_at, lambda: self._wake_thread(thr, 0)
+            )
+            thr.wake.append((None, token))
             return True  # parked
 
         if num in (SYS["write"], SYS["writev"]) and (
@@ -713,11 +1226,20 @@ class NativeProcess:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, self.pid)
             return False
         if num == SYS["gettid"]:
-            self.ipc.reply(MSG_SYSCALL_COMPLETE, self.pid)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self._cur.vtid)
             return False
         if num == SYS["getppid"]:
-            self.ipc.reply(MSG_SYSCALL_COMPLETE, 1)
+            self.ipc.reply(
+                MSG_SYSCALL_COMPLETE,
+                self.parent.pid if self.parent is not None else 1,
+            )
             return False
+        if num in (SYS["clone"], SYS["fork"], SYS["vfork"]):
+            return self._handle_clone(num, args)
+        if num == SYS["wait4"]:
+            return self._handle_wait4(args)
+        if num == SYS["futex"]:
+            return self._handle_futex(args)
         if num == SYS["sched_yield"]:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
@@ -735,16 +1257,45 @@ class NativeProcess:
             else:
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
+        if num == SYS["exit"] and any(
+            t is not self._cur and t.state != "dead"
+            for t in self.threads.values()
+        ):
+            # thread exit while siblings live (pthread_exit path): emulate
+            # CLONE_CHILD_CLEARTID — clear the tid word and wake emulated
+            # futex waiters (pthread_join) — then let the thread die for
+            # real. The kernel's own clear/wake happens invisibly later;
+            # ours is the one the emulated waiters see. (thread.rs handling
+            # of child-cleartid + handler/futex.c FUTEX_WAKE.)
+            thr = self._cur
+            thr.state = "dead"
+            if thr.clone_flags & CLONE_CHILD_CLEARTID and thr.ctid_addr:
+                try:
+                    _vm_write(cpid, thr.ctid_addr, struct.pack("<i", 0))
+                except OSError:
+                    pass
+                self._futex_wake_addr(thr.ctid_addr, 1 << 30, FUTEX_BITSET_ALL)
+            self.ipc.reply(MSG_SYSCALL_NATIVE)  # the real thread exits
+            # recycle the channel slot: both channels ended EMPTY (the exit
+            # reply was the last traffic), so a future clone can reuse it
+            del self.threads[thr.slot]
+            self._free_slots.append(thr.slot)
+            return True
         if num in (SYS["exit_group"], SYS["exit"]):
             self.state = "zombie"
             self.exit_code = args[0] & 0xFF
             self._clear_wake()
             for sock in self._vfds.values():
-                sock.close()
+                self._drop_vfd(sock)
             self._vfds.clear()
             self.ipc.reply(MSG_SYSCALL_NATIVE)  # let it really exit
             self._child.wait(timeout=10)
             self.ipc.close()
+            if self.parent is not None and self.parent.state == "running":
+                parent = self.parent
+                self.host.schedule(
+                    self.host.now(), lambda: parent._child_exited(self)
+                )
             self.host.on_process_exit(self)
             return True
         if num in (SYS["poll"], SYS["ppoll"]):
@@ -803,14 +1354,14 @@ class NativeProcess:
                 ready += 1
         now = self.host.now()
         if ready:
-            self._poll_deadline = None
+            self._cur.poll_deadline = None
             _vm_write(cpid, args[0], bytes(out))
             self.ipc.reply(MSG_SYSCALL_COMPLETE, ready)
             return False
         if timeout_ms == 0 or (
-            self._poll_deadline is not None and now >= self._poll_deadline
+            self._cur.poll_deadline is not None and now >= self._cur.poll_deadline
         ):
-            self._poll_deadline = None
+            self._cur.poll_deadline = None
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
         if not watch and timeout_ms < 0:
@@ -821,10 +1372,10 @@ class NativeProcess:
         else:
             # absolute deadline survives re-runs so a timeout wake that
             # finds nothing ready reports 0 instead of re-arming in full
-            if self._poll_deadline is None:
-                self._poll_deadline = now + timeout_ms * 1_000_000
+            if self._cur.poll_deadline is None:
+                self._cur.poll_deadline = now + timeout_ms * 1_000_000
             self._block_on(watch, num, args,
-                           timeout_ns=self._poll_deadline - now)
+                           timeout_ns=self._cur.poll_deadline - now)
         return True
 
     def _handle_epoll(self, num: int, args: list[int]) -> bool:
@@ -912,7 +1463,7 @@ class NativeProcess:
             evs = f.wait(maxev)
             now = self.host.now()
             if evs is not None:
-                self._poll_deadline = None
+                self._cur.poll_deadline = None
                 out = bytearray()
                 for e in evs:
                     out += struct.pack("<I", e.events) + struct.pack("<Q", e.data)
@@ -921,18 +1472,18 @@ class NativeProcess:
                 return False
             timeout_ms = args[3]
             if timeout_ms == 0 or (
-                self._poll_deadline is not None and now >= self._poll_deadline
+                self._cur.poll_deadline is not None and now >= self._cur.poll_deadline
             ):
-                self._poll_deadline = None
+                self._cur.poll_deadline = None
                 reply(MSG_SYSCALL_COMPLETE, 0)
                 return False
             if timeout_ms < 0:
                 self._block_on([(f, FileState.READABLE)], num, args)
             else:
-                if self._poll_deadline is None:
-                    self._poll_deadline = now + timeout_ms * 1_000_000
+                if self._cur.poll_deadline is None:
+                    self._cur.poll_deadline = now + timeout_ms * 1_000_000
                 self._block_on([(f, FileState.READABLE)], num, args,
-                               timeout_ns=self._poll_deadline - now)
+                               timeout_ns=self._cur.poll_deadline - now)
             return True
 
         if num == S["timerfd_settime"]:

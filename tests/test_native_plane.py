@@ -122,3 +122,66 @@ def test_regular_file_write_passthrough(tmp_path):
     )
     assert p.exit_code == 0, b"".join(p.stdout) + b"".join(p.stderr)
     assert b"roundtrip: hello file world" in b"".join(p.stdout)
+
+
+TEST_THREADS = os.path.join(REPO, "native", "build", "test_threads")
+
+
+def test_pthreads_create_join_mutex_condvar():
+    """Multi-threaded managed process: clone trampoline, per-thread IPC
+    slots, emulated futex (mutex + condvar + join), per-thread sleeps in
+    simulated time (reference src/test/threads + src/test/clone)."""
+    _, p = run_one([TEST_THREADS])
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert "worker 0: counter=1 t=10ms" in out
+    assert "worker 1: counter=3 t=20ms" in out
+    assert "worker 2: counter=6 t=30ms" in out
+    assert "worker 3: counter=10 t=40ms" in out
+    assert "main: joined counter=10 retsum=42 t=40ms" in out
+
+
+def test_pthreads_two_runs_identical():
+    a = run_one([TEST_THREADS])[1]
+    b = run_one([TEST_THREADS])[1]
+    assert p_out(a) == p_out(b)
+
+
+def p_out(p):
+    return b"".join(p.stdout) + b"".join(p.stderr)
+
+
+TEST_FORK = os.path.join(REPO, "native", "build", "test_fork")
+
+
+def test_fork_udp_server_and_wait4():
+    """fork(): child gets its own IPC block + virtual pid, inherits the fd
+    table, talks to the parent over an emulated UDP socket, and is reaped
+    with wait4 (status plumbed). Reference: handler/process.rs fork +
+    src/test/clone."""
+    h, p = run_one([TEST_FORK])
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert 'parent: got "hello-from-child ppid_ok=1" t=30ms' in out
+    assert "parent: reaped match=1 exit=7 t=30ms" in out
+    # the fork child ran as its own process object on the host
+    kids = [q for q in h.processes.values() if q.name.endswith(".f1")]
+    assert len(kids) == 1 and kids[0].exit_code == 7
+
+
+def test_fork_two_runs_identical():
+    a = run_one([TEST_FORK])[1]
+    b = run_one([TEST_FORK])[1]
+    assert p_out(a) == p_out(b)
+
+
+TEST_CHURN = os.path.join(REPO, "native", "build", "test_thread_churn")
+
+
+def test_thread_slot_recycling():
+    """40 sequential create/join cycles > 32 IPC slots: slots must recycle
+    after clean thread exit, and clone handshakes serialize correctly."""
+    _, p = run_one([TEST_CHURN], until=10 * SEC)
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert "churn done counter=40 t=40ms" in out
