@@ -31,6 +31,10 @@ enum MsgKind {
     MSG_SYSCALL_NATIVE = 5,   /* shadow -> shim: execute natively            */
     MSG_THREAD_START = 6,     /* shim(new thread) -> shadow: tid in `num`    */
     MSG_CLONE_DONE = 7,       /* shim(parent) -> shadow: real tid in args[0] */
+    MSG_RUN_SIGNAL = 8,       /* shadow -> shim: call handler args[0] with
+                               * signal `num` (args[1]=SA_SIGINFO), then send
+                               * MSG_SIGNAL_DONE and keep waiting            */
+    MSG_SIGNAL_DONE = 9,      /* shim -> shadow: handler returned            */
 };
 
 enum ChanState {
@@ -63,9 +67,14 @@ typedef struct {
 typedef struct {
     int64_t sim_time_ns; /* simulator-maintained simulated clock */
     uint32_t doorbell;   /* futex word: bumped on every to_shadow send */
-    uint32_t _flags;
+    uint32_t flags;      /* bit0: model unblocked-syscall latency; bits1+:
+                          * forward every Nth locally-answered time syscall
+                          * to the simulator so busy-poll loops advance sim
+                          * time (reference handler/mod.rs:268-318) */
     ShimChanPair thread[IPC_MAX_THREADS]; /* slot 0 = main thread */
 } IpcBlock; /* 16 + 32*160 = 5136 bytes */
+
+#define IPC_FLAGS_OFF 12
 
 #define IPC_DOORBELL_OFF 8
 #define IPC_THREADS_OFF 16

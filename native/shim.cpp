@@ -164,6 +164,21 @@ static long emulate_time_syscall(long num, long a, long b) {
 
 /* --------------------------------------------------------------- sigsys */
 
+/* unblocked-latency escape shared by the SIGSYS path and the libc
+ * interposers (ONE per-thread counter): every Nth locally-answered time
+ * call goes to the simulator so it can charge CPU latency — otherwise a
+ * spin-on-clock loop would never advance simulated time */
+static bool time_escape(void) {
+    static __thread uint32_t cnt
+        __attribute__((tls_model("initial-exec"))) = 0;
+    uint32_t flags = __atomic_load_n(&g_ipc->flags, __ATOMIC_RELAXED);
+    if ((flags & 1) && ++cnt >= (flags >> 1)) {
+        cnt = 0;
+        return true;
+    }
+    return false;
+}
+
 static long forward_msg(int kind, long num, const long args[6]) {
     ShimMsg req, resp;
     memset(&req, 0, sizeof req);
@@ -173,9 +188,32 @@ static long forward_msg(int kind, long num, const long args[6]) {
         for (int i = 0; i < 6; i++)
             req.args[i] = args[i];
     chan_send(to_shadow(t_slot), &req);
-    if (chan_recv(to_shim(t_slot), &resp) != 0) {
-        /* simulator went away: die quietly (ProcessDeath analogue) */
-        g_raw(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+    for (;;) {
+        if (chan_recv(to_shim(t_slot), &resp) != 0) {
+            /* simulator went away: die quietly (ProcessDeath analogue) */
+            g_raw(SYS_exit_group, 1, 0, 0, 0, 0, 0);
+        }
+        if (resp.kind != MSG_RUN_SIGNAL)
+            break;
+        /* deliver an emulated signal at this syscall boundary (the
+         * reference invokes handlers under simulator control the same
+         * way: handler/signal.rs). Nested handler syscalls trap and
+         * forward on this same channel — the simulator services them
+         * until we report MSG_SIGNAL_DONE. */
+        int sig = (int)resp.num;
+        if (resp.args[1]) { /* SA_SIGINFO: pass a zeroed siginfo */
+            siginfo_t si;
+            memset(&si, 0, sizeof si);
+            si.si_signo = sig;
+            ((void (*)(int, siginfo_t *, void *))resp.args[0])(sig, &si,
+                                                               nullptr);
+        } else {
+            ((void (*)(int))resp.args[0])(sig);
+        }
+        ShimMsg done;
+        memset(&done, 0, sizeof done);
+        done.kind = MSG_SIGNAL_DONE;
+        chan_send(to_shadow(t_slot), &done);
     }
     if (resp.kind == MSG_SYSCALL_NATIVE)
         return g_raw(num, args[0], args[1], args[2], args[3], args[4], args[5]);
@@ -323,12 +361,17 @@ static long do_thread_clone(const long args[6], greg_t *regs) {
  * (host/syscall/handler/process.rs) with the same downgrade. */
 
 static long do_fork(long num, const long args[6]) {
+    size_t bl = strlen(g_shm_base);
+    /* each fork generation appends ".f<id>"; refuse before either the
+     * local path buffer or the child's g_shm_base copy could overflow */
+    if (bl + 26 >= sizeof(g_shm_base))
+        return -ENAMETOOLONG;
+
     long fork_id = forward_msg(MSG_SYSCALL, num, args);
     if (fork_id < 0)
         return fork_id;
 
     char path[300];
-    size_t bl = strlen(g_shm_base);
     memcpy(path, g_shm_base, bl);
     path[bl] = '.';
     path[bl + 1] = 'f';
@@ -406,7 +449,8 @@ extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
     case SYS_clock_gettime:
     case SYS_gettimeofday:
     case SYS_time:
-        ret = emulate_time_syscall(num, args[0], args[1]);
+        ret = time_escape() ? forward_syscall(num, args)
+                            : emulate_time_syscall(num, args[0], args[1]);
         break;
     case SYS_clock_getres: {
         struct timespec *ts = (struct timespec *)args[1];
@@ -423,7 +467,11 @@ extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
         break;
     case SYS_clone:
         if ((args[0] & CLONE_VM) && !(args[0] & CLONE_VFORK)) {
-            ret = do_thread_clone(args, regs);
+            /* the child claims its channel slot through TLS; without
+             * CLONE_SETTLS it would share the parent's TLS and corrupt the
+             * parent's slot binding (pthreads always pass SETTLS) */
+            ret = (args[0] & CLONE_SETTLS) ? do_thread_clone(args, regs)
+                                           : -ENOSYS;
         } else {
             ret = do_fork(num, args);
         }
@@ -447,6 +495,10 @@ extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
 extern "C" int clock_gettime(clockid_t clk, struct timespec *ts) {
     if (!g_ipc)
         return (int)syscall(SYS_clock_gettime, clk, ts);
+    if (time_escape()) {
+        long args[6] = {(long)clk, (long)ts, 0, 0, 0, 0};
+        return (int)forward_syscall(SYS_clock_gettime, args);
+    }
     int64_t now = sim_now();
     if (ts) {
         ts->tv_sec = now / 1000000000;
@@ -459,6 +511,10 @@ extern "C" int gettimeofday(struct timeval *tv, void *tz) {
     (void)tz;
     if (!g_ipc)
         return (int)syscall(SYS_gettimeofday, tv, tz);
+    if (time_escape()) {
+        long args[6] = {(long)tv, (long)tz, 0, 0, 0, 0};
+        return (int)forward_syscall(SYS_gettimeofday, args);
+    }
     int64_t now = sim_now();
     if (tv) {
         tv->tv_sec = now / 1000000000;
@@ -470,6 +526,10 @@ extern "C" int gettimeofday(struct timeval *tv, void *tz) {
 extern "C" time_t time(time_t *tloc) {
     if (!g_ipc)
         return (time_t)syscall(SYS_time, tloc);
+    if (time_escape()) {
+        long args[6] = {(long)tloc, 0, 0, 0, 0, 0};
+        return (time_t)forward_syscall(SYS_time, args);
+    }
     time_t secs = sim_now() / 1000000000;
     if (tloc)
         *tloc = secs;

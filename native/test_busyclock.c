@@ -1,0 +1,21 @@
+/* Spin on the clock until 5ms of simulated time passes. Without the
+ * unblocked-syscall latency model this loops forever (the shim answers
+ * clock_gettime from shared memory at zero simulated cost); with it, every
+ * Nth call is charged, so the loop terminates deterministically. */
+#include <stdio.h>
+#include <time.h>
+
+int main(void) {
+    struct timespec t0, t;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    long spins = 0;
+    for (;;) {
+        spins++;
+        clock_gettime(CLOCK_MONOTONIC, &t);
+        long d = (t.tv_sec - t0.tv_sec) * 1000000000L + (t.tv_nsec - t0.tv_nsec);
+        if (d >= 5 * 1000 * 1000)
+            break;
+    }
+    printf("busyclock done spins=%ld\n", spins);
+    return 0;
+}

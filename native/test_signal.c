@@ -1,0 +1,67 @@
+/* Signal workout: SIGUSR1 handler + fork child kill()ing the parent
+ * (nanosleep EINTR semantics), a 10ms-period ITIMER_REAL ticking SIGALRM
+ * five times against pause(), and SIGTERM default-terminating a child.
+ * (Reference: src/test/signal + src/test/itimer.) */
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/time.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile int usr1 = 0, alrm = 0;
+
+static long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+static void on_usr1(int sig) { usr1 += (sig == SIGUSR1); }
+static void on_alrm(int sig) { alrm += (sig == SIGALRM); }
+
+int main(void) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof sa);
+    sa.sa_handler = on_usr1;
+    sigaction(SIGUSR1, &sa, NULL);
+
+    /* child 1: signals the parent after 20ms, then loops until SIGTERM */
+    pid_t c1 = fork();
+    if (c1 == 0) {
+        struct timespec d = {0, 20 * 1000 * 1000};
+        nanosleep(&d, NULL);
+        kill(getppid(), SIGUSR1);
+        for (;;)
+            pause();
+    }
+
+    /* the parent's long sleep is interrupted by the handler */
+    struct timespec long_sleep = {5, 0};
+    long rc = nanosleep(&long_sleep, NULL);
+    printf("parent: usr1=%d sleep_interrupted=%d t=%ldms\n", usr1, rc != 0,
+           now_ms());
+
+    /* periodic itimer: 5 ticks of 10ms against pause() */
+    memset(&sa, 0, sizeof sa);
+    sa.sa_handler = on_alrm;
+    sigaction(SIGALRM, &sa, NULL);
+    struct itimerval itv;
+    itv.it_interval.tv_sec = 0;
+    itv.it_interval.tv_usec = 10 * 1000;
+    itv.it_value = itv.it_interval;
+    setitimer(ITIMER_REAL, &itv, NULL);
+    while (alrm < 5)
+        pause();
+    memset(&itv, 0, sizeof itv);
+    setitimer(ITIMER_REAL, &itv, NULL); /* disarm */
+    printf("parent: alrm=%d t=%ldms\n", alrm, now_ms());
+
+    /* SIGTERM's default action kills the pausing child */
+    kill(c1, SIGTERM);
+    int status = 0;
+    pid_t got = wait4(c1, &status, 0, NULL);
+    printf("parent: child_reaped=%d t=%ldms\n", got == c1, now_ms());
+    return 0;
+}

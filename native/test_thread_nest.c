@@ -1,0 +1,38 @@
+/* Nested/concurrent thread creation: every worker spawns a sub-worker, so
+ * clone handshakes from different threads can collide — the simulator must
+ * serialize them (one CloneBoot in flight). */
+#include <pthread.h>
+#include <stdio.h>
+#include <time.h>
+
+static pthread_mutex_t lock = PTHREAD_MUTEX_INITIALIZER;
+static int total = 0;
+
+static void *leaf(void *arg) {
+    (void)arg;
+    pthread_mutex_lock(&lock);
+    total++;
+    pthread_mutex_unlock(&lock);
+    return NULL;
+}
+
+static void *worker(void *arg) {
+    pthread_t sub;
+    pthread_create(&sub, NULL, leaf, NULL);
+    leaf(arg);
+    pthread_join(sub, NULL);
+    return NULL;
+}
+
+int main(void) {
+    pthread_t th[6];
+    for (long i = 0; i < 6; i++)
+        pthread_create(&th[i], NULL, worker, (void *)i);
+    for (long i = 0; i < 6; i++)
+        pthread_join(th[i], NULL);
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    printf("nest done total=%d t=%ldns\n", total,
+           ts.tv_sec * 1000000000L + ts.tv_nsec);
+    return total == 12 ? 0 : 1;
+}

@@ -37,6 +37,8 @@ MSG_SYSCALL_COMPLETE = 4
 MSG_SYSCALL_NATIVE = 5
 MSG_THREAD_START = 6
 MSG_CLONE_DONE = 7
+MSG_RUN_SIGNAL = 8
+MSG_SIGNAL_DONE = 9
 
 CHAN_EMPTY, CHAN_FULL, CHAN_CLOSED = 0, 1, 2
 
@@ -133,7 +135,7 @@ _ARTIFACTS = (
     "libshadow_shim.so", "test_app", "test_busy", "test_udp_echo",
     "test_udp_client", "test_tcp_stream", "test_epoll_server",
     "test_filewrite", "test_sockaddr_len", "test_writev_sock",
-    "test_threads", "test_fork", "test_thread_churn",
+    "test_threads", "test_fork", "test_thread_churn", "test_signal", "test_busyclock", "test_thread_nest",
 )
 
 
@@ -209,6 +211,9 @@ class IpcBlock:
     def set_time(self, t_ns: int):
         self._mm[0:8] = struct.pack("<q", t_ns)
 
+    def set_flags(self, v: int):
+        struct.pack_into("<I", self._mm, 12, v)
+
     # -- channel primitives (Python is the "shadow" side)
     def chan_state_at(self, off: int) -> int:
         return struct.unpack_from("<I", self._mm, off)[0]
@@ -248,10 +253,14 @@ class IpcBlock:
     def reply(self, kind: int, ret: int = 0):
         self.reply_slot(self.cur_slot, kind, ret)
 
-    def reply_slot(self, slot: int, kind: int, ret: int = 0):
+    def reply_slot(
+        self, slot: int, kind: int, ret: int = 0, num: int = 0,
+        args: tuple = (),
+    ):
         off = self._shim_off(slot)
+        a = list(args) + [0] * (6 - len(args))
         struct.pack_into(
-            "<ii q 6q q", self._mm, off + 8, kind, 0, 0, 0, 0, 0, 0, 0, 0,
+            "<ii q 6q q", self._mm, off + 8, kind, 0, num, *a,
             ctypes.c_int64(ret).value,
         )
         self.set_chan_state(off, CHAN_FULL, wake=True)
@@ -273,7 +282,8 @@ SYS = {
     "kill": 62, "tgkill": 234, "madvise": 28, "poll": 7, "ppoll": 271,
     "pipe2": 293, "dup": 32, "getuid": 102, "getgid": 104, "geteuid": 107,
     "getegid": 108, "getppid": 110, "clone": 56, "clone3": 435, "tkill": 200,
-    "fork": 57, "vfork": 58, "wait4": 61,
+    "fork": 57, "vfork": 58, "wait4": 61, "pause": 34, "getitimer": 36,
+    "alarm": 37, "setitimer": 38, "gettimeofday": 96, "time": 201,
     # sockets
     "socket": 41, "connect": 42, "accept": 43, "sendto": 44, "recvfrom": 45,
     "shutdown": 48, "bind": 49, "listen": 50, "getsockname": 51,
@@ -310,6 +320,13 @@ CLONE_PARENT_SETTID = 0x00100000
 CLONE_CHILD_CLEARTID = 0x00200000
 CLONE_CHILD_SETTID = 0x01000000
 
+# signals (emulated dispositions + syscall-boundary delivery; reference
+# host/syscall/handler/signal.rs + shim-side handler invocation)
+SIG_DFL, SIG_IGN = 0, 1
+SA_SIGINFO = 4
+SIGKILL, SIGALRM, SIGTERM, SIGCHLD, SIGSTOP = 9, 14, 15, 17, 19
+_SIG_DEFAULT_IGNORE = {17, 18, 23, 28}  # CHLD, CONT, URG, WINCH
+
 # futex ops (cmd = op & 0x7f)
 FUTEX_CMD_WAIT = 0
 FUTEX_CMD_WAKE = 1
@@ -337,7 +354,7 @@ class _Thread:
     __slots__ = (
         "slot", "state", "vtid", "rtid", "clone_flags", "ptid_addr",
         "ctid_addr", "wake", "poll_deadline", "pending_reply",
-        "blocked_num", "blocked_args", "parent_owed",
+        "blocked_num", "blocked_args", "parent_owed", "sig_stash",
     )
 
     def __init__(self, slot: int, vtid: int):
@@ -356,6 +373,8 @@ class _Thread:
         self.parent_owed = None  # (parent slot, ret) reply deferred until
         # this child checks in — serializes clone bootstraps (see
         # _finish_clone)
+        self.sig_stash = None  # work deferred while a handler runs:
+        # ("syscall", num, args) or ("reply", ret)
 
 # emulated sockets hand out fds in this range so the two fd spaces (the
 # child's real kernel fds vs the simulator's virtual sockets) can't collide
@@ -493,6 +512,7 @@ class NativeProcess:
         self.env = env or {}
         self.state = None  # mirrors host.process.ProcState via strings
         self.exit_code: int | None = None
+        self.term_signal: int | None = None  # set when a signal killed us
         self.stdout: list[bytes] = []
         self.stderr: list[bytes] = []
         self.ipc = IpcBlock(path=ipc_path)
@@ -512,8 +532,18 @@ class NativeProcess:
         self._cur: _Thread = self.threads[0]  # thread being serviced
         self._next_slot = 1
         self._free_slots: list[int] = []  # recycled after clean thread exit
+        # the shim has ONE in-flight CloneBoot: thread-clone handshakes are
+        # process-wide critical sections; concurrent requests queue here
+        self._clone_busy = False
+        self._clone_queue: list[tuple[_Thread, list[int]]] = []
         # emulated futex table: addr -> FIFO [(thread, bitset)]
         self._futexes: dict[int, list] = {}
+        # signals: emulated dispositions + pending queue (delivered at
+        # syscall boundaries under simulator control)
+        self._sigactions: dict[int, tuple[int, int]] = {}  # sig->(handler,flags)
+        self._sig_pending: list[tuple[int, int | None]] = []  # (sig, slot|None)
+        self._itimer_token = None
+        self._itimer_interval_ns = 0
         # fork bookkeeping
         self.parent: NativeProcess | None = None
         self.children: list[NativeProcess] = []
@@ -531,6 +561,9 @@ class NativeProcess:
         env["LD_PRELOAD"] = shim_path()
         env["SHADOW_SHM_PATH"] = self.ipc.path
         self.ipc.set_time(self.host.now())
+        hcfg = self.host.cfg
+        if hcfg.model_unblocked_latency:
+            self.ipc.set_flags((hcfg.unblocked_syscall_limit << 1) | 1)
         self._child = subprocess.Popen(
             self.argv, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -574,6 +607,7 @@ class NativeProcess:
 
     def kill(self):
         if self.state != "zombie":
+            self.term_signal = SIGKILL
             self._die(137)
 
     # ---- the service loop --------------------------------------------------
@@ -613,6 +647,7 @@ class NativeProcess:
                     pslot, ret = t.parent_owed
                     t.parent_owed = None
                     self.ipc.reply_slot(pslot, MSG_SYSCALL_COMPLETE, ret)
+                    self._clone_finished()
                 continue
             if kind == MSG_CLONE_DONE:
                 if args[2]:  # fork-style (shim's do_fork)
@@ -620,9 +655,33 @@ class NativeProcess:
                 else:
                     self._finish_clone(t, args)
                 continue
+            if kind == MSG_SIGNAL_DONE:
+                # a handler finished: deliver the next pending signal or
+                # resume the stashed work (the interrupted syscall / the
+                # blocked-syscall result)
+                if self._deliver_signal(t):
+                    continue
+                stash, t.sig_stash = t.sig_stash, None
+                if stash is None:
+                    continue
+                if stash[0] == "reply":
+                    self.ipc.reply_slot(t.slot, MSG_SYSCALL_COMPLETE, stash[1])
+                else:
+                    self._cur = t
+                    self.ipc.cur_slot = t.slot
+                    self._handle(stash[1], stash[2])
+                    if t.state != "running":
+                        self._runner = None
+                continue
             self.syscall_count += 1
             self.host.counters["syscalls"] += 1
             self._cur = t
+            # pending signals run their handlers BEFORE the syscall is
+            # serviced (syscall entry = the deterministic delivery point)
+            if self._sig_pending and t.sig_stash is None:
+                if self._deliver_signal(t):
+                    t.sig_stash = ("syscall", num, args)
+                    continue
             self._handle(num, args)
             if t.state != "running":
                 self._runner = None  # parked/dead: schedule someone else
@@ -641,6 +700,14 @@ class NativeProcess:
         self.ipc.set_time(self.host.now())
         if t.state == "start-ready":
             self.ipc.reply_slot(t.slot, MSG_START_OK)
+        elif (
+            self._sig_pending
+            and t.sig_stash is None
+            and self._deliver_signal(t)
+        ):
+            # run the handler before the interrupted syscall's result is
+            # returned (kernel ordering: handler first, then e.g. -EINTR)
+            t.sig_stash = ("reply", t.pending_reply)
         else:  # wake-ready
             self.ipc.reply_slot(t.slot, MSG_SYSCALL_COMPLETE, t.pending_reply)
         t.state = "running"
@@ -668,7 +735,9 @@ class NativeProcess:
         if tid < 0 or child is None:
             if child is not None and child.state == "starting":
                 del self.threads[slot]
+                self._free_slots.append(slot)
             self.ipc.reply_slot(parent.slot, MSG_SYSCALL_COMPLETE, tid)
+            self._clone_finished()
             return
         checked_in = child.state != "starting"  # THREAD_START already seen?
         child.rtid = tid if tid > 0 else child.rtid
@@ -688,6 +757,7 @@ class NativeProcess:
                 pass
         if checked_in:
             self.ipc.reply_slot(parent.slot, MSG_SYSCALL_COMPLETE, child.vtid)
+            self._clone_finished()
         else:
             # hold the parent until the child has claimed its bootstrap
             # (g_pending_boot) and checked in. This (a) closes the window
@@ -697,6 +767,83 @@ class NativeProcess:
             # the child not yet checked in, the loop could return with the
             # late MSG_THREAD_START unheard forever.
             child.parent_owed = (parent.slot, child.vtid)
+
+    # ---- signals -----------------------------------------------------------
+
+    def _deliver_signal(self, t: _Thread) -> bool:
+        """Send the next deliverable pending signal to thread t as a
+        MSG_RUN_SIGNAL; True if one was sent (caller stashes its work until
+        MSG_SIGNAL_DONE)."""
+        i = 0
+        while i < len(self._sig_pending):
+            sig, slot = self._sig_pending[i]
+            if slot is not None and slot != t.slot:
+                i += 1
+                continue
+            handler, flags = self._sigactions.get(sig, (SIG_DFL, 0))
+            self._sig_pending.pop(i)  # i now indexes the next entry
+            if handler in (SIG_DFL, SIG_IGN):
+                continue  # disposition changed since queueing: drop
+            self.ipc.reply_slot(
+                t.slot, MSG_RUN_SIGNAL, ret=0, num=sig,
+                args=(handler, 1 if flags & SA_SIGINFO else 0),
+            )
+            return True
+        return False
+
+    def _post_signal(self, sig: int, slot: int | None = None):
+        """Queue a signal for this process (or a specific thread), applying
+        dispositions (handler/ignore/default-terminate). Reference:
+        handler/signal.rs + process.rs signal delivery."""
+        if self.state != "running":
+            return
+        handler, _flags = self._sigactions.get(sig, (SIG_DFL, 0))
+        if sig in (SIGKILL, SIGSTOP) or (
+            handler == SIG_DFL and sig not in _SIG_DEFAULT_IGNORE
+        ):
+            self.term_signal = sig
+            self._die(128 + sig)  # default action: terminate
+            return
+        if handler == SIG_IGN or (
+            handler == SIG_DFL and sig in _SIG_DEFAULT_IGNORE
+        ):
+            return
+        self._sig_pending.append((sig, slot))
+        # interrupt one blocked thread so delivery is not postponed past
+        # an arbitrarily long emulated block (EINTR semantics)
+        for s in sorted(self.threads):
+            t = self.threads[s]
+            if t.state == "blocked" and (slot is None or slot == s):
+                self._remove_futex_waiter(t)
+                self._wake_thread(t, -errno.EINTR)
+                break
+
+    def _remove_futex_waiter(self, thr: _Thread):
+        for addr in list(self._futexes):
+            q = [(t, b) for t, b in self._futexes[addr] if t is not thr]
+            if q:
+                self._futexes[addr] = q
+            else:
+                del self._futexes[addr]
+
+    def _itimer_fire(self):
+        self._itimer_token = None
+        if self.state != "running":
+            return
+        if self._itimer_interval_ns > 0:
+            self._itimer_token = self.host.schedule(
+                self.host.now() + self._itimer_interval_ns, self._itimer_fire
+            )
+        self._post_signal(SIGALRM)
+
+    def _itimer_cancel(self) -> int:
+        """Cancel the REAL itimer; returns remaining ns (0 if unarmed)."""
+        if self._itimer_token is None:
+            return 0
+        remaining = max(0, self._itimer_token[0] - self.host.now())
+        self.host.cancel(self._itimer_token)
+        self._itimer_token = None
+        return remaining
 
     # ---- threads + futex ---------------------------------------------------
 
@@ -710,21 +857,46 @@ class NativeProcess:
             flags & CLONE_VFORK
         ):
             return self._handle_fork(num, args)
+        if self._clone_busy:
+            # another thread's clone bootstrap is in flight; the requester
+            # stays parked (no reply) until it completes — the shim's
+            # single g_pending_boot must never be overwritten early
+            self._cur.state = "blocked"
+            self._clone_queue.append((self._cur, list(args)))
+            return True
+        return self._start_thread_clone(self._cur, args)
+
+    def _start_thread_clone(self, thr: _Thread, args: list[int]) -> bool:
+        flags = args[0]
         if self._free_slots:
             slot = self._free_slots.pop(0)
         elif self._next_slot < IPC_MAX_THREADS:
             slot = self._next_slot
             self._next_slot += 1
         else:
-            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EAGAIN)
+            self.ipc.reply_slot(thr.slot, MSG_SYSCALL_COMPLETE, -errno.EAGAIN)
             return False
+        self._clone_busy = True
         child = _Thread(slot, self.pid * 1000 + slot)
         child.clone_flags = flags
         child.ptid_addr = args[2]
         child.ctid_addr = args[3]
         self.threads[slot] = child
-        self.ipc.reply(MSG_SYSCALL_COMPLETE, slot)
+        self.ipc.reply_slot(thr.slot, MSG_SYSCALL_COMPLETE, slot)
         return False
+
+    def _clone_finished(self):
+        """The in-flight clone completed (child checked in, or failed):
+        start the next queued one, if any."""
+        self._clone_busy = False
+        while self._clone_queue:
+            thr, args = self._clone_queue.pop(0)
+            if thr.state != "blocked" and thr.state != "running":
+                continue
+            thr.state = "running"
+            if self._start_thread_clone(thr, args):
+                continue  # re-queued (cannot happen: busy was False)
+            break
 
     def _handle_fork(self, num: int, args: list[int]) -> bool:
         """Create the fork child's IPC block + process object; the shim maps
@@ -740,6 +912,10 @@ class NativeProcess:
             ipc_path=self.ipc.path + f".f{fork_id}",
         )
         child.parent = self
+        if self.host.cfg.model_unblocked_latency:
+            child.ipc.set_flags(
+                (self.host.cfg.unblocked_syscall_limit << 1) | 1
+            )
         # fd table is inherited: same emulated objects, refcounted so a
         # close in one process does not tear the other's descriptor down
         child._vfds = dict(self._vfds)
@@ -796,7 +972,13 @@ class NativeProcess:
             if c.state == "zombie" and match(c):
                 self.children.remove(c)
                 if args[1]:
-                    status = (c.exit_code or 0) << 8  # WIFEXITED encoding
+                    # wait-status encoding: low 7 bits = killing signal
+                    # (WIFSIGNALED), else exit code << 8 (WIFEXITED)
+                    status = (
+                        c.term_signal & 0x7F
+                        if c.term_signal
+                        else (c.exit_code or 0) << 8
+                    )
                     _vm_write(cpid, args[1], struct.pack("<i", status))
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, c.pid)
                 return False
@@ -828,6 +1010,9 @@ class NativeProcess:
             if thr.state == "running":
                 self._runner = thr
                 self._kick_runner()
+        # SIGCHLD after wait retries: a parked wait4 must win the status,
+        # not be EINTR'd by its own child's death notification
+        self._post_signal(SIGCHLD)
 
     def _kick_runner(self):
         """Enter the service loop for an already-resumed runner if we are
@@ -847,6 +1032,7 @@ class NativeProcess:
         if cmd in (FUTEX_CMD_WAIT, FUTEX_CMD_WAIT_BITSET):
             try:
                 cur = struct.unpack("<I", _vm_read(cpid, addr, 4))[0]
+                raw = _vm_read(cpid, args[3], 16) if args[3] else b""
             except (OSError, struct.error):
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
                 return False
@@ -860,22 +1046,20 @@ class NativeProcess:
             ) or FUTEX_BITSET_ALL
             thr.state = "blocked"
             self._futexes.setdefault(addr, []).append((thr, bitset))
-            if args[3]:  # timespec pointer
-                raw = _vm_read(cpid, args[3], 16)
-                if len(raw) == 16:
-                    sec, nsec = struct.unpack("<qq", raw)
-                    t_ns = sec * NS_PER_SEC + nsec
-                    # WAIT: relative. WAIT_BITSET: absolute (sim clock).
-                    deadline = (
-                        max(t_ns, self.host.now())
-                        if cmd == FUTEX_CMD_WAIT_BITSET
-                        else self.host.now() + max(0, t_ns)
-                    )
-                    token = self.host.schedule(
-                        deadline,
-                        lambda: self._futex_timeout(addr, thr),
-                    )
-                    thr.wake.append((None, token))
+            if len(raw) == 16:
+                sec, nsec = struct.unpack("<qq", raw)
+                t_ns = sec * NS_PER_SEC + nsec
+                # WAIT: relative. WAIT_BITSET: absolute (sim clock).
+                deadline = (
+                    max(t_ns, self.host.now())
+                    if cmd == FUTEX_CMD_WAIT_BITSET
+                    else self.host.now() + max(0, t_ns)
+                )
+                token = self.host.schedule(
+                    deadline,
+                    lambda: self._futex_timeout(addr, thr),
+                )
+                thr.wake.append((None, token))
             return True
 
         if cmd in (FUTEX_CMD_WAKE, FUTEX_CMD_WAKE_BITSET):
@@ -1250,12 +1434,118 @@ class NativeProcess:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 8)
             return False
         if num == SYS["rt_sigaction"]:
-            # guard the shim's SIGSYS handler (shim_seccomp.c keeps SIGSYS)
+            # emulated dispositions (handler/signal.rs); the shim's SIGSYS
+            # handler is guarded — the app may not replace it
             SIGSYS = 31
-            if args[0] == SIGSYS:
+            sig = args[0]
+            if sig == SIGSYS:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # pretend success
+                return False
+            if args[2]:  # oldact out-param
+                oh, of = self._sigactions.get(sig, (SIG_DFL, 0))
+                try:
+                    _vm_write(cpid, args[2], struct.pack("<qqqq", oh, of, 0, 0))
+                except OSError:
+                    pass
+            if args[1]:  # new act: kernel struct {handler,flags,restorer,mask}
+                raw = _vm_read(cpid, args[1], 32)
+                if len(raw) < 16:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+                handler, flags = struct.unpack_from("<qq", raw)
+                self._sigactions[sig] = (handler, flags)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num in (SYS["kill"], SYS["tkill"], SYS["tgkill"]):
+            if num == SYS["kill"]:
+                tpid, sig, tslot = args[0], args[1], None
+            elif num == SYS["tkill"]:
+                tpid, sig = None, args[1]
+                tslot = args[0]
+            else:  # tgkill(tgid, tid, sig)
+                tpid, sig = args[0], args[2]
+                tslot = args[1]
+            if tslot is not None:
+                # vtid -> (process, slot): main thread vtid == pid
+                vtid = tslot
+                owner = None
+                for pr in self.host.processes.values():
+                    if not isinstance(pr, NativeProcess):
+                        continue
+                    if vtid == pr.pid:
+                        owner, tslot = pr, 0
+                        break
+                    if any(t.vtid == vtid for t in pr.threads.values()):
+                        owner = pr
+                        tslot = next(
+                            s for s, t in pr.threads.items() if t.vtid == vtid
+                        )
+                        break
+                target = owner
             else:
-                self.ipc.reply(MSG_SYSCALL_NATIVE)
+                target = (
+                    self
+                    if tpid in (self.pid, 0)
+                    else self.host.processes.get(tpid)
+                )
+            if not isinstance(target, NativeProcess) or target.state != "running":
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ESRCH)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            if sig != 0:
+                target._post_signal(sig, tslot)
+            return False
+        if num == SYS["pause"]:
+            thr = self._cur
+            thr.state = "blocked"  # until a signal wakes it (-EINTR)
+            return True
+        if num == SYS["alarm"]:
+            prev_ns = self._itimer_cancel()
+            self._itimer_interval_ns = 0
+            if args[0] > 0:
+                self._itimer_token = self.host.schedule(
+                    self.host.now() + args[0] * NS_PER_SEC, self._itimer_fire
+                )
+            self.ipc.reply(
+                MSG_SYSCALL_COMPLETE, (prev_ns + NS_PER_SEC - 1) // NS_PER_SEC
+            )
+            return False
+        if num in (SYS["setitimer"], SYS["getitimer"]):
+            ITIMER_REAL = 0
+            if args[0] != ITIMER_REAL:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EINVAL)
+                return False
+            old_ptr = args[2] if num == SYS["setitimer"] else args[1]
+            if old_ptr:
+                rem = (
+                    max(0, self._itimer_token[0] - self.host.now())
+                    if self._itimer_token is not None
+                    else 0
+                )
+                iv = self._itimer_interval_ns
+                try:
+                    _vm_write(cpid, old_ptr, struct.pack(
+                        "<qqqq", iv // NS_PER_SEC, (iv % NS_PER_SEC) // 1000,
+                        rem // NS_PER_SEC, (rem % NS_PER_SEC) // 1000,
+                    ))
+                except OSError:
+                    pass
+            if num == SYS["setitimer"] and args[1]:
+                raw = _vm_read(cpid, args[1], 32)
+                if len(raw) < 32:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+                iv_s, iv_us, val_s, val_us = struct.unpack("<qqqq", raw)
+                self._itimer_cancel()
+                self._itimer_interval_ns = iv_s * NS_PER_SEC + iv_us * 1000
+                val_ns = val_s * NS_PER_SEC + val_us * 1000
+                if val_ns > 0:
+                    self._itimer_token = self.host.schedule(
+                        self.host.now() + val_ns, self._itimer_fire
+                    )
+                else:
+                    self._itimer_interval_ns = 0
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
         if num == SYS["exit"] and any(
             t is not self._cur and t.state != "dead"
@@ -1300,6 +1590,48 @@ class NativeProcess:
             return True
         if num in (SYS["poll"], SYS["ppoll"]):
             return self._handle_poll(num, args)
+        if num in (SYS["clock_gettime"], SYS["gettimeofday"], SYS["time"]):
+            # the shim answers these locally; one in every
+            # `unblocked_syscall_limit` calls escapes here when the
+            # unblocked-latency model is on — charge the latency by parking
+            # the thread, then answer with the ADVANCED clock so
+            # spin-on-clock binaries make simulated progress
+            # (reference handler/mod.rs:268-318)
+            thr = self._cur
+            thr.state = "blocked"
+            wake_at = (
+                self.host.now() + self.host.cfg.unblocked_syscall_latency_ns
+            )
+            saved = list(args)
+
+            def finish(thr=thr, num=num, args=saved):
+                if self.state != "running" or thr.state != "blocked":
+                    return
+                self._clear_wake(thr)
+                now = self.host.now()
+                ret = 0
+                try:
+                    if num == SYS["clock_gettime"] and args[1]:
+                        _vm_write(self._child.pid, args[1], struct.pack(
+                            "<qq", now // NS_PER_SEC, now % NS_PER_SEC))
+                    elif num == SYS["gettimeofday"] and args[0]:
+                        _vm_write(self._child.pid, args[0], struct.pack(
+                            "<qq", now // NS_PER_SEC,
+                            (now % NS_PER_SEC) // 1000))
+                    elif num == SYS["time"]:
+                        ret = now // NS_PER_SEC
+                        if args[0]:
+                            _vm_write(self._child.pid, args[0],
+                                      struct.pack("<q", ret))
+                except OSError:
+                    ret = -errno.EFAULT
+                thr.state = "wake-ready"
+                thr.pending_reply = ret
+                self._kick()
+
+            token = self.host.schedule(wake_at, finish)
+            thr.wake.append((None, token))
+            return True
 
         # default: refuse with ENOSYS (surface unknown syscalls loudly)
         self.ipc.reply(MSG_SYSCALL_COMPLETE, -38)

@@ -185,3 +185,54 @@ def test_thread_slot_recycling():
     out = b"".join(p.stdout).decode()
     assert p.exit_code == 0, out + b"".join(p.stderr).decode()
     assert "churn done counter=40 t=40ms" in out
+
+
+TEST_SIGNAL = os.path.join(REPO, "native", "build", "test_signal")
+
+
+def test_signals_kill_itimer_pause():
+    """Cross-process kill -> handler at syscall boundary + EINTR'd
+    nanosleep; periodic ITIMER_REAL against pause(); SIGTERM default
+    action terminates a child (reference src/test/signal, src/test/itimer)."""
+    _, p = run_one([TEST_SIGNAL], until=10 * SEC)
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert "parent: usr1=1 sleep_interrupted=1 t=20ms" in out
+    assert "parent: alrm=5 t=70ms" in out
+    assert "parent: child_reaped=1 t=70ms" in out
+
+
+def test_signals_two_runs_identical():
+    a = run_one([TEST_SIGNAL], until=10 * SEC)[1]
+    b = run_one([TEST_SIGNAL], until=10 * SEC)[1]
+    assert p_out(a) == p_out(b)
+
+
+TEST_BUSYCLOCK = os.path.join(REPO, "native", "build", "test_busyclock")
+
+
+def test_unblocked_syscall_latency_model():
+    """A spin-on-clock binary makes simulated progress when the
+    unblocked-syscall latency model is on (reference
+    handler/mod.rs:268-318): every Nth locally-answered time call escapes
+    to the simulator and is charged latency."""
+    h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=4, host_id=0,
+                           model_unblocked_latency=True))
+    p = spawn_native(h, [TEST_BUSYCLOCK], start_time=0)
+    h.execute(5 * SEC)
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert "busyclock done spins=5119999" in out  # deterministic count
+
+
+TEST_NEST = os.path.join(REPO, "native", "build", "test_thread_nest")
+
+
+def test_nested_concurrent_thread_creation():
+    """Workers spawning sub-workers: clone handshakes from different
+    threads must serialize through the single in-flight bootstrap."""
+    for _ in range(3):  # race-sensitive: a few repeats
+        _, p = run_one([TEST_NEST], until=5 * SEC)
+        out = b"".join(p.stdout).decode()
+        assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+        assert "nest done total=12" in out
