@@ -41,6 +41,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
+#include <sys/personality.h>
 #include <sys/prctl.h>
 #include <sys/syscall.h>
 #include <sys/ucontext.h>
@@ -536,6 +537,179 @@ extern "C" time_t time(time_t *tloc) {
     return secs;
 }
 
+/* ----------------------------------------------------------------- rdtsc
+ *
+ * The time-syscall interposition above cannot see `rdtsc`/`rdtscp` — they
+ * read the cycle counter in userspace, leaking wall time into the
+ * simulation. prctl(PR_SET_TSC, PR_TSC_SIGSEGV) makes them fault; the
+ * SIGSEGV handler decodes the instruction and synthesizes a deterministic
+ * counter from the simulated clock at a nominal 1 GHz (1 tick = 1 ns).
+ * Reference: src/lib/shim/shim_rdtsc.c + src/lib/tsc. */
+
+extern "C" void shadow_shim_handle_sigsegv(int sig, siginfo_t *info,
+                                           void *ucontext) {
+    (void)sig;
+    (void)info;
+    ucontext_t *uc = (ucontext_t *)ucontext;
+    greg_t *regs = uc->uc_mcontext.gregs;
+    const unsigned char *ip = (const unsigned char *)regs[REG_RIP];
+    if (ip && ip[0] == 0x0f && ip[1] == 0x31) { /* rdtsc */
+        uint64_t tsc = (uint64_t)sim_now();
+        regs[REG_RAX] = (greg_t)(tsc & 0xffffffffu);
+        regs[REG_RDX] = (greg_t)(tsc >> 32);
+        regs[REG_RIP] += 2;
+        return;
+    }
+    if (ip && ip[0] == 0x0f && ip[1] == 0x01 && ip[2] == 0xf9) { /* rdtscp */
+        uint64_t tsc = (uint64_t)sim_now();
+        regs[REG_RAX] = (greg_t)(tsc & 0xffffffffu);
+        regs[REG_RDX] = (greg_t)(tsc >> 32);
+        regs[REG_RCX] = 0; /* IA32_TSC_AUX: cpu 0 */
+        regs[REG_RIP] += 3;
+        return;
+    }
+    /* genuine fault: restore the default disposition VIA THE TRAMPOLINE
+     * (libc sigaction would be seccomp-trapped and answered by the
+     * emulated rt_sigaction, which never changes the kernel state — the
+     * faulting instruction would re-enter this handler forever) and
+     * return, so the re-fault crashes for real */
+    struct {
+        uint64_t handler, flags, restorer, mask;
+    } kact = {0, 0, 0, 0};
+    g_raw(SYS_rt_sigaction, SIGSEGV, (long)&kact, 0, 8, 0, 0);
+}
+
+/* ------------------------------------------------------------ vdso patch
+ *
+ * The vDSO computes clock_gettime from a live rdtsc against vvar's
+ * real-TSC calibration — both a wall-time leak (for callers that bypass
+ * our interposed PLT symbols, e.g. glibc-internal __clock_gettime) and,
+ * once PR_SET_TSC synthesizes sim-time TSC values, a source of garbage
+ * timestamps. Overwrite every vDSO entry point with `mov eax, <nr>;
+ * syscall; ret` so they become real (seccomp-trapped, emulated) syscalls.
+ * Reference: src/lib/shim/patch_vdso.c. */
+
+#include <elf.h>
+#include <sys/auxv.h>
+
+static int patch_vdso(void) {
+    unsigned long base = getauxval(AT_SYSINFO_EHDR);
+    if (!base)
+        return -1;
+    const Elf64_Ehdr *eh = (const Elf64_Ehdr *)base;
+    const Elf64_Phdr *ph = (const Elf64_Phdr *)(base + eh->e_phoff);
+    const Elf64_Dyn *dyn = nullptr;
+    unsigned long size = 0;
+    for (int i = 0; i < eh->e_phnum; i++) {
+        if (ph[i].p_type == PT_DYNAMIC)
+            dyn = (const Elf64_Dyn *)(base + ph[i].p_offset);
+        if (ph[i].p_type == PT_LOAD && ph[i].p_vaddr + ph[i].p_memsz > size)
+            size = ph[i].p_vaddr + ph[i].p_memsz;
+    }
+    if (!dyn || !size)
+        return -1;
+    /* vDSO dynamic pointers may be link-time (unrelocated) addresses */
+    auto fix = [base, size](unsigned long p) -> unsigned long {
+        return (p < size) ? base + p : p;
+    };
+    const Elf64_Sym *symtab = nullptr;
+    const char *strtab = nullptr;
+    const uint32_t *hash = nullptr;
+    for (const Elf64_Dyn *d = dyn; d->d_tag != DT_NULL; d++) {
+        if (d->d_tag == DT_SYMTAB)
+            symtab = (const Elf64_Sym *)fix(d->d_un.d_ptr);
+        else if (d->d_tag == DT_STRTAB)
+            strtab = (const char *)fix(d->d_un.d_ptr);
+        else if (d->d_tag == DT_HASH)
+            hash = (const uint32_t *)fix(d->d_un.d_ptr);
+    }
+    if (!symtab || !strtab || !hash)
+        return -1;
+    uint32_t nsyms = hash[1]; /* nchain */
+
+    unsigned long pagesz = 4096;
+    unsigned long start = base & ~(pagesz - 1);
+    unsigned long len = ((base + size + pagesz - 1) & ~(pagesz - 1)) - start;
+    if (mprotect((void *)start, len, PROT_READ | PROT_WRITE | PROT_EXEC))
+        return -1;
+
+    static const struct {
+        const char *name;
+        int nr;
+    } targets[] = {
+        {"__vdso_clock_gettime", SYS_clock_gettime},
+        {"clock_gettime", SYS_clock_gettime},
+        {"__vdso_gettimeofday", SYS_gettimeofday},
+        {"gettimeofday", SYS_gettimeofday},
+        {"__vdso_time", SYS_time},
+        {"time", SYS_time},
+        {"__vdso_clock_getres", SYS_clock_getres},
+        {"clock_getres", SYS_clock_getres},
+        {"__vdso_getcpu", SYS_getcpu},
+        {"getcpu", SYS_getcpu},
+    };
+    for (uint32_t i = 0; i < nsyms && i < 4096; i++) {
+        const char *nm = strtab + symtab[i].st_name;
+        if (!symtab[i].st_value)
+            continue;
+        for (const auto &t : targets) {
+            if (strcmp(nm, t.name) != 0)
+                continue;
+            unsigned char *fn =
+                (unsigned char *)(symtab[i].st_value < size
+                                      ? base + symtab[i].st_value
+                                      : symtab[i].st_value);
+            fn[0] = 0xb8; /* mov eax, imm32 */
+            memcpy(fn + 1, &t.nr, 4);
+            fn[5] = 0x0f; /* syscall */
+            fn[6] = 0x05;
+            fn[7] = 0xc3; /* ret */
+            break;
+        }
+    }
+    mprotect((void *)start, len, PROT_READ | PROT_EXEC);
+    return 0;
+}
+
+/* ---------------------------------------------- OpenSSL RNG determinism
+ *
+ * Any TLS-using binary pulls entropy through OpenSSL's RAND_*; routing it
+ * to the (seccomp-trapped, simulator-seeded) getrandom syscall keeps two
+ * runs bit-identical. LD_PRELOAD makes these definitions win over
+ * libcrypto's. Reference: src/lib/preload-openssl. */
+
+static int shadow_rand_fill(unsigned char *buf, int n) {
+    int off = 0;
+    while (off < n) {
+        long got = syscall(SYS_getrandom, buf + off, (long)(n - off), 0);
+        if (got <= 0)
+            return 0;
+        off += (int)got;
+    }
+    return 1;
+}
+
+extern "C" int RAND_bytes(unsigned char *buf, int n) {
+    return shadow_rand_fill(buf, n);
+}
+extern "C" int RAND_priv_bytes(unsigned char *buf, int n) {
+    return shadow_rand_fill(buf, n);
+}
+extern "C" int RAND_pseudo_bytes(unsigned char *buf, int n) {
+    return shadow_rand_fill(buf, n);
+}
+extern "C" int RAND_status(void) { return 1; }
+extern "C" int RAND_poll(void) { return 1; }
+extern "C" void RAND_seed(const void *buf, int num) {
+    (void)buf;
+    (void)num;
+}
+extern "C" void RAND_add(const void *buf, int num, double entropy) {
+    (void)buf;
+    (void)num;
+    (void)entropy;
+}
+
 /* -------------------------------------------------------------- seccomp */
 
 static int install_seccomp(void) {
@@ -578,6 +752,43 @@ __attribute__((constructor)) static void shadow_shim_init(void) {
     const char *path = getenv("SHADOW_SHM_PATH");
     if (!path)
         return; /* not under the simulator: run natively */
+
+    /* ADDR_NO_RANDOMIZE (reference shadow.rs:428-429): if this image was
+     * laid out with ASLR, flip the personality and re-exec once so every
+     * mapping is at its fixed address. The flag survives exec, so the
+     * second pass falls through. */
+#ifndef ADDR_NO_RANDOMIZE
+#define ADDR_NO_RANDOMIZE 0x0040000
+#endif
+    int pers = personality(0xffffffff);
+    if (pers >= 0 && !(pers & ADDR_NO_RANDOMIZE)) {
+        personality(pers | ADDR_NO_RANDOMIZE);
+        static char cmdbuf[16384];
+        int cfd = open("/proc/self/cmdline", O_RDONLY);
+        if (cfd >= 0) {
+            ssize_t n = read(cfd, cmdbuf, sizeof(cmdbuf));
+            close(cfd);
+            /* only re-exec with a FULL argv: a truncated command line
+             * (n == bufsize, or more args than the table) must not be
+             * silently re-run with different arguments */
+            if (n > 0 && n < (ssize_t)sizeof(cmdbuf) &&
+                cmdbuf[n - 1] == 0) {
+                static char *cargv[512];
+                int argc = 0;
+                char *p = cmdbuf;
+                while (p < cmdbuf + n && argc < 511) {
+                    cargv[argc++] = p;
+                    p += strlen(p) + 1;
+                }
+                if (p >= cmdbuf + n) { /* consumed every argument */
+                    cargv[argc] = nullptr;
+                    execv("/proc/self/exe", cargv);
+                }
+            }
+        }
+        /* exec failed or argv too large: continue with ASLR (best effort) */
+    }
+
     size_t plen = strlen(path);
     if (plen >= sizeof(g_shm_base) - 8)
         _exit(90);
@@ -601,6 +812,26 @@ __attribute__((constructor)) static void shadow_shim_init(void) {
     sigemptyset(&sa.sa_mask);
     if (sigaction(SIGSYS, &sa, nullptr))
         _exit(94);
+
+    /* rdtsc interposition: trap the instruction, emulate from sim time.
+     * Only armed when the vDSO was successfully rewritten to real
+     * syscalls — otherwise the vDSO's own rdtsc-based clock math would
+     * compute garbage from the synthesized counter. */
+    struct sigaction sv;
+    memset(&sv, 0, sizeof sv);
+    sv.sa_sigaction = shadow_shim_handle_sigsegv;
+    sv.sa_flags = SA_SIGINFO | SA_NODEFER;
+    sigemptyset(&sv.sa_mask);
+    if (sigaction(SIGSEGV, &sv, nullptr))
+        _exit(94);
+#ifndef PR_SET_TSC
+#define PR_SET_TSC 26
+#endif
+#ifndef PR_TSC_SIGSEGV
+#define PR_TSC_SIGSEGV 2
+#endif
+    if (patch_vdso() == 0)
+        prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
 
     /* StartReq/StartRes handshake (managed_thread.rs:135-243) */
     ShimMsg start, resp;

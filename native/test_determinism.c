@@ -1,0 +1,47 @@
+/* Determinism hardening workout: rdtsc/rdtscp emulated from sim time,
+ * /dev/urandom virtualized onto the seeded host RNG, getrandom emulated,
+ * and ASLR disabled (stable addresses). Two runs must be byte-identical.
+ * (Reference: shim_rdtsc.c, preload-openssl, shadow.rs ASLR disable.) */
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <time.h>
+#include <unistd.h>
+#include <sys/random.h>
+#include <sys/syscall.h>
+
+static inline uint64_t rdtsc(void) {
+    uint32_t lo, hi;
+    __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+    return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtscp_(void) {
+    uint32_t lo, hi, aux;
+    __asm__ __volatile__("rdtscp" : "=a"(lo), "=d"(hi), "=c"(aux));
+    return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+    uint64_t t0 = rdtsc();
+    struct timespec d = {0, 7 * 1000 * 1000}; /* 7 ms */
+    nanosleep(&d, NULL);
+    uint64_t t1 = rdtscp_();
+    /* 1 tick = 1 ns: the sleep must read as exactly 7e6 ticks */
+    printf("tsc start=%lu delta=%lu\n", t0, t1 - t0);
+
+    unsigned char buf[8];
+    int fd = open("/dev/urandom", O_RDONLY);
+    ssize_t n = read(fd, buf, sizeof buf);
+    close(fd);
+    printf("urandom n=%zd bytes=%02x%02x%02x%02x%02x%02x%02x%02x\n", n,
+           buf[0], buf[1], buf[2], buf[3], buf[4], buf[5], buf[6], buf[7]);
+
+    getrandom(buf, sizeof buf, 0);
+    printf("getrandom bytes=%02x%02x%02x%02x%02x%02x%02x%02x\n", buf[0],
+           buf[1], buf[2], buf[3], buf[4], buf[5], buf[6], buf[7]);
+
+    int stack_probe = 0;
+    printf("stackaddr=%p\n", (void *)&stack_probe);
+    return 0;
+}

@@ -135,7 +135,7 @@ _ARTIFACTS = (
     "libshadow_shim.so", "test_app", "test_busy", "test_udp_echo",
     "test_udp_client", "test_tcp_stream", "test_epoll_server",
     "test_filewrite", "test_sockaddr_len", "test_writev_sock",
-    "test_threads", "test_fork", "test_thread_churn", "test_signal", "test_busyclock", "test_thread_nest",
+    "test_threads", "test_fork", "test_thread_churn", "test_signal", "test_busyclock", "test_thread_nest", "test_determinism",
 )
 
 
@@ -284,6 +284,7 @@ SYS = {
     "getegid": 108, "getppid": 110, "clone": 56, "clone3": 435, "tkill": 200,
     "fork": 57, "vfork": 58, "wait4": 61, "pause": 34, "getitimer": 36,
     "alarm": 37, "setitimer": 38, "gettimeofday": 96, "time": 201,
+    "getcpu": 309,
     # sockets
     "socket": 41, "connect": 42, "accept": 43, "sendto": 44, "recvfrom": 45,
     "shutdown": 48, "bind": 49, "listen": 50, "getsockname": 51,
@@ -461,6 +462,33 @@ _EPOLL_SYSCALLS = {
 }
 
 
+class _RandomFile:
+    """Deterministic /dev/urandom|/dev/random stand-in (the reference
+    virtualizes these through its file layer; preload-openssl covers the
+    library path). Always readable; bytes come from the host's seeded RNG."""
+
+    def __init__(self, host):
+        self._host = host
+
+    def read(self, n: int) -> bytes:
+        return self._host.rng.randbytes(min(n, 1 << 16))
+
+    def close(self):
+        pass
+
+    @property
+    def state(self):
+        from shadow_tpu.host.filestate import FileState
+
+        return FileState.READABLE
+
+    def add_listener(self, lst):
+        pass
+
+    def remove_listener(self, lst):
+        pass
+
+
 class _Adopted:
     """Popen-shaped wrapper for a fork child we did not spawn (it is our
     grandchild, so waitpid is unavailable: liveness comes from /proc and
@@ -564,6 +592,10 @@ class NativeProcess:
         hcfg = self.host.cfg
         if hcfg.model_unblocked_latency:
             self.ipc.set_flags((hcfg.unblocked_syscall_limit << 1) | 1)
+        # ASLR is disabled by the shim itself (personality + one self
+        # re-exec in its constructor): a preexec_fn here would force
+        # subprocess off posix_spawn onto os.fork, which is deadlock-prone
+        # under JAX's threads.
         self._child = subprocess.Popen(
             self.argv, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -1260,6 +1292,23 @@ class NativeProcess:
                 # F_DUPFD etc: unsupported on emulated sockets — fail loudly
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
             return False
+        if num == SYS["openat"]:
+            # virtualize the entropy devices (determinism: a passthrough
+            # open would read real kernel entropy); everything else passes
+            # through per the regular-file policy
+            try:
+                raw = _vm_read(cpid, args[1], 256)
+                pathname = raw.split(b"\0", 1)[0]
+            except OSError:
+                pathname = b""
+            if pathname in (b"/dev/urandom", b"/dev/random"):
+                vfd = self._next_vfd
+                self._next_vfd += 1
+                self._vfds[vfd] = _RandomFile(self.host)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
+                return False
+            self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
         if num in _NATIVE_OK:
             self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
@@ -1401,8 +1450,7 @@ class NativeProcess:
 
         if num == SYS["getrandom"]:
             n = min(args[1], 1 << 20)
-            data = bytes(self.host.rng.getrandbits(8) for _ in range(n))
-            _vm_write(cpid, args[0], data)
+            _vm_write(cpid, args[0], self.host.rng.randbytes(n))
             self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
             return False
 
@@ -1425,6 +1473,18 @@ class NativeProcess:
         if num == SYS["futex"]:
             return self._handle_futex(args)
         if num == SYS["sched_yield"]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SYS["getcpu"]:
+            # deterministic single-cpu host (vdso getcpu is patched to the
+            # real syscall, which lands here)
+            try:
+                if args[0]:
+                    _vm_write(cpid, args[0], struct.pack("<I", 0))
+                if args[1]:
+                    _vm_write(cpid, args[1], struct.pack("<I", 0))
+            except OSError:
+                pass
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
         if num == SYS["sched_getaffinity"]:
