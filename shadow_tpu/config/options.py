@@ -128,7 +128,11 @@ class ExperimentalOptions:
     scheduler: str = "tpu"  # "tpu" | "cpu-reference" (pure-numpy oracle)
     runahead: int = parse_time_ns("1 ms")  # floor (reference default 1ms, runahead.rs)
     use_dynamic_runahead: bool = False
-    interface_qdisc: str = "fifo"  # "fifo" | "round-robin" (QDiscMode, configuration.rs:960)
+    # "fifo" | "round-robin" (QDiscMode, configuration.rs:960): the order a
+    # managed host's same-window sends enter the network — emit order vs
+    # one-per-socket interleave (acts in the co-sim staging; device models
+    # have no socket structure to interleave)
+    interface_qdisc: str = "fifo"
     use_codel: bool = True
     # strace-style per-process syscall logs: "off" | "standard" |
     # "deterministic" (StraceLoggingMode, configuration.rs:1162;

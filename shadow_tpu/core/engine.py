@@ -149,6 +149,7 @@ class EngineParams(NamedTuple):
     node_of: Array  # i32[H_total] host -> graph node (replicated)
     lat_ns: Array  # i64[N, N] path latency; <0 = unreachable (replicated)
     loss: Array  # f32[N, N] path loss probability (replicated)
+    jitter_ns: Array  # i64[N, N] path jitter amplitude (replicated)
     eg_tb: TBParams  # uplink buckets (sharded per host)
     in_tb: TBParams  # downlink buckets (sharded per host)
     model: Any  # model param pytree (sharded per host)
@@ -177,6 +178,10 @@ class EngineConfig:
     # identical results whenever queues never overflow (see
     # ops/merge.py merge_flat_events). Opt-in for sized workloads.
     cheap_shed: bool = False
+    # Per-packet latency jitter (graph edges carry a `jitter` amplitude):
+    # statically elided when no edge has jitter so jitter-free sims draw no
+    # extra RNG (digest stability).
+    use_jitter: bool = False
     # CPU model (reference host/cpu.rs + host.rs:820-847): every handled
     # event charges `cpu_delay_ns` of simulated CPU time; events that pop
     # while the host CPU is still busy are deferred to busy_until instead of
@@ -368,6 +373,24 @@ class Engine:
             )
         self.run_chunk = jax.jit(chunk, donate_argnums=0)
 
+    def build_capture_step(self):
+        """Jitted single round returning (state, sent-outbox) for pcap
+        synthesis; built on demand (capture trades speed for observability)."""
+        axis = AXIS if self.mesh is not None else None
+        step = functools.partial(_round_step_capture, self.cfg, self.model, axis)
+        if self.mesh is not None:
+            state_spec = self.state_specs()
+            sh = P(AXIS)
+            ob_spec = Outbox(dst=sh, t=sh, order=sh, kind=sh, payload=sh, count=sh)
+            step = jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(state_spec, self.param_specs()),
+                out_specs=(state_spec, ob_spec),
+                check_vma=False,
+            )
+        return jax.jit(step)
+
     # ---- sharding specs ----------------------------------------------------
 
     def _model_specs(self, tree):
@@ -411,6 +434,7 @@ class Engine:
             node_of=rep,
             lat_ns=rep,
             loss=rep,
+            jitter_ns=rep,
             eg_tb=TBParams(capacity=sh, refill=sh),
             in_tb=TBParams(capacity=sh, refill=sh),
             model=self._model_param_spec_tree,
@@ -523,8 +547,8 @@ def _run_guarded_chunk(
     return state
 
 
-def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EngineParams):
-    # ---- 1-2: barrier + window (controller.rs:88-112)
+def _compute_window(cfg: EngineConfig, axis, st: SimState):
+    """Barrier + window (controller.rs:88-112): (window_end, done)."""
     lmin = jnp.min(_effective_next(cfg, st))
     gmin = _pmin(lmin, axis)
     done = gmin >= cfg.stop_time  # TIME_MAX (empty everywhere) implies done
@@ -535,12 +559,30 @@ def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EnginePara
         else jnp.asarray(max(cfg.runahead_floor, cfg.static_min_latency), jnp.int64)
     )
     window_end = jnp.minimum(gmin_safe + jnp.maximum(runahead, 1), cfg.stop_time)
+    return window_end, done
+
+
+def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EngineParams):
+    window_end, done = _compute_window(cfg, axis, st)
     return _window_step(cfg, model, axis, st, params, window_end, done)
+
+
+def _round_step_capture(
+    cfg: EngineConfig, model, axis, st: SimState, params: EngineParams
+):
+    """One round that ALSO returns the pre-exchange outbox — the packets
+    sent this round, for host-side pcap synthesis (the modeled-sim analogue
+    of the reference's per-interface capture, network_interface.c). One
+    dispatch per round: capture runs trade throughput for observability."""
+    window_end, done = _compute_window(cfg, axis, st)
+    return _window_step(
+        cfg, model, axis, st, params, window_end, done, capture=True
+    )
 
 
 def _window_step(
     cfg: EngineConfig, model, axis, st: SimState, params: EngineParams,
-    window_end, done,
+    window_end, done, capture: bool = False,
 ):
     """Execute one scheduling window [*, window_end): microsteps + exchange.
 
@@ -576,12 +618,15 @@ def _window_step(
         microsteps=st_x.stats.microsteps + steps[None],
     )
     min_used = _pmin(st_x.min_used_lat, axis)
-    return st_x._replace(
+    out = st_x._replace(
         now=jnp.where(done, st.now, window_end),
         done=done,
         min_used_lat=min_used,
         stats=stats,
     )
+    if capture:
+        return out, st_m.outbox  # this round's sends, pre-exchange
+    return out
 
 
 def _effective_next(cfg: EngineConfig, st: SimState):
@@ -741,11 +786,22 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             # are a measured per-microstep hot spot on TPU
             lat = jnp.broadcast_to(params.lat_ns[0, 0], dst.shape)
             lossp = jnp.broadcast_to(params.loss[0, 0], dst.shape)
+            jit = jnp.broadcast_to(params.jitter_ns[0, 0], dst.shape)
         else:
             src_node = params.node_of[host_gid]
             dst_node = params.node_of[dst]
             lat = params.lat_ns[src_node, dst_node]
             lossp = params.loss[src_node, dst_node]
+            jit = params.jitter_ns[src_node, dst_node]
+        lat_bound = lat  # pre-jitter: the conservative lookahead quantity
+        if cfg.use_jitter:
+            # uniform in [lat - j, lat + j] (deterministic per-host lane
+            # draw); the lookahead bound uses lat - j
+            rng, uj = rng_uniform(rng, mask)
+            lat = lat + ((uj * 2.0 - 1.0) * jit.astype(jnp.float32)).astype(
+                jnp.int64
+            )
+            lat_bound = lat_bound - jit
         # a model emitting an out-of-range dst is a bug: surface it as
         # unreachable rather than silently delivering to a clamped host
         unreachable = mask & ((lat < 0) | bad_dst)
@@ -771,7 +827,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             payload,
         )
         ob_lost = ob_lost + n_lost
-        used_lat = jnp.where(send_ok, lat, TIME_MAX)
+        used_lat = jnp.where(send_ok, lat_bound, TIME_MAX)
         st = st._replace(
             min_used_lat=jnp.minimum(st.min_used_lat, jnp.min(used_lat))
         )

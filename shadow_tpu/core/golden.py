@@ -167,6 +167,7 @@ def run_golden(
     node_of = np.asarray(params.node_of)
     lat_ns = np.asarray(params.lat_ns)
     loss = np.asarray(params.loss)
+    jitter_ns = np.asarray(params.jitter_ns)
     eg = [
         _TokenBucket(c, r, cfg.tb_interval_ns)
         for c, r in zip(np.asarray(params.eg_tb.capacity), np.asarray(params.eg_tb.refill))
@@ -216,9 +217,19 @@ def run_golden(
     microsteps = 0
     limit = cfg.effective_microstep_limit
     r_cap = min(cfg.max_round_inserts, cfg.queue_capacity)
+    # CPU model (engine.py _effective_next/_microstep): events execute at
+    # max(t, busy_until); each handled event charges cpu_delay_ns
+    busy = [0] * h
+    delay_ns = cfg.cpu_delay_ns
+
+    def eff_next(i: int) -> int:
+        if not heaps[i]:
+            return TIME_MAX
+        t = heaps[i][0][0]
+        return max(t, busy[i]) if delay_ns > 0 else t
 
     while True:
-        gmin = min((q[0][0] for q in heaps if q), default=TIME_MAX)
+        gmin = min((eff_next(i) for i in range(h)), default=TIME_MAX)
         if gmin >= cfg.stop_time:
             break
         runahead = (
@@ -239,11 +250,17 @@ def run_golden(
             ev_payload = np.zeros((h, EVENT_PAYLOAD_WORDS), np.int32)
             active = np.zeros(h, bool)
             for i in range(h):
-                if heaps[i] and heaps[i][0][0] < window_end:
-                    t, order, k, pl = heapq.heappop(heaps[i])
-                    ev_t[i], ev_order[i], ev_kind[i] = t, order, k
-                    ev_payload[i] = pl
-                    active[i] = True
+                if not heaps[i] or heaps[i][0][0] >= window_end:
+                    continue
+                if delay_ns > 0 and busy[i] >= window_end:
+                    continue  # CPU busy past the window: events stay queued
+                t, order, k, pl = heapq.heappop(heaps[i])
+                if delay_ns > 0:
+                    t = max(t, busy[i])  # busy-shifted execution time
+                    busy[i] = t + delay_ns
+                ev_t[i], ev_order[i], ev_kind[i] = t, order, k
+                ev_payload[i] = pl
+                active[i] = True
             if not active.any():
                 break
             steps += 1
@@ -314,6 +331,10 @@ def run_golden(
 
             for s in out.sends:
                 mask = np.asarray(s.mask) & dispatch
+                if cfg.use_jitter:
+                    # device draws jitter BEFORE the loss draw: same order
+                    rng, uj_arr = rng_uniform(rng, jnp.asarray(mask))
+                    uj = np.asarray(uj_arr, np.float32)
                 rng, u_arr = rng_uniform(rng, jnp.asarray(mask))
                 u = np.asarray(u_arr)
                 dst_arr = np.asarray(s.dst, np.int64)
@@ -331,9 +352,19 @@ def run_golden(
                         eg_depart = eg[i].charge(t, size_bits)
                     dst = int(dst_arr[i])
                     bad = dst < 0 or dst >= h
-                    lat = int(lat_ns[node_of[i], node_of[min(max(dst, 0), h - 1)]])
-                    lossp = float(loss[node_of[i], node_of[min(max(dst, 0), h - 1)]])
-                    if lat < 0 or bad:
+                    dn = node_of[min(max(dst, 0), h - 1)]
+                    lat = int(lat_ns[node_of[i], dn])
+                    lossp = float(loss[node_of[i], dn])
+                    lat_bound = lat
+                    if cfg.use_jitter:
+                        jit = int(jitter_ns[node_of[i], dn])
+                        # identical float math to the device path
+                        lat = lat + int(np.int64(
+                            np.float32(uj[i] * np.float32(2.0) - np.float32(1.0))
+                            * np.float32(jit)
+                        ))
+                        lat_bound = lat_bound - jit
+                    if lat_bound < 0 or bad:
                         st["pkts_unreachable"][i] += 1
                         continue
                     if u[i] < lossp and t >= cfg.bootstrap_end_time:
@@ -343,7 +374,7 @@ def run_golden(
                         st["pkts_budget_dropped"][i] += 1
                         continue
                     sent_round[i] += 1
-                    min_used_lat = min(min_used_lat, lat)
+                    min_used_lat = min(min_used_lat, lat_bound)
                     pl = payload[i].copy()
                     pl[PAYLOAD_SIZE_WORD] = sz_arr[i]
                     arrive = max(eg_depart + max(lat, 0), window_end)

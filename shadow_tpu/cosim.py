@@ -87,6 +87,7 @@ class HybridSimulation:
             bootstrap_end_time=cfg.general.bootstrap_end_time,
             runahead_floor=ex.runahead,
             static_min_latency=max(self.graph.min_latency_ns, 1),
+            use_jitter=self.graph.has_jitter,
             use_dynamic_runahead=False,
             use_codel=ex.use_codel,
             queue_capacity=qcap,
@@ -124,6 +125,7 @@ class HybridSimulation:
                 node_of=jnp.asarray(node_of),
                 lat_ns=jnp.asarray(self.graph.lat_ns),
                 loss=jnp.asarray(self.graph.loss),
+                jitter_ns=jnp.asarray(self.graph.jitter_ns),
                 eg_tb=simmod._tb_params(bw_up, ecfg.tb_interval_ns),
                 in_tb=simmod._tb_params(bw_down, ecfg.tb_interval_ns),
                 model=jax.tree.map(jnp.asarray, mparams),
@@ -212,8 +214,9 @@ class HybridSimulation:
                     self._strace_files.append(f)
                     p.strace = StraceLogger(f, strace_mode)
 
-        # staging + payload store
-        self._staged: list[tuple[int, int, int, int, int]] = []  # src,t,dst,size,key
+        # staging + payload store; tuples are (src, t, dst, size, key, sock)
+        self._staged: list[tuple[int, int, int, int, int, int]] = []
+        self._qdisc = cfg.experimental.interface_qdisc
         self._send_seq = np.zeros((ecfg.num_hosts,), np.int64)
         self._bytes: dict[tuple[int, int], tuple[int, NetPacket]] = {}
         self._window_idx = 0
@@ -247,7 +250,10 @@ class HybridSimulation:
         key = int(self._send_seq[gid] % (1 << 31))
         self._send_seq[gid] += 1
         self._bytes[(gid, key)] = (self._window_idx, pkt)
-        self._staged.append((gid, host.now(), dst_gid, pkt.size_bytes, key))
+        sock = (int(pkt.proto) << 16) | (int(pkt.src_port) & 0xFFFF)
+        self._staged.append(
+            (gid, host.now(), dst_gid, pkt.size_bytes, key, sock)
+        )
 
     # ---- window loop -------------------------------------------------------
 
@@ -335,6 +341,12 @@ class HybridSimulation:
         self._windows = windows
         return self.stats_report()
 
+    def _order_seq(self, gid: int) -> int:
+        """Fresh per-host order counter for qdisc-reordered injections."""
+        v = int(self._send_seq[gid] % (1 << 31))
+        self._send_seq[gid] += 1
+        return v
+
     def _inject(self):
         """Merge up to staging_cap staged sends into the device queues (and
         clear the capture rings); the guarded round loop computes its own
@@ -343,6 +355,8 @@ class HybridSimulation:
         staged = self._staged[:cap]
         overflow = self._staged[cap:]
         self._staged = overflow  # carried to next window (bounded staging)
+        if self._qdisc == "round-robin":
+            staged = _rr_reorder(staged)
         n = cap
         src = np.zeros((n,), np.int64)
         t = np.full((n,), TIME_MAX, np.int64)
@@ -351,11 +365,15 @@ class HybridSimulation:
         kind = np.zeros((n,), np.int32)
         payload = np.zeros((n, 4), np.int32)
         valid = np.zeros((n,), bool)
-        for i, (gid, t_ns, dst_gid, size, key) in enumerate(staged):
+        for i, (gid, t_ns, dst_gid, size, key, _sock) in enumerate(staged):
             src[i] = gid
             t[i] = t_ns
             dstw[i] = gid  # send-request is a LOCAL event on the source host
-            order[i] = int(pack_order(1, gid, key))
+            # key doubles as the order tiebreak: under round-robin the list
+            # order changed, so re-sequence (the payload keeps the original
+            # key for the byte-store lookup)
+            order[i] = int(pack_order(1, gid, key if self._qdisc == "fifo"
+                                      else self._order_seq(gid)))
             kind[i] = KIND_SENDREQ
             payload[i, PW_SIZE] = size
             payload[i, PW_DST_OR_SRC] = dst_gid
@@ -478,6 +496,29 @@ class HybridSimulation:
             with open(os.path.join(hd, "host-stats.json"), "w") as f:
                 json.dump({"name": spec.name, "ip": spec.ip, **host.counters}, f)
         return data_dir
+
+
+def _rr_reorder(staged):
+    """Round-robin qdisc (reference QDiscMode::RoundRobin wired into
+    network_interface.c): within each source host, interleave this window's
+    packets one per originating socket (sockets in first-seen order) instead
+    of strict emit-FIFO. Deterministic: depends only on the staged list."""
+    by_host: dict[int, dict[int, list]] = {}
+    host_order: list[int] = []
+    for e in staged:
+        gid, sock = e[0], e[5]
+        if gid not in by_host:
+            by_host[gid] = {}
+            host_order.append(gid)
+        by_host[gid].setdefault(sock, []).append(e)
+    out = []
+    for gid in host_order:
+        socks = list(by_host[gid].values())
+        while any(socks):
+            for q in socks:
+                if q:
+                    out.append(q.pop(0))
+    return out
 
 
 def _clear_caps(state):

@@ -176,6 +176,7 @@ class NetworkGraph:
     node_ids: np.ndarray  # i64[N] original GML ids
     lat_ns: np.ndarray  # i64[N, N]
     loss: np.ndarray  # f32[N, N]
+    jitter_ns: np.ndarray  # i64[N, N] path jitter amplitude (0 = none)
     bw_down_bits: np.ndarray  # i64[N]
     bw_up_bits: np.ndarray  # i64[N]
     directed: bool
@@ -196,17 +197,25 @@ class NetworkGraph:
     @property
     def min_latency_ns(self) -> int:
         """Smallest reachable path latency — the conservative-PDES lookahead
-        bound (reference runahead.rs:5-13: round length <= min latency)."""
-        reach = self.lat_ns[self.lat_ns >= 0]
-        if reach.size == 0:
+        bound (reference runahead.rs:5-13: round length <= min latency).
+        With jitter the bound is the smallest latency MINUS its jitter
+        amplitude (a jittered packet can arrive that early)."""
+        mask = self.lat_ns >= 0
+        if not mask.any():
             raise GraphError("graph has no reachable paths")
-        return int(reach.min())
+        eff = self.lat_ns[mask] - self.jitter_ns[mask]
+        return int(eff.min())
+
+    @property
+    def has_jitter(self) -> bool:
+        return bool((self.jitter_ns > 0).any())
 
 
 def _edge_arrays(g: dict, index_of: dict[int, int]):
     n = len(index_of)
     lat = np.full((n, n), -1, np.int64)
     sur = np.zeros((n, n), np.float64)  # survival probability per direct edge
+    jit = np.zeros((n, n), np.int64)
     for e in g["edges"]:
         try:
             s = index_of[int(e["source"])]
@@ -221,18 +230,28 @@ def _edge_arrays(g: dict, index_of: dict[int, int]):
         p_loss = float(e.get("packet_loss", 0.0))
         if not (0.0 <= p_loss < 1.0):
             raise GraphError(f"packet_loss {p_loss} outside [0, 1)")
+        # jitter (reference graph/mod.rs:68,87-92 parses it; here it is also
+        # APPLIED: each packet draws latency uniformly in [lat-j, lat+j])
+        j_ns = parse_time_ns(e["jitter"], TimeUnit.MS) if "jitter" in e else 0
+        if not (0 <= j_ns < l_ns):
+            raise GraphError(
+                f"edge jitter {j_ns}ns must be in [0, latency) — a packet "
+                f"must never arrive before the conservative lookahead bound"
+            )
         pairs = [(s, d)] if g["directed"] else [(s, d), (d, s)]
         for a, b in pairs:
             # parallel edges: keep the lowest-latency one (deterministic)
             if lat[a, b] < 0 or l_ns < lat[a, b]:
                 lat[a, b] = l_ns
                 sur[a, b] = 1.0 - p_loss
-    return lat, sur
+                jit[a, b] = j_ns
+    return lat, sur, jit
 
 
-def _shortest_paths(lat: np.ndarray, sur: np.ndarray):
-    """All-pairs shortest path by latency; compose survival along the chosen
-    path via the predecessor matrix (reference graph/mod.rs:183-228)."""
+def _shortest_paths(lat: np.ndarray, sur: np.ndarray, jit: np.ndarray):
+    """All-pairs shortest path by latency; compose survival (product) and
+    jitter (sum) along the chosen path via the predecessor matrix
+    (reference graph/mod.rs:183-228)."""
     from scipy.sparse import csr_matrix
     from scipy.sparse.csgraph import dijkstra
 
@@ -250,27 +269,31 @@ def _shortest_paths(lat: np.ndarray, sur: np.ndarray):
     # walk nodes per source in increasing-distance order: survival follows the
     # predecessor tree (optimal substructure), fully deterministic because
     # scipy's dijkstra tie-breaks are fixed for a fixed input.
+    path_jit = np.zeros((n, n), np.int64)
     order = np.argsort(dist, axis=1, kind="stable")
     for s in range(n):
         ps = path_sur[s]
+        pj = path_jit[s]
         ps[s] = 1.0
         for j in order[s]:
             p = pred[s, j]
             if p < 0:
                 continue  # unreachable or the source itself
             ps[j] = ps[p] * sur[p, j]
+            pj[j] = pj[p] + jit[p, j]
     for s in range(n):
         if self_edge[s]:
             dist_ns[s, s] = lat[s, s]
             path_sur[s, s] = sur[s, s]
+            path_jit[s, s] = jit[s, s]
         elif dist_ns[s, s] == 0:
             path_sur[s, s] = 1.0
-    return dist_ns, path_sur
+    return dist_ns, path_sur, path_jit
 
 
-def _direct_paths(lat: np.ndarray, sur: np.ndarray):
+def _direct_paths(lat: np.ndarray, sur: np.ndarray, jit: np.ndarray):
     """use_shortest_path=false: only direct edges route (graph/mod.rs:230-253)."""
-    return lat.copy(), sur.copy()
+    return lat.copy(), sur.copy(), jit.copy()
 
 
 def _node_bandwidth(nd: dict, key: str) -> int:
@@ -286,16 +309,17 @@ def build_graph(
     if len(set(ids)) != len(ids):
         raise GraphError("duplicate node ids in graph")
     index_of = {gid: i for i, gid in enumerate(ids)}
-    lat, sur = _edge_arrays(g, index_of)
+    lat, sur, jit = _edge_arrays(g, index_of)
     if use_shortest_path:
-        path_lat, path_sur = _shortest_paths(lat, sur)
+        path_lat, path_sur, path_jit = _shortest_paths(lat, sur, jit)
     else:
-        path_lat, path_sur = _direct_paths(lat, sur)
+        path_lat, path_sur, path_jit = _direct_paths(lat, sur, jit)
     loss = np.where(path_lat >= 0, 1.0 - path_sur, 0.0).astype(np.float32)
     return NetworkGraph(
         node_ids=np.asarray(ids, np.int64),
         lat_ns=path_lat,
         loss=loss,
+        jitter_ns=path_jit,
         bw_down_bits=np.asarray(
             [_node_bandwidth(nd, "host_bandwidth_down") for nd in g["nodes"]], np.int64
         ),

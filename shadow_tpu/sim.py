@@ -37,7 +37,7 @@ from shadow_tpu.core.engine import Engine, EngineConfig, EngineParams
 from shadow_tpu.models.base import get_model
 from shadow_tpu.net import TBParams
 from shadow_tpu.net.graph import IpAssignment, NetworkGraph, load_graph
-from shadow_tpu.simtime import NS_PER_SEC
+from shadow_tpu.simtime import NS_PER_SEC, TIME_MAX
 
 MTU_BITS = 1500 * 8
 UNLIMITED_BW = 1 << 40  # token-bucket params for "no bandwidth configured"
@@ -62,6 +62,65 @@ class HostSpec:
     pcap_capture_size: int
     # managed programs (hybrid/co-sim hosts): [{path, args, start_time, ...}]
     programs: list = dataclasses.field(default_factory=list)
+
+
+class _ModeledPcap:
+    """Per-round pcap synthesis for device-modeled sims: each captured
+    round's pre-exchange outbox is pulled to the host and written as
+    synthesized UDP frames (src host = lane row, arrival timestamp; ports
+    are a deterministic synthesis — model events carry no transport
+    header). Reference: pcap_writer.rs + network_interface.c capture."""
+
+    def __init__(self, sim: "Simulation"):
+        from shadow_tpu.host.sockets import NetPacket
+        from shadow_tpu.obs.pcap import PcapWriter
+
+        self._NetPacket = NetPacket
+        self.step = sim.engine.build_capture_step()
+        self._ips = [h.ip for h in sim.hosts]
+        data_dir = sim.cfg.general.data_directory or "shadow_tpu.data"
+        self.writers = {}
+        for h in sim.hosts:
+            if not h.pcap_enabled:
+                continue
+            host_dir = os.path.join(data_dir, "hosts", h.name)
+            os.makedirs(host_dir, exist_ok=True)
+            self.writers[h.host_id] = PcapWriter(
+                os.path.join(host_dir, "eth0.pcap"), h.pcap_capture_size
+            )
+
+    def write_round(self, outbox):
+        t = np.asarray(jax.device_get(outbox.t))
+        if not (t < TIME_MAX).any():
+            return
+        dst = np.asarray(jax.device_get(outbox.dst))
+        payload = np.asarray(jax.device_get(outbox.payload))
+        n_hosts = len(self._ips)
+        for src, col in zip(*np.nonzero(t < TIME_MAX)):
+            d = int(dst[src, col])
+            if not (0 <= d < n_hosts):
+                continue
+            size = int(payload[src, col, 0])  # PAYLOAD_SIZE_WORD
+            pkt = self._NetPacket(
+                src_ip=self._ips[int(src)],
+                src_port=40000,
+                dst_ip=self._ips[d],
+                dst_port=40000,
+                proto=17,  # synthesized as UDP
+                payload=b"\x00" * max(0, min(size, 65000)),
+            )
+            ts = int(t[src, col])
+            w = self.writers.get(int(src))
+            if w is not None:
+                w.write(ts, pkt)  # egress (timestamped at arrival: the
+                # outbox stores only the delivery time)
+            w = self.writers.get(d)
+            if w is not None:
+                w.write(ts, pkt)  # ingress at the destination
+
+    def close(self):
+        for w in self.writers.values():
+            w.close()
 
 
 def _resolve_host_basics(cfg: ConfigOptions, graph: NetworkGraph):
@@ -264,6 +323,7 @@ class Simulation:
             bootstrap_end_time=cfg.general.bootstrap_end_time,
             runahead_floor=ex.runahead,
             static_min_latency=max(self.graph.min_latency_ns, 1),
+            use_jitter=self.graph.has_jitter,
             use_dynamic_runahead=ex.use_dynamic_runahead,
             use_codel=ex.use_codel,
             queue_capacity=qcap,
@@ -336,6 +396,7 @@ class Simulation:
                 node_of=jnp.asarray(node_of),
                 lat_ns=jnp.asarray(self.graph.lat_ns),
                 loss=jnp.asarray(self.graph.loss),
+                jitter_ns=jnp.asarray(self.graph.jitter_ns),
                 eg_tb=_tb_params(bw_up, ecfg.tb_interval_ns),
                 in_tb=_tb_params(bw_down, ecfg.tb_interval_ns),
                 model=self._pad(mparams),
@@ -358,9 +419,14 @@ class Simulation:
         hb_ns = cfg.general.heartbeat_interval
         t0 = time.monotonic()
         next_hb = hb_ns
+        capture = self._pcap_capture_begin()
         chunks = 0
         while not bool(self.state.done):
-            self.state = self.engine.run_chunk(self.state, self.params)
+            if capture is not None:
+                self.state, sent = capture.step(self.state, self.params)
+                capture.write_round(sent)
+            else:
+                self.state = self.engine.run_chunk(self.state, self.params)
             chunks += 1
             now_ns = int(self.state.now)
             wall = time.monotonic() - t0
@@ -380,9 +446,22 @@ class Simulation:
                 print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
         if show_progress:
             print(file=log)
+        if capture is not None:
+            capture.close()
         self._wall_seconds = time.monotonic() - t0
         self._chunks = chunks
         return self.stats_report()
+
+    def _pcap_capture_begin(self):
+        """When any host has pcap_enabled, switch the run loop to captured
+        single-round dispatches and open per-host eth0.pcap writers (the
+        modeled-sim analogue of the reference's per-interface capture; the
+        co-sim plane captures real packets, here frames are synthesized
+        from packet events). Returns None when no host captures."""
+        specs = [h for h in self.hosts if h.pcap_enabled]
+        if not specs:
+            return None
+        return _ModeledPcap(self)
 
     def _run_golden(self) -> dict:
         """`experimental.scheduler: cpu-reference` — run the independent
@@ -392,11 +471,6 @@ class Simulation:
         """
         from shadow_tpu.core.golden import run_golden
 
-        if self.engine_cfg.cpu_delay_ns > 0:
-            raise ConfigError(
-                "experimental.cpu_delay is not modeled by the cpu-reference "
-                "scheduler; use scheduler: tpu"
-            )
         params, mstate, events = self._golden_inputs
         t0 = time.monotonic()
         gold = run_golden(

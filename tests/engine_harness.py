@@ -25,6 +25,8 @@ def build_sim(
     seed: int = 1,
     runahead_floor: int = 1_000_000,
     use_codel: bool = True,
+    cpu_delay_ns: int = 0,
+    jitter: int = 0,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -41,6 +43,8 @@ def build_sim(
         rounds_per_chunk=64,
         world=world,
         use_codel=use_codel,
+        cpu_delay_ns=cpu_delay_ns,
+        use_jitter=jitter > 0,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)
@@ -48,6 +52,7 @@ def build_sim(
         node_of=jnp.zeros((h,), jnp.int32),
         lat_ns=jnp.full((1, 1), latency, jnp.int64),
         loss=jnp.full((1, 1), loss, jnp.float32),
+        jitter_ns=jnp.full((1, 1), jitter, jnp.int64),
         eg_tb=TBParams(
             capacity=jnp.full((h,), 30_000, jnp.int64),
             refill=jnp.full((h,), bw_bits // 1000, jnp.int64),

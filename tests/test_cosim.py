@@ -175,3 +175,86 @@ def test_build_simulation_factory_dispatch():
         stop="1 s",
     )
     assert isinstance(build_simulation(prog_cfg), HybridSimulation)
+
+
+def test_hybrid_determinism_sixteen_hosts():
+    """Two-run digest equality at >=16 CPU hosts over the device plane —
+    the scale point where service order, per-host RNG lanes, and the
+    window barrier would expose any wall-clock leakage (VERDICT r1 #9)."""
+
+    def once():
+        cfg = _cfg(
+            {
+                "server": {
+                    "network_node_id": 0,
+                    "count": 2,
+                    "processes": [{"path": "udp_echo_server"}],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "count": 14,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server1", "count=3", "size=120"],
+                        }
+                    ],
+                },
+            },
+            stop="2 s",
+            seed=31,
+        )
+        sim = HybridSimulation(cfg)
+        report = sim.run()
+        outs = {s.name: _stdout(sim, s.name) for s in sim.specs}
+        return report["determinism_digest"], outs, report["packets_sent"]
+
+    first = once()
+    assert len(first[1]) == 16
+    assert first == once()
+
+
+def test_rr_qdisc_reorders_and_stays_deterministic():
+    """interface_qdisc: round-robin interleaves a host's same-window sends
+    one per socket (reference QDiscMode wired into network_interface.c);
+    fifo keeps emit order. Both must be deterministic."""
+    from shadow_tpu.cosim import _rr_reorder
+
+    # two sockets (A=1, B=2) on host 0, one socket on host 1
+    staged = [
+        (0, 10, 1, 100, 0, 1),  # A0
+        (0, 10, 1, 100, 1, 1),  # A1
+        (0, 10, 1, 100, 2, 2),  # B0
+        (0, 10, 1, 100, 3, 1),  # A2
+        (1, 10, 0, 100, 0, 9),
+    ]
+    out = _rr_reorder(staged)
+    keys = [(e[0], e[4]) for e in out]
+    assert keys == [(0, 0), (0, 2), (0, 1), (0, 3), (1, 0)]  # A,B,A,A then h1
+
+    def run(qdisc):
+        cfg = _cfg(
+            {
+                "server": {
+                    "network_node_id": 0,
+                    "processes": [{"path": "udp_echo_server"}],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "count": 2,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server", "count=3"],
+                        }
+                    ],
+                },
+            },
+            stop="1 s",
+            extra={"experimental": {"interface_qdisc": qdisc}},
+        )
+        sim = HybridSimulation(cfg)
+        report = sim.run()
+        return report["determinism_digest"]
+
+    assert run("round-robin") == run("round-robin")  # deterministic

@@ -174,3 +174,40 @@ def test_large_random_graph_matches_floyd_warshall():
         d = np.minimum(d, d[:, k : k + 1] + d[k : k + 1, :])
     expect = np.where(d >= inf, -1, d)
     np.testing.assert_array_equal(g.lat_ns, expect)
+
+
+def test_edge_jitter_parsed_and_composed():
+    from shadow_tpu.net.graph import build_graph
+
+    g = build_graph("""
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  node [ id 2 ]
+  edge [ source 0 target 1 latency "10 ms" jitter "2 ms" ]
+  edge [ source 1 target 2 latency "10 ms" jitter "3 ms" ]
+]
+""")
+    a, b, c = (g.node_index(i) for i in (0, 1, 2))
+    assert g.jitter_ns[a, b] == 2_000_000
+    assert g.jitter_ns[a, c] == 5_000_000  # composed along the path
+    assert g.has_jitter
+    # lookahead bound shrinks by the jitter amplitude
+    assert g.min_latency_ns == 8_000_000
+
+
+def test_edge_jitter_must_be_below_latency():
+    import pytest
+
+    from shadow_tpu.net.graph import GraphError, build_graph
+
+    with pytest.raises(GraphError):
+        build_graph("""
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 1 latency "1 ms" jitter "1 ms" ]
+]
+""")
