@@ -803,8 +803,11 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             )
             lat_bound = lat_bound - jit
         # a model emitting an out-of-range dst is a bug: surface it as
-        # unreachable rather than silently delivering to a clamped host
-        unreachable = mask & ((lat < 0) | bad_dst)
+        # unreachable rather than silently delivering to a clamped host.
+        # Uses the PRE-jitter bound so the predicate is independent of the
+        # jitter draw (float32 jitter math could otherwise flip the sign for
+        # amplitudes >= 2^24 ns, diverging from golden which tests lat_bound)
+        unreachable = mask & ((lat_bound < 0) | bad_dst)
         rng, u = rng_uniform(rng, mask)
         lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
         send_ok = mask & ~lost & ~unreachable & ~over_budget

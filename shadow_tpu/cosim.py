@@ -86,7 +86,7 @@ class HybridSimulation:
             stop_time=cfg.general.stop_time,
             bootstrap_end_time=cfg.general.bootstrap_end_time,
             runahead_floor=ex.runahead,
-            static_min_latency=max(self.graph.min_latency_ns, 1),
+            static_min_latency=max(self.graph.min_latency_ns_opt or 0, 1),
             use_jitter=self.graph.has_jitter,
             use_dynamic_runahead=False,
             use_codel=ex.use_codel,

@@ -195,16 +195,30 @@ class NetworkGraph:
             raise GraphError(f"config references unknown graph node id {gml_id}") from None
 
     @property
+    def min_latency_ns_opt(self) -> int | None:
+        """Smallest reachable path latency, or None for a graph with no
+        routable pairs at all (legal for timer-only workloads — the engine
+        then runs on the runahead floor). The synthetic zero diagonal is
+        already excluded at build time (no-self-loop diagonals are -1)."""
+        mask = self.lat_ns >= 0
+        if not mask.any():
+            return None
+        eff = self.lat_ns[mask] - self.jitter_ns[mask]
+        return int(eff.min())
+
+    @property
     def min_latency_ns(self) -> int:
         """Smallest reachable path latency — the conservative-PDES lookahead
         bound (reference runahead.rs:5-13: round length <= min latency).
         With jitter the bound is the smallest latency MINUS its jitter
         amplitude (a jittered packet can arrive that early)."""
-        mask = self.lat_ns >= 0
-        if not mask.any():
-            raise GraphError("graph has no reachable paths")
-        eff = self.lat_ns[mask] - self.jitter_ns[mask]
-        return int(eff.min())
+        v = self.min_latency_ns_opt
+        if v is None:
+            raise GraphError(
+                "graph has no routable node pairs (a node needs a self-loop "
+                "edge for same-node traffic, or an edge to another node)"
+            )
+        return v
 
     @property
     def has_jitter(self) -> bool:
@@ -261,8 +275,14 @@ def _shortest_paths(lat: np.ndarray, sur: np.ndarray, jit: np.ndarray):
     graph = csr_matrix((w[mask], np.nonzero(mask)), shape=(n, n))
     dist, pred = dijkstra(graph, directed=True, return_predecessors=True)
 
-    # self paths: a self-edge (possible in GML) wins over the trivial 0 path —
-    # the reference routes loopback-node traffic over the self-edge latency.
+    # self paths: the reference REQUIRES a self-loop on every node and routes
+    # node-to-itself traffic over it (graph/mod.rs:210-216, get_edge_weight
+    # errors without one). Dijkstra's synthetic zero diagonal must NOT leak
+    # into the tables: a free self path would make min_latency_ns (the
+    # conservative lookahead bound) collapse to 0 on every multi-node graph.
+    # Deviation from the reference: instead of erroring at parse time for a
+    # missing self-loop, the diagonal becomes unreachable (-1) and sim setup
+    # rejects configs that actually place >= 2 hosts on such a node.
     self_edge = np.diag(mask)
     dist_ns = np.where(np.isinf(dist), -1, np.rint(dist)).astype(np.int64)
     path_sur = np.zeros((n, n), np.float64)
@@ -286,8 +306,10 @@ def _shortest_paths(lat: np.ndarray, sur: np.ndarray, jit: np.ndarray):
             dist_ns[s, s] = lat[s, s]
             path_sur[s, s] = sur[s, s]
             path_jit[s, s] = jit[s, s]
-        elif dist_ns[s, s] == 0:
-            path_sur[s, s] = 1.0
+        else:
+            dist_ns[s, s] = -1  # no self-loop: same-node pairs cannot route
+            path_sur[s, s] = 0.0
+            path_jit[s, s] = 0
     return dist_ns, path_sur, path_jit
 
 

@@ -130,6 +130,21 @@ def _resolve_host_basics(cfg: ConfigOptions, graph: NetworkGraph):
     bw_down, bw_up)."""
     ips = IpAssignment()
     ordered = sorted(cfg.hosts, key=lambda h: h.name)
+    # the reference requires a self-loop on every graph node
+    # (graph/mod.rs:210-216); enforce it where it matters — a node carrying
+    # >= 2 hosts with an unreachable diagonal can never route same-node
+    # traffic, which is a config error, not per-packet drops
+    hosts_per_node: dict[int, int] = {}
+    for h in ordered:
+        n = graph.node_index(h.network_node_id)
+        hosts_per_node[n] = hosts_per_node.get(n, 0) + h.count
+    for n, cnt in sorted(hosts_per_node.items()):
+        if cnt >= 2 and graph.lat_ns[n, n] < 0:
+            raise ConfigError(
+                f"graph node {int(graph.node_ids[n])} hosts {cnt} hosts but "
+                f"has no self-loop edge: same-node traffic cannot route "
+                f"(the reference requires a self-loop per node)"
+            )
     for i, h in enumerate(ordered):
         if h.ip_addr is not None:
             ips.assign_manual(i, h.ip_addr)
@@ -322,7 +337,7 @@ class Simulation:
             stop_time=cfg.general.stop_time,
             bootstrap_end_time=cfg.general.bootstrap_end_time,
             runahead_floor=ex.runahead,
-            static_min_latency=max(self.graph.min_latency_ns, 1),
+            static_min_latency=max(self.graph.min_latency_ns_opt or 0, 1),
             use_jitter=self.graph.has_jitter,
             use_dynamic_runahead=ex.use_dynamic_runahead,
             use_codel=ex.use_codel,

@@ -47,9 +47,11 @@ def test_shortest_path_latency_and_loss():
     assert g.loss[i0, i1] == pytest.approx(0.1, abs=1e-6)
     # symmetric (undirected)
     assert np.array_equal(g.lat_ns, g.lat_ns.T)
-    # self paths are free unless a self-edge exists
-    assert g.lat_ns[i0, i0] == 0 and g.loss[i0, i0] == 0
-    assert g.min_latency_ns == 0  # self paths count (single-node graphs route)
+    # no self-edge => same-node pairs cannot route (reference requires a
+    # self-loop per node, graph/mod.rs:210-216) and the synthetic Dijkstra
+    # zero diagonal must NOT leak into the lookahead bound
+    assert g.lat_ns[i0, i0] == -1 and g.loss[i0, i0] == 0
+    assert g.min_latency_ns == 10_000_000  # smallest REAL path, not the diagonal
     assert g.bw_down_bits[i0] == 100_000_000 and g.bw_up_bits[i0] == 10_000_000
 
 
@@ -173,6 +175,9 @@ def test_large_random_graph_matches_floyd_warshall():
     for k in range(n):
         d = np.minimum(d, d[:, k : k + 1] + d[k : k + 1, :])
     expect = np.where(d >= inf, -1, d)
+    # no node in this random graph has a self-edge, so every diagonal entry
+    # is unreachable (the synthetic zero path must not leak through)
+    np.fill_diagonal(expect, -1)
     np.testing.assert_array_equal(g.lat_ns, expect)
 
 
@@ -193,8 +198,9 @@ graph [
     assert g.jitter_ns[a, b] == 2_000_000
     assert g.jitter_ns[a, c] == 5_000_000  # composed along the path
     assert g.has_jitter
-    # lookahead bound shrinks by the jitter amplitude
-    assert g.min_latency_ns == 8_000_000
+    # lookahead bound = min over pairs of (latency - jitter amplitude); the
+    # 1<->2 edge (10 ms - 3 ms) is the binding pair, not 0<->1 (10 - 2)
+    assert g.min_latency_ns == 7_000_000
 
 
 def test_edge_jitter_must_be_below_latency():
@@ -211,3 +217,83 @@ graph [
   edge [ source 0 target 1 latency "1 ms" jitter "1 ms" ]
 ]
 """)
+
+
+def test_multinode_min_latency_sets_window_size():
+    """A 2-node 50 ms graph must yield ~50 ms scheduling windows — the core
+    conservative-PDES perf lever (reference runahead.rs:5-13: round length =
+    min path latency). Regression guard for the zero-diagonal bug that
+    collapsed every multi-node window to the 1 ms runahead floor."""
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.sim import Simulation
+
+    gml = """
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 1 latency "50 ms" ]
+]
+"""
+    cfg = ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "1 s", "seed": 3},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "hosts": {
+                "a": {
+                    "network_node_id": 0,
+                    "processes": [{"model": "udp_echo",
+                                   "model_args": {"role": "server"}}],
+                },
+                "b": {
+                    "network_node_id": 1,
+                    "processes": [{"model": "udp_echo",
+                                   "model_args": {"role": "client",
+                                                  "peer": "a",
+                                                  "interval": "100 ms"}}],
+                },
+            },
+        }
+    )
+    g = Simulation(cfg, world=1)
+    assert g.graph.min_latency_ns == 50_000_000
+    report = g.run(progress=False)
+    # 1 s of sim time at 50 ms windows: ~20 rounds (+ a couple of boot /
+    # shutdown rounds). The bug produced ~1000 rounds (1 ms floor).
+    assert report["rounds"] <= 30, report["rounds"]
+    assert report["packets_delivered"] > 0
+
+
+def test_two_hosts_on_selfloopless_node_rejected():
+    """>= 2 hosts on a node with no self-loop cannot exchange packets; sim
+    setup must reject the config (reference requires a self-loop per node,
+    graph/mod.rs:210-216)."""
+    import pytest as _pytest
+
+    from shadow_tpu.config.options import ConfigError, ConfigOptions
+    from shadow_tpu.sim import Simulation
+
+    gml = """
+graph [
+  directed 0
+  node [ id 0 ]
+  node [ id 1 ]
+  edge [ source 0 target 1 latency "10 ms" ]
+]
+"""
+    cfg = ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "1 s"},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "hosts": {
+                "x": {
+                    "count": 2,
+                    "network_node_id": 0,
+                    "processes": [{"model": "udp_echo",
+                                   "model_args": {"role": "server"}}],
+                },
+            },
+        }
+    )
+    with _pytest.raises(ConfigError, match="self-loop"):
+        Simulation(cfg, world=1)

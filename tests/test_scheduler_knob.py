@@ -25,8 +25,9 @@ def _cfg(scheduler: str, extra_exp: dict | None = None):
                     "processes": [
                         {
                             "model": "gossip",
-                            "model_args": {"fanout": 2, "rounds": 6,
-                                           "interval": "100 ms"},
+                            # publisher: without it no host schedules a first
+                            # event and the whole sim is vacuously empty
+                            "model_args": {"fanout": 2, "publisher": True},
                         }
                     ],
                 }
@@ -43,6 +44,7 @@ def test_scheduler_choice_does_not_change_results(tmp_path):
     assert (
         dev_report["determinism_digest"] == gold_report["determinism_digest"]
     )
+    assert dev_report["events_processed"] > 0  # guard against a vacuous sim
     for k in ("events_processed", "packets_sent", "packets_delivered",
               "packets_lost", "rounds"):
         assert dev_report[k] == gold_report[k], k
@@ -56,9 +58,10 @@ def test_unknown_scheduler_rejected():
         _cfg("gpu")
 
 
-def test_cpu_reference_rejects_cpu_delay():
-    from shadow_tpu.config.options import ConfigError
-
-    sim = Simulation(_cfg("cpu-reference", {"cpu_delay": "1 ms"}), world=1)
-    with pytest.raises(ConfigError, match="cpu_delay"):
-        sim.run(progress=False)
+def test_cpu_reference_accepts_cpu_delay():
+    # the golden scheduler models the CPU busy horizon since round 2; it must
+    # run cpu_delay configs and agree with the device engine (full parity is
+    # covered by test_golden.py::test_cpu_delay_matches)
+    gold = Simulation(_cfg("cpu-reference", {"cpu_delay": "1 ms"}), world=1)
+    report = gold.run(progress=False)
+    assert report["events_processed"] > 0
