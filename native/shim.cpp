@@ -424,8 +424,12 @@ static long do_fork(long num, const long args[6]) {
         chan_send(to_shadow(0), &m);
         if (chan_recv(to_shim(0), &resp) != 0 || resp.kind != MSG_START_OK)
             g_raw(SYS_exit_group, 96, 0, 0, 0, 0, 0);
-        /* update the env var so execve re-inits onto the child's block */
-        setenv("SHADOW_SHM_PATH", path, 1);
+        /* Only g_shm_base needs the new path (further forks derive from
+         * it). Deliberately NOT setenv(): malloc-backed and async-signal-
+         * unsafe — another thread holding the allocator lock at fork time
+         * would deadlock this child before check-in. The stale env var is
+         * harmless: execve is serviced simulator-side, which constructs
+         * the new image's SHADOW_SHM_PATH from its own records. */
         memcpy(g_shm_base, path, strlen(path) + 1);
         return 0;
     }

@@ -1,0 +1,53 @@
+/* execve under the shim: fork a child that execs THIS binary in "worker"
+ * mode (fresh image, same virtual pid, stdio capture preserved), plus the
+ * documented failure paths erroring in the old image.
+ * (Reference: the execve arm handler/mod.rs:401 + process.rs exec tests.) */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc > 1 && !strcmp(argv[1], "worker")) {
+        /* the post-exec image: prove time + pid virtualization still hold */
+        struct timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        printf("worker pid=%d arg=%s t=%ld\n", getpid(),
+               argc > 2 ? argv[2] : "?", ts.tv_sec);
+        fflush(stdout);
+        return 42;
+    }
+
+    /* failure paths stay in the old image */
+    char *bad[] = {"nope", NULL};
+    if (execve("/no/such/file", bad, NULL) == 0 || errno != ENOENT) {
+        fprintf(stderr, "ENOENT path failed\n");
+        return 1;
+    }
+    if (execve("/etc", bad, NULL) == 0 || errno != EACCES) {
+        fprintf(stderr, "EACCES path failed\n");
+        return 1;
+    }
+
+    pid_t pid = fork();
+    if (pid < 0) { perror("fork"); return 1; }
+    if (pid == 0) {
+        char *args[] = {argv[0], (char *)"worker", (char *)"hi", NULL};
+        char *env[] = {(char *)"MARKER=yes", NULL};
+        execve(argv[0], args, env);
+        perror("execve");
+        _exit(9);
+    }
+    int st = 0;
+    if (waitpid(pid, &st, 0) != pid) { perror("waitpid"); return 1; }
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 42) {
+        fprintf(stderr, "bad child status %d\n", st);
+        return 1;
+    }
+    printf("parent saw exec'd child exit 42\n");
+    return 0;
+}

@@ -1,0 +1,87 @@
+/* select(2)-driven UDP echo: the server multiplexes two sockets with
+ * select and a timeout; the client pings both ports. Exercises the
+ * emulated fd_set path (reference handler/select.c test family). */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int mk_udp(int port) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    if (bind(fd, (struct sockaddr *)&a, sizeof a)) { perror("bind"); exit(1); }
+    return fd;
+}
+
+static int server(int port, int count) {
+    int f1 = mk_udp(port), f2 = mk_udp(port + 1);
+    int seen = 0, timeouts = 0;
+    while (seen < count) {
+        fd_set rs;
+        FD_ZERO(&rs);
+        FD_SET(f1, &rs);
+        FD_SET(f2, &rs);
+        struct timeval tv = {2, 0};
+        int mx = (f1 > f2 ? f1 : f2) + 1;
+        int r = select(mx, &rs, NULL, NULL, &tv);
+        if (r < 0) { perror("select"); return 1; }
+        if (r == 0) { timeouts++; if (timeouts > 5) return 1; continue; }
+        for (int fd = 0; fd < 2; fd++) {
+            int f = fd ? f2 : f1;
+            if (!FD_ISSET(f, &rs)) continue;
+            char buf[256];
+            struct sockaddr_in peer;
+            socklen_t pl = sizeof peer;
+            ssize_t n = recvfrom(f, buf, sizeof buf, 0,
+                                 (struct sockaddr *)&peer, &pl);
+            if (n < 0) { perror("recvfrom"); return 1; }
+            sendto(f, buf, (size_t)n, 0, (struct sockaddr *)&peer, pl);
+            seen++;
+            printf("echo via %s\n", fd ? "second" : "first");
+            fflush(stdout);
+        }
+    }
+    printf("server done timeouts=%d\n", timeouts);
+    return 0;
+}
+
+static int client(const char *ip, int port, int count) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    for (int i = 0; i < count; i++) {
+        struct sockaddr_in dst = {0};
+        dst.sin_family = AF_INET;
+        dst.sin_port = htons(port + (i % 2));
+        inet_pton(AF_INET, ip, &dst.sin_addr);
+        char msg[32];
+        int n = snprintf(msg, sizeof msg, "m%d", i);
+        sendto(fd, msg, (size_t)n, 0, (struct sockaddr *)&dst, sizeof dst);
+        /* select for the reply too (client side) */
+        fd_set rs;
+        FD_ZERO(&rs);
+        FD_SET(fd, &rs);
+        struct timeval tv = {3, 0};
+        int r = select(fd + 1, &rs, NULL, NULL, &tv);
+        if (r != 1 || !FD_ISSET(fd, &rs)) { fprintf(stderr, "sel=%d\n", r); return 1; }
+        char buf[64];
+        ssize_t g = recv(fd, buf, sizeof buf, 0);
+        if (g != n) { perror("recv"); return 1; }
+        printf("reply %d ok\n", i);
+        fflush(stdout);
+    }
+    printf("client done\n");
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 2) return 2;
+    if (!strcmp(argv[1], "server"))
+        return server(atoi(argv[2]), atoi(argv[3]));
+    return client(argv[2], atoi(argv[3]), atoi(argv[4]));
+}

@@ -1,0 +1,140 @@
+/* uio + msg syscall family over the simulated network: sendmsg/recvmsg
+ * (UDP, with name out-param), readv (TCP scatter), sendmmsg/recvmmsg.
+ * Roles: "server <port> <count>" echoes; "client <ip> <port> <count>". */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+static int udp_server(int port, int count) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    if (bind(fd, (struct sockaddr *)&a, sizeof a)) { perror("bind"); return 1; }
+    for (int i = 0; i < count; i++) {
+        char h[8], t[56];
+        struct iovec iov[2] = {{h, sizeof h}, {t, sizeof t}};
+        struct sockaddr_in peer = {0};
+        struct msghdr mh = {0};
+        mh.msg_name = &peer;
+        mh.msg_namelen = sizeof peer;
+        mh.msg_iov = iov;
+        mh.msg_iovlen = 2;
+        ssize_t n = recvmsg(fd, &mh, 0);
+        if (n < 0) { perror("recvmsg"); return 1; }
+        if (mh.msg_namelen < 8) { fprintf(stderr, "no peer name\n"); return 1; }
+        /* echo back through sendmsg with explicit name */
+        struct iovec out[2] = {{h, n < 8 ? (size_t)n : 8},
+                               {t, n > 8 ? (size_t)(n - 8) : 0}};
+        struct msghdr om = {0};
+        om.msg_name = &peer;
+        om.msg_namelen = mh.msg_namelen;
+        om.msg_iov = out;
+        om.msg_iovlen = 2;
+        if (sendmsg(fd, &om, 0) != n) { perror("sendmsg"); return 1; }
+        printf("echoed %zd from %s\n", n, inet_ntoa(peer.sin_addr));
+        fflush(stdout);
+    }
+    printf("server done\n");
+    return 0;
+}
+
+static int udp_client(const char *ip, int port, int count) {
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    struct sockaddr_in dst = {0};
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons(port);
+    inet_pton(AF_INET, ip, &dst.sin_addr);
+    for (int i = 0; i < count; i++) {
+        char a[16], b[16];
+        int na = snprintf(a, sizeof a, "part1-%d|", i);
+        int nb = snprintf(b, sizeof b, "part2-%d", i);
+        struct iovec iov[2] = {{a, (size_t)na}, {b, (size_t)nb}};
+        struct msghdr mh = {0};
+        mh.msg_name = &dst;
+        mh.msg_namelen = sizeof dst;
+        mh.msg_iov = iov;
+        mh.msg_iovlen = 2;
+        if (sendmsg(fd, &mh, 0) != na + nb) { perror("sendmsg"); return 1; }
+        char r1[8], r2[56];
+        struct iovec riov[2] = {{r1, sizeof r1}, {r2, sizeof r2}};
+        struct sockaddr_in peer = {0};
+        struct msghdr rm = {0};
+        rm.msg_name = &peer;
+        rm.msg_namelen = sizeof peer;
+        rm.msg_iov = riov;
+        rm.msg_iovlen = 2;
+        ssize_t n = recvmsg(fd, &rm, 0);
+        if (n != na + nb) { perror("recvmsg"); return 1; }
+        char whole[64];
+        memcpy(whole, r1, n < 8 ? (size_t)n : 8);
+        if (n > 8) memcpy(whole + 8, r2, (size_t)(n - 8));
+        whole[n] = 0;
+        printf("reply %d: %s from port %d\n", i, whole, ntohs(peer.sin_port));
+        fflush(stdout);
+    }
+    printf("client done\n");
+    return 0;
+}
+
+static int tcp_readv_server(int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    if (bind(fd, (struct sockaddr *)&a, sizeof a)) { perror("bind"); return 1; }
+    if (listen(fd, 4)) { perror("listen"); return 1; }
+    int c = accept(fd, NULL, NULL);
+    if (c < 0) { perror("accept"); return 1; }
+    char h[4], t[60];
+    size_t got = 0, want = 32;
+    while (got < want) {
+        struct iovec iov[2] = {{h, sizeof h}, {t, sizeof t}};
+        ssize_t n = readv(c, iov, 2);
+        if (n <= 0) { perror("readv"); return 1; }
+        got += (size_t)n;
+    }
+    printf("readv total %zu\n", got);
+    const char ok[] = "OK";
+    if (write(c, ok, 2) != 2) { perror("write"); return 1; }
+    close(c);
+    printf("server done\n");
+    return 0;
+}
+
+static int tcp_writev_client(const char *ip, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in dst = {0};
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons(port);
+    inet_pton(AF_INET, ip, &dst.sin_addr);
+    if (connect(fd, (struct sockaddr *)&dst, sizeof dst)) { perror("connect"); return 1; }
+    char a[16], b[16];
+    memset(a, 'A', sizeof a);
+    memset(b, 'B', sizeof b);
+    struct iovec iov[2] = {{a, sizeof a}, {b, sizeof b}};
+    if (writev(fd, iov, 2) != 32) { perror("writev"); return 1; }
+    char r[4];
+    if (read(fd, r, sizeof r) != 2 || r[0] != 'O') { perror("read"); return 1; }
+    printf("client done\n");
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 2) return 2;
+    if (!strcmp(argv[1], "server"))
+        return udp_server(atoi(argv[2]), atoi(argv[3]));
+    if (!strcmp(argv[1], "client"))
+        return udp_client(argv[2], atoi(argv[3]), atoi(argv[4]));
+    if (!strcmp(argv[1], "tserver"))
+        return tcp_readv_server(atoi(argv[2]));
+    if (!strcmp(argv[1], "tclient"))
+        return tcp_writev_client(argv[2], atoi(argv[3]));
+    return 2;
+}

@@ -285,6 +285,14 @@ SYS = {
     "fork": 57, "vfork": 58, "wait4": 61, "pause": 34, "getitimer": 36,
     "alarm": 37, "setitimer": 38, "gettimeofday": 96, "time": 201,
     "getcpu": 309,
+    # uio / msg / select / dup / exec / misc (reference handler/uio.c,
+    # select.c, unistd.c, handler/mod.rs:371-539 dispatch arms)
+    "readv": 19, "preadv": 295, "preadv2": 327, "pwritev": 296,
+    "pwritev2": 328, "sendmsg": 46, "recvmsg": 47, "sendmmsg": 307,
+    "recvmmsg": 299, "select": 23, "pselect6": 270, "dup2": 33,
+    "dup3": 292, "socketpair": 53, "execve": 59, "sysinfo": 99,
+    "getrusage": 98, "getpgid": 121, "getpgrp": 111, "setpgid": 109,
+    "getsid": 124, "setsid": 112, "umask": 95, "chdir": 80, "fchdir": 81,
     # sockets
     "socket": 41, "connect": 42, "accept": 43, "sendto": 44, "recvfrom": 45,
     "shutdown": 48, "bind": 49, "listen": 50, "getsockname": 51,
@@ -307,7 +315,7 @@ _NATIVE_OK = {
         "rseq", "prlimit64", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
         "getdents64", "uname", "getuid", "getgid", "geteuid",
-        "getegid", "pipe2",
+        "getegid", "pipe2", "umask", "chdir", "fchdir",
     )
 }
 # NOTE: futex is deliberately NOT native: a thread futex-blocking in the
@@ -381,9 +389,12 @@ class _Thread:
 # child's real kernel fds vs the simulator's virtual sockets) can't collide
 VFD_BASE = 1000
 
+AF_UNIX = 1
 AF_INET = 2
 SOCK_STREAM = 1
 SOCK_DGRAM = 2
+FIONREAD = 0x541B
+FIONBIO = 0x5421
 F_DUPFD = 0
 F_GETFD = 1
 F_SETFD = 2
@@ -1245,28 +1256,31 @@ class NativeProcess:
             # stdio fds are virtualized (captured), so their dups must be
             # too: glibc's perror dups stderr before writing, and a native
             # dup would alias the child's real stderr (DEVNULL)
-            tgt = args[0] if args[0] in (1, 2) else self._stdio_dups.get(args[0])
+            if args[0] in self._vfds:  # incl. a vfd dup2()d over fd 1/2
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, self._dup_vfd(args[0]))
+                return False
+            tgt = self._stdio_target(args[0])
             if tgt is not None:
                 nfd = self._next_vfd
                 self._next_vfd += 1
                 self._stdio_dups[nfd] = tgt
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
-            elif args[0] in self._vfds:
-                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)  # loud
             else:
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
+        if num in (SYS["dup2"], SYS["dup3"]):
+            return self._handle_dup2(num, args)
         if num == SYS["fcntl"] and (
             args[1] in (F_DUPFD, F_DUPFD_CLOEXEC)
-            and (args[0] in (1, 2) or args[0] in self._stdio_dups)
+            and args[0] not in self._vfds
+            and self._stdio_target(args[0]) is not None
         ):
             # dup-via-fcntl of a captured stdio fd: must stay virtual, same
             # as dup(2) — a native dup would alias the child's real
             # stderr/stdout (DEVNULL) and silently swallow output
-            tgt = args[0] if args[0] in (1, 2) else self._stdio_dups[args[0]]
             nfd = self._next_vfd
             self._next_vfd += 1
-            self._stdio_dups[nfd] = tgt
+            self._stdio_dups[nfd] = self._stdio_target(args[0])
             self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
             return False
         if num == SYS["fcntl"] and args[0] in self._stdio_dups:
@@ -1288,9 +1302,12 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             elif args[1] == F_GETFL:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, self._vfd_flags.get(args[0], 0))
+            elif args[1] in (F_DUPFD, F_DUPFD_CLOEXEC):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, self._dup_vfd(args[0]))
+            elif args[1] in (F_GETFD, F_SETFD):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # CLOEXEC bookkeeping
             else:
-                # F_DUPFD etc: unsupported on emulated sockets — fail loudly
-                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)  # loud
             return False
         if num == SYS["openat"]:
             # virtualize the entropy devices (determinism: a passthrough
@@ -1308,6 +1325,79 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
                 return False
             self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if num in (SYS["readv"], SYS["preadv"], SYS["preadv2"]):
+            if args[0] in self._vfds:
+                if num != SYS["readv"]:
+                    # positioned io on an unseekable emulated descriptor
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ESPIPE)
+                    return False
+                return self._handle_readv(args)
+            if self._stdio_target(args[0]) is not None:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)  # write-only
+                return False
+            self.ipc.reply(MSG_SYSCALL_NATIVE)  # regular-file uio
+            return False
+        if num in (SYS["pwritev"], SYS["pwritev2"]):
+            if args[0] in self._vfds:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ESPIPE)
+                return False
+            tgt = self._stdio_target(args[0])
+            if tgt is None:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+                return False
+            # pwritev on captured stdio: treat as a plain gather write
+            data = self._gather_write(cpid, SYS["writev"], args)
+            (self.stdout if tgt == 1 else self.stderr).append(data)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
+            return False
+        if num in (SYS["sendmsg"], SYS["recvmsg"], SYS["sendmmsg"],
+                   SYS["recvmmsg"]):
+            return self._handle_msg(num, args)
+        if num in (SYS["select"], SYS["pselect6"]):
+            return self._handle_select(num, args)
+        if num == SYS["socketpair"]:
+            return self._handle_socketpair(args)
+        if num == SYS["execve"]:
+            return self._handle_execve(args)
+        if num == SYS["ioctl"] and args[0] in self._vfds:
+            return self._handle_vfd_ioctl(args)
+        if num == SYS["sysinfo"]:
+            # deterministic machine facts (reference handler sysinfo arm):
+            # uptime = simulated seconds, fixed 8 GiB RAM half free
+            now_s = self.host.now() // NS_PER_SEC
+            gib = 1 << 30
+            buf = struct.pack(
+                "<q3Q6QHH4x2QI", now_s, 0, 0, 0, 8 * gib, 4 * gib, 0, 0, 0, 0,
+                len(self.host.processes) & 0xFFFF, 0, 0, 0, 1,
+            )
+            try:
+                _vm_write(cpid, args[0], buf.ljust(112, b"\0"))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SYS["getrusage"]:
+            # deterministic: zero cpu times, fixed maxrss (reference
+            # handler/resource.rs returns plausible-but-deterministic data)
+            try:
+                _vm_write(cpid, args[1], struct.pack(
+                    "<4q14q", 0, 0, 0, 0, 10240, *([0] * 13)))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num in (SYS["getpgid"], SYS["getpgrp"], SYS["getsid"]):
+            # single-session model: every process leads its own group
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self.pid)
+            return False
+        if num in (SYS["setpgid"], SYS["setsid"]):
+            self.ipc.reply(
+                MSG_SYSCALL_COMPLETE,
+                0 if num == SYS["setpgid"] else self.pid,
+            )
             return False
         if num in _NATIVE_OK:
             self.ipc.reply(MSG_SYSCALL_NATIVE)
@@ -1331,13 +1421,14 @@ class NativeProcess:
             thr.wake.append((None, token))
             return True  # parked
 
-        if num in (SYS["write"], SYS["writev"]) and (
+        if num in (SYS["write"], SYS["writev"]) and args[0] not in self._vfds and (
             args[0] in (1, 2) or args[0] in self._stdio_dups
         ):
+            # (a vfd dup2()d over fd 1/2 shadows the captured stdio)
             if num == SYS["writev"] and args[2] > IOV_MAX:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
                 return False
-            tgt = args[0] if args[0] in (1, 2) else self._stdio_dups[args[0]]
+            tgt = self._stdio_target(args[0])
             data = self._gather_write(cpid, num, args)
             (self.stdout if tgt == 1 else self.stderr).append(data)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
@@ -1769,6 +1860,584 @@ class NativeProcess:
             self._block_on(watch, num, args,
                            timeout_ns=self._cur.poll_deadline - now)
         return True
+
+    # ---- uio / msg / select / dup2 / socketpair / exec ---------------------
+    # (reference: handler/uio.c, select.c, unistd.c dup arms, socket/unix.rs
+    # socketpair, and the execve arm at handler/mod.rs:401)
+
+    def _stdio_target(self, fd: int) -> int | None:
+        """Resolve a fd to its captured-stdio target (1|2) or None. The dup
+        table wins over the well-known numbers so `dup2(1, 2)` (2>&1) really
+        redirects fd 2's writes into the stdout buffer."""
+        tgt = self._stdio_dups.get(fd)
+        if tgt is not None:
+            return tgt
+        return fd if fd in (1, 2) else None
+
+    def _share_vfd(self, old: int, new: int) -> int:
+        """Point `new` at `old`'s emulated descriptor: shared object,
+        refcounted so close() of either fd keeps the other alive.
+        NOTE: status flags are per-fd here (the kernel shares them via the
+        open file description); acceptable deviation — apps set O_NONBLOCK
+        right after socket()/accept4 and before dup'ing."""
+        sock = self._vfds[old]
+        sock._nrefs = getattr(sock, "_nrefs", 1) + 1
+        self._vfds[new] = sock
+        self._vfd_flags[new] = self._vfd_flags.get(old, 0)
+        return new
+
+    def _dup_vfd(self, old: int) -> int:
+        nfd = self._next_vfd
+        self._next_vfd += 1
+        return self._share_vfd(old, nfd)
+
+    def _close_virtual(self, fd: int):
+        """Silently drop whatever virtual thing occupies `fd` (dup2 target
+        semantics: the previous descriptor is implicitly closed)."""
+        if fd in self._vfds:
+            sock = self._vfds.pop(fd)
+            self._vfd_flags.pop(fd, None)
+            self._drop_vfd(sock)
+        self._stdio_dups.pop(fd, None)
+
+    def _handle_dup2(self, num: int, args: list[int]) -> bool:
+        old, new = args[0], args[1]
+        if num == SYS["dup3"] and old == new:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+        if old in self._vfds:
+            if old == new:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, new)
+                return False
+            self._close_virtual(new)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self._share_vfd(old, new))
+            return False
+        tgt = self._stdio_target(old)
+        if tgt is not None:
+            if old == new:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, new)
+                return False
+            self._close_virtual(new)
+            self._stdio_dups[new] = tgt
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, new)
+            return False
+        # real-file dup2: pass through — but dup2 implicitly closes the
+        # target, so any virtual thing occupying that number must die too,
+        # or the stale vfd would shadow the freshly dup'ed kernel fd
+        self._close_virtual(new)
+        self.ipc.reply(MSG_SYSCALL_NATIVE)
+        return False
+
+    def _read_iovs(self, cpid: int, iov_ptr: int, iovcnt: int):
+        iovcnt = min(iovcnt, IOV_MAX)
+        raw = _vm_read(cpid, iov_ptr, iovcnt * 16)
+        return [struct.unpack_from("<QQ", raw, i * 16)
+                for i in range(len(raw) // 16)]
+
+    def _scatter(self, cpid: int, iovs, data: bytes) -> int:
+        off = 0
+        for base, ln in iovs:
+            if off >= len(data):
+                break
+            chunk = data[off: off + ln]
+            _vm_write(cpid, base, chunk)
+            off += len(chunk)
+        return off
+
+    def _handle_readv(self, args: list[int]) -> bool:
+        from shadow_tpu.host.filestate import FileState
+
+        cpid = self._child.pid
+        f = self._vfds.get(args[0])
+        if f is None:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+        try:
+            iovs = self._read_iovs(cpid, args[1], args[2])
+        except OSError:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+            return False
+        total = min(sum(ln for _, ln in iovs), 1 << 20)
+        try:
+            data = f.read(total)
+        except (ConnectionResetError, BrokenPipeError):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -ECONNRESET)
+            return False
+        except OSError as e:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+            return False
+        if data is None:
+            if self._nonblock(args[0]):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                return False
+            self._block_on(
+                [(f, FileState.READABLE | FileState.ACCEPTABLE
+                  | FileState.HUP | FileState.ERROR | FileState.CLOSED)],
+                SYS["readv"], args,
+            )
+            return True
+        try:
+            n = self._scatter(cpid, iovs, data)
+        except OSError:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+            return False
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+        return False
+
+    # msghdr (x86-64): name(8) namelen(4+4pad) iov(8) iovlen(8) control(8)
+    # controllen(8) flags(4+4pad) = 56 bytes; mmsghdr adds u32 msg_len(+pad)
+    _MSGHDR_FMT = "<QI4xQQQQi4x"
+    _MSGHDR_SIZE = 56
+    _MMSGHDR_STRIDE = 64
+
+    def _read_msghdr(self, cpid: int, ptr: int):
+        raw = _vm_read(cpid, ptr, self._MSGHDR_SIZE)
+        if len(raw) < self._MSGHDR_SIZE:
+            return None
+        name, namelen, iov, iovlen, control, controllen, flags = (
+            struct.unpack(self._MSGHDR_FMT, raw)
+        )
+        return name, namelen, iov, iovlen
+
+    def _do_send(self, sock, data: bytes, addr):
+        """Returns bytes sent or None = would-block; raises OSError."""
+        from shadow_tpu.host.sockets import UdpSocket
+
+        if isinstance(sock, UdpSocket):
+            return sock.sendto(data, addr)
+        return sock.write(data)
+
+    def _do_recv(self, sock, total: int):
+        """Returns (data, addr|None) or None = would-block."""
+        from shadow_tpu.host.sockets import UdpSocket
+
+        if isinstance(sock, UdpSocket):
+            r = sock.recvfrom(total)
+            return None if r is None else r
+        data = sock.read(total)
+        return None if data is None else (data, None)
+
+    def _handle_msg(self, num: int, args: list[int]) -> bool:
+        from shadow_tpu.host.filestate import FileState
+
+        cpid = self._child.pid
+        S = SYS
+        sock = self._vfds.get(args[0])
+        if sock is None:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+        single = num in (S["sendmsg"], S["recvmsg"])
+        sending = num in (S["sendmsg"], S["sendmmsg"])
+        vlen = 1 if single else min(args[2], 64)
+        wait_r = (FileState.READABLE | FileState.HUP | FileState.ERROR
+                  | FileState.CLOSED)
+        wait_w = FileState.WRITABLE | FileState.ERROR | FileState.CLOSED
+        done = 0
+        for i in range(vlen):
+            mptr = args[1] + (0 if single else i * self._MMSGHDR_STRIDE)
+            try:
+                hdr = self._read_msghdr(cpid, mptr)
+            except OSError:
+                hdr = None
+            if hdr is None:
+                if done:
+                    break
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            name, namelen, iov_ptr, iovlen = hdr
+            try:
+                iovs = self._read_iovs(cpid, iov_ptr, iovlen)
+            except OSError:
+                iovs = []
+            if sending:
+                try:
+                    data = bytearray()
+                    for base, ln in iovs:
+                        data += _vm_read(cpid, base, min(ln, 1 << 20))
+                    addr = None
+                    if name and namelen >= 8:
+                        addr = _parse_sockaddr_in(_vm_read(cpid, name, 16))
+                except OSError:
+                    if done:
+                        break
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+                try:
+                    n = self._do_send(sock, bytes(data), addr)
+                except (ConnectionResetError, BrokenPipeError):
+                    if done:
+                        break
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -ECONNRESET)
+                    return False
+                except OSError as e:
+                    if done:
+                        break
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+                    return False
+                if n is None:  # would block
+                    if done:
+                        break
+                    if self._nonblock(args[0]):
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                        return False
+                    self._block_on([(sock, wait_w)], num, args)
+                    return True
+                if single:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+                    return False
+                try:
+                    _vm_write(cpid, mptr + self._MSGHDR_SIZE,
+                              struct.pack("<I", n))
+                except OSError:
+                    pass
+                done += 1
+            else:
+                total = min(sum(ln for _, ln in iovs), 1 << 20)
+                try:
+                    r = self._do_recv(sock, total)
+                except (ConnectionResetError, BrokenPipeError):
+                    if done:
+                        break
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -ECONNRESET)
+                    return False
+                except OSError as e:
+                    if done:
+                        break
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+                    return False
+                if r is None:
+                    if done:
+                        break
+                    if self._nonblock(args[0]):
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                        return False
+                    self._block_on([(sock, wait_r)], num, args)
+                    return True
+                data, addr = r
+                # the payload is consumed at this point: out-param faults
+                # degrade to partial writes instead of losing the syscall
+                n = 0
+                try:
+                    n = self._scatter(cpid, iovs, data)
+                    # peer name (value-result via the namelen field), no
+                    # control data, no flags
+                    if name and addr is not None:
+                        sa = _build_sockaddr_in(addr[0], addr[1])
+                        _vm_write(cpid, name, sa[: min(namelen, len(sa))])
+                        _vm_write(cpid, mptr + 8, struct.pack("<I", len(sa)))
+                    _vm_write(cpid, mptr + 40, struct.pack("<Q", 0))
+                    _vm_write(cpid, mptr + 48, struct.pack("<i", 0))
+                except OSError:
+                    pass
+                if single:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+                    return False
+                try:
+                    _vm_write(cpid, mptr + self._MSGHDR_SIZE,
+                              struct.pack("<I", n))
+                except OSError:
+                    pass
+                done += 1
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, done)
+        return False
+
+    def _handle_select(self, num: int, args: list[int]) -> bool:
+        """select/pselect6 over emulated vfds (reference handler/select.c).
+        Real kernel fds in the sets are never ready (same policy as poll);
+        the pselect sigmask is ignored (signals are emulated and delivered
+        at syscall boundaries anyway)."""
+        from shadow_tpu.host.filestate import FileState
+
+        cpid = self._child.pid
+        nfds = min(max(args[0], 0), 1024)
+        nbytes = (nfds + 7) // 8
+        bits = []
+        for ptr in (args[1], args[2], args[3]):
+            if ptr and nbytes:
+                try:
+                    raw = _vm_read(cpid, ptr, nbytes)
+                except OSError:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+                bits.append(int.from_bytes(raw, "little"))
+            else:
+                bits.append(0)
+        rbits, wbits, ebits = bits
+        timeout_ns = None
+        if args[4]:
+            try:
+                raw = _vm_read(cpid, args[4], 16)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            if len(raw) == 16:
+                s, frac = struct.unpack("<qq", raw)
+                timeout_ns = s * NS_PER_SEC + (
+                    frac * 1000 if num == SYS["select"] else frac
+                )
+        out_r = out_w = out_e = 0
+        watch = []
+        for fd in range(nfds):
+            m = 1 << fd
+            want_r, want_w, want_e = rbits & m, wbits & m, ebits & m
+            if not (want_r or want_w or want_e):
+                continue
+            sock = self._vfds.get(fd)
+            if sock is None:
+                continue  # real kernel fd: not pollable here
+            st = sock.state
+            if want_r and st & (
+                FileState.READABLE | FileState.ACCEPTABLE
+                | FileState.HUP | FileState.CLOSED
+            ):
+                out_r |= m
+            if want_w and st & FileState.WRITABLE:
+                out_w |= m
+            if want_e and st & FileState.ERROR:
+                out_e |= m
+            mask = FileState.ERROR | FileState.CLOSED
+            if want_r:
+                mask |= (FileState.READABLE | FileState.ACCEPTABLE
+                         | FileState.HUP)
+            if want_w:
+                mask |= FileState.WRITABLE
+            watch.append((sock, mask))
+
+        def writeback():
+            try:
+                for ptr, val in ((args[1], out_r), (args[2], out_w),
+                                 (args[3], out_e)):
+                    if ptr and nbytes:
+                        _vm_write(cpid, ptr, val.to_bytes(nbytes, "little"))
+            except OSError:
+                return False
+            return True
+
+        ready = (bin(out_r).count("1") + bin(out_w).count("1")
+                 + bin(out_e).count("1"))
+        now = self.host.now()
+        if ready:
+            self._cur.poll_deadline = None
+            ok = writeback()
+            self.ipc.reply(MSG_SYSCALL_COMPLETE,
+                           ready if ok else -errno.EFAULT)
+            return False
+        if timeout_ns == 0 or (
+            self._cur.poll_deadline is not None
+            and now >= self._cur.poll_deadline
+        ):
+            self._cur.poll_deadline = None
+            ok = writeback()  # all-zero sets
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0 if ok else -errno.EFAULT)
+            return False
+        if not watch and timeout_ns is None:
+            self._die(99)  # infinite select on nothing we can ever signal
+            return True
+        if timeout_ns is None:
+            self._block_on(watch, num, args)
+        else:
+            if self._cur.poll_deadline is None:
+                self._cur.poll_deadline = now + timeout_ns
+            self._block_on(watch, num, args,
+                           timeout_ns=self._cur.poll_deadline - now)
+        return True
+
+    def _handle_socketpair(self, args: list[int]) -> bool:
+        from shadow_tpu.host.unix import UnixStreamSocket
+
+        domain, typ = args[0], args[1]
+        if domain != AF_UNIX:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAFNOSUPPORT)
+            return False
+        if typ & SOCK_TYPE_MASK != SOCK_STREAM:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EOPNOTSUPP)
+            return False
+        a, b = UnixStreamSocket.make_pair()
+        fds = []
+        for s in (a, b):
+            fd = self._next_vfd
+            self._next_vfd += 1
+            self._vfds[fd] = s
+            if typ & SOCK_NONBLOCK:
+                self._vfd_flags[fd] = 0x800
+            fds.append(fd)
+        try:
+            _vm_write(self._child.pid, args[3], struct.pack("<ii", *fds))
+        except OSError:
+            for fd in fds:
+                self._close_virtual(fd)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+            return False
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+        return False
+
+    def _bytes_avail(self, sock) -> int:
+        from shadow_tpu.host.filestate import FileState
+        from shadow_tpu.host.pipe import StreamEnd
+        from shadow_tpu.host.sockets import TcpSocket, UdpSocket
+
+        if isinstance(sock, UdpSocket):
+            return len(sock._rcv[0][2]) if sock._rcv else 0
+        if isinstance(sock, TcpSocket):
+            return int(sock.tcp.rcv_buf.readable())
+        if isinstance(sock, StreamEnd) and sock._rx is not None:
+            return len(sock._rx.data)
+        return 8 if sock.state & FileState.READABLE else 0
+
+    def _handle_vfd_ioctl(self, args: list[int]) -> bool:
+        sock = self._vfds[args[0]]
+        req = args[1]
+        if req == FIONREAD:
+            try:
+                _vm_write(self._child.pid, args[2],
+                          struct.pack("<i", self._bytes_avail(sock)))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if req == FIONBIO:
+            try:
+                raw = _vm_read(self._child.pid, args[2], 4)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            v = struct.unpack("<i", raw)[0] if len(raw) == 4 else 0
+            flags = self._vfd_flags.get(args[0], 0)
+            self._vfd_flags[args[0]] = (
+                flags | 0x800 if v else flags & ~0x800
+            )
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOTTY)
+        return False
+
+    def _read_cstr(self, cpid: int, addr: int, maxlen: int = 4096) -> bytes:
+        """NUL-terminated string read that never crosses an unmapped page
+        (process_vm_readv is all-or-nothing per iovec on fault)."""
+        out = bytearray()
+        while len(out) < maxlen:
+            chunk = min(4096 - (addr & 0xFFF), maxlen - len(out))
+            raw = _vm_read(cpid, addr, chunk)
+            if not raw:
+                break
+            i = raw.find(b"\0")
+            if i >= 0:
+                out += raw[:i]
+                return bytes(out)
+            out += raw
+            addr += len(raw)
+        return bytes(out)
+
+    def _read_cstr_array(self, cpid: int, ptr: int) -> list[str]:
+        out = []
+        for i in range(512):
+            raw = _vm_read(cpid, ptr + i * 8, 8)
+            if len(raw) < 8:
+                break
+            p = struct.unpack("<Q", raw)[0]
+            if p == 0:
+                break
+            out.append(
+                self._read_cstr(cpid, p).decode("utf-8", "surrogateescape")
+            )
+        return out
+
+    def _handle_execve(self, args: list[int]) -> bool:
+        """execve: replace the native child with a freshly spawned process
+        image, exactly like the reference — which SIGKILLs the old native
+        process and posix_spawns the target under a new ManagedThread
+        (process.rs:1680-1725 update_for_exec) rather than letting the old
+        image exec in place (the inherited seccomp filter would kill the
+        new image before the shim constructor could install its handler).
+
+        Virtual state survives per execve(2): vfds (no CLOEXEC emulation —
+        our emulated descriptors are never close-on-exec), pending itimers,
+        captured-stdio buffers, virtual pid, parent/children links. Signal
+        dispositions reset to default. Natively-opened regular files of the
+        old image are lost (deviation: the kernel would keep them; our
+        passthrough files live in the dead process's fd table)."""
+        cpid = self._child.pid
+        try:
+            path = self._read_cstr(cpid, args[0]).decode(
+                "utf-8", "surrogateescape"
+            )
+            argv = self._read_cstr_array(cpid, args[1]) if args[1] else []
+            envp = self._read_cstr_array(cpid, args[2]) if args[2] else []
+        except OSError:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+            return False
+        # resolve relative paths against the CALLER'S cwd (chdir is native,
+        # so the child's cwd can differ from the simulator's)
+        try:
+            child_cwd = os.readlink(f"/proc/{cpid}/cwd")
+        except OSError:
+            child_cwd = os.getcwd()
+        if not os.path.isabs(path):
+            path = os.path.join(child_cwd, path)
+        # preflight the failure modes execve(2) documents so a doomed exec
+        # errors in the OLD image instead of killing the process
+        # (managed_thread.rs:556-577 does the same preemptive checks)
+        if not path or not os.path.exists(path):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOENT)
+            return False
+        if os.path.isdir(path) or not os.access(path, os.X_OK):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EACCES)
+            return False
+        if self.strace is not None:
+            self.strace(self.host.now(), self.pid, "execve",
+                        (path, len(argv), len(envp)), None)
+        # spawn the new image FIRST (fresh IPC block, the CALLER'S envp plus
+        # the simulator plumbing): a spawn failure — e.g. ENOEXEC for a bad
+        # binary format the preflight can't see — must error in the OLD
+        # image, which is still alive and blocked on this syscall
+        new_ipc = IpcBlock()
+        env = {}
+        for kv in envp:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        env["LD_PRELOAD"] = shim_path()
+        env["SHADOW_SHM_PATH"] = new_ipc.path
+        new_ipc.set_time(self.host.now())
+        hcfg = self.host.cfg
+        if hcfg.model_unblocked_latency:
+            new_ipc.set_flags((hcfg.unblocked_syscall_limit << 1) | 1)
+        try:
+            new_child = subprocess.Popen(
+                argv or [path], executable=path, env=env, cwd=child_cwd,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                stdin=subprocess.DEVNULL,
+            )
+        except OSError as e:
+            new_ipc.close()
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -(e.errno or errno.ENOEXEC))
+            return False
+        # point of no return: tear down the old native process (threads die
+        # with it, per exec) and swap the new image in
+        self._clear_wake()
+        self.ipc.close()
+        old = self._child
+        old.kill()
+        old.wait()
+        self.threads = {0: _Thread(0, self.pid)}
+        self.threads[0].state = "running"
+        self._runner = self._cur = self.threads[0]
+        self._next_slot = 1
+        self._free_slots = []
+        self._clone_busy = False
+        self._clone_queue = []
+        self._futexes = {}
+        self._sigactions = {}  # exec resets caught signals to default
+        self._sig_pending = []
+        self.argv = argv or [path]
+        self.ipc = new_ipc
+        self._child = new_child
+        msg = self.ipc.recv_any(timeout_s=10.0)
+        if msg is None or msg[0] != MSG_START:
+            self._die(97)
+            return True
+        self.ipc.reply_slot(0, MSG_START_OK)
+        return False  # service loop continues with the new image
 
     def _handle_epoll(self, num: int, args: list[int]) -> bool:
         """epoll/timerfd/eventfd for real binaries, backed by the host-plane
