@@ -1,0 +1,63 @@
+/* Minimal deterministic HTTP/1.0 server for third-party-client tests:
+ * serves `nbytes` of a repeating pattern to `nconns` connections, then
+ * exits. The interesting binary in these tests is the CLIENT (unmodified
+ * curl/wget from the distro); this side only has to speak enough HTTP.
+ * (Reference analogue: examples/apps http servers used to prove real
+ * applications run under the simulator.) */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    int port = argc > 1 ? atoi(argv[1]) : 8080;
+    long nbytes = argc > 2 ? atol(argv[2]) : 65536;
+    int nconns = argc > 3 ? atoi(argv[3]) : 1;
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(port);
+    if (bind(fd, (struct sockaddr *)&a, sizeof a)) { perror("bind"); return 1; }
+    if (listen(fd, 8)) { perror("listen"); return 1; }
+    for (int c = 0; c < nconns; c++) {
+        int s = accept(fd, NULL, NULL);
+        if (s < 0) { perror("accept"); return 1; }
+        char req[4096];
+        ssize_t n = 0, got;
+        /* read until blank line (HTTP request end) */
+        while ((got = read(s, req + n, sizeof req - 1 - (size_t)n)) > 0) {
+            n += got;
+            req[n] = 0;
+            if (strstr(req, "\r\n\r\n") || strstr(req, "\n\n"))
+                break;
+        }
+        if (n <= 0) { fprintf(stderr, "empty request\n"); return 1; }
+        char hdr[256];
+        int hl = snprintf(hdr, sizeof hdr,
+                          "HTTP/1.0 200 OK\r\n"
+                          "Content-Type: application/octet-stream\r\n"
+                          "Content-Length: %ld\r\n"
+                          "Connection: close\r\n\r\n",
+                          nbytes);
+        if (write(s, hdr, (size_t)hl) != hl) { perror("write hdr"); return 1; }
+        char block[4096];
+        for (int i = 0; i < (int)sizeof block; i++)
+            block[i] = (char)('A' + (i % 26));
+        long left = nbytes;
+        while (left > 0) {
+            size_t w = left > (long)sizeof block ? sizeof block : (size_t)left;
+            ssize_t wr = write(s, block, w);
+            if (wr < 0) { perror("write body"); return 1; }
+            left -= wr;
+        }
+        close(s);
+        printf("served %ld bytes (conn %d)\n", nbytes, c);
+        fflush(stdout);
+    }
+    printf("httpd done\n");
+    return 0;
+}

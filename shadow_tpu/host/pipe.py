@@ -89,6 +89,16 @@ class StreamEnd(File):
             return b""  # EOF
         return None  # would block
 
+    def peek(self, n: int) -> bytes | None:
+        """MSG_PEEK: same result contract as read() without consuming."""
+        if self._rx is None:
+            raise OSError("EBADF: not readable")
+        if self._rx.data:
+            return bytes(self._rx.data[:n])
+        if self._rx.writers == 0:
+            return b""  # EOF
+        return None  # would block
+
     def write(self, data: bytes) -> int | None:
         if self._tx is None:
             raise OSError("EBADF: not writable")

@@ -113,6 +113,13 @@ class UdpSocket(_SocketBase):
             self._set_state(off=FileState.READABLE)
         return data[:n], (src_ip, src_port)
 
+    def peekfrom(self, n: int) -> tuple[bytes, tuple[str, int]] | None:
+        """MSG_PEEK: next datagram without popping it."""
+        if not self._rcv:
+            return None
+        src_ip, src_port, data = self._rcv[0]
+        return data[:n], (src_ip, src_port)
+
     def read(self, n: int) -> bytes | None:
         r = self.recvfrom(n)
         return None if r is None else r[0]
@@ -167,6 +174,21 @@ class TcpSocket(_SocketBase):
         out = self.tcp.recv(n)
         self._after_tcp()
         return out
+
+    def peek(self, n: int) -> bytes | None:
+        """MSG_PEEK: read() contract (None=block, b''=EOF) w/o consuming.
+        Real clients (wget's persistent-connection probe) peek response
+        headers before reading them."""
+        buf = self.tcp.rcv_buf
+        if buf.readable():
+            return bytes(buf._ready[:n])
+        if self.tcp.rcv_fin_seen or self.tcp.error is not None:
+            return b""
+        from shadow_tpu.tcp import State as TS
+
+        if self.tcp.state in (TS.CLOSED, TS.LISTEN):
+            return b""
+        return None
 
     def shutdown_write(self):
         self.tcp.shutdown_write(self.host.now())

@@ -395,6 +395,7 @@ SOCK_STREAM = 1
 SOCK_DGRAM = 2
 FIONREAD = 0x541B
 FIONBIO = 0x5421
+MSG_PEEK = 0x2
 F_DUPFD = 0
 F_GETFD = 1
 F_SETFD = 2
@@ -2007,14 +2008,14 @@ class NativeProcess:
             return sock.sendto(data, addr)
         return sock.write(data)
 
-    def _do_recv(self, sock, total: int):
+    def _do_recv(self, sock, total: int, peek: bool = False):
         """Returns (data, addr|None) or None = would-block."""
         from shadow_tpu.host.sockets import UdpSocket
 
         if isinstance(sock, UdpSocket):
-            r = sock.recvfrom(total)
+            r = sock.peekfrom(total) if peek else sock.recvfrom(total)
             return None if r is None else r
-        data = sock.read(total)
+        data = sock.peek(total) if peek else sock.read(total)
         return None if data is None else (data, None)
 
     def _handle_msg(self, num: int, args: list[int]) -> bool:
@@ -2093,8 +2094,11 @@ class NativeProcess:
                 done += 1
             else:
                 total = min(sum(ln for _, ln in iovs), 1 << 20)
+                peek = bool(
+                    (args[2] if single else args[3]) & MSG_PEEK
+                )
                 try:
-                    r = self._do_recv(sock, total)
+                    r = self._do_recv(sock, total, peek)
                 except (ConnectionResetError, BrokenPipeError):
                     if done:
                         break
@@ -2767,8 +2771,10 @@ class NativeProcess:
             wait_mask = (
                 FileState.READABLE | FileState.HUP | FileState.ERROR | FileState.CLOSED
             )
+            peek = bool(args[3] & MSG_PEEK)
             if isinstance(sock, UdpSocket):
-                r = sock.recvfrom(min(args[2], 1 << 20))
+                n_req = min(args[2], 1 << 20)
+                r = sock.peekfrom(n_req) if peek else sock.recvfrom(n_req)
                 if r is None:
                     if self._nonblock(fd):
                         reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
@@ -2782,7 +2788,8 @@ class NativeProcess:
                 )
                 reply(MSG_SYSCALL_COMPLETE, len(data))
                 return False
-            data = sock.read(min(args[2], 1 << 20))
+            n_req = min(args[2], 1 << 20)
+            data = sock.peek(n_req) if peek else sock.read(n_req)
             if data is None:
                 if self._nonblock(fd):
                     reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
@@ -2814,8 +2821,38 @@ class NativeProcess:
             reply(MSG_SYSCALL_COMPLETE, 0)
             return False
 
-        if num in (S["setsockopt"], S["getsockopt"]):
+        if num == S["setsockopt"]:
             reply(MSG_SYSCALL_COMPLETE, 0)  # accepted and ignored
+            return False
+
+        if num == S["getsockopt"]:
+            # real clients read these out-params; SO_ERROR especially is the
+            # async-connect completion check (curl/wget poll for writable
+            # then read SO_ERROR) — leaving it unwritten feeds them garbage
+            SOL_SOCKET = 1
+            SO_ERROR, SO_TYPE, SO_SNDBUF, SO_RCVBUF = 4, 3, 7, 8
+            SO_ACCEPTCONN = 30
+            val = 0
+            if args[1] == SOL_SOCKET:
+                if args[2] == SO_ERROR:
+                    # same failure signal the blocking-connect path reports
+                    err = getattr(getattr(sock, "tcp", None), "error", None)
+                    val = errno.ECONNREFUSED if err is not None else 0
+                elif args[2] == SO_TYPE:
+                    val = SOCK_DGRAM if isinstance(sock, UdpSocket) else SOCK_STREAM
+                elif args[2] in (SO_SNDBUF, SO_RCVBUF):
+                    val = 256 * 1024
+                elif args[2] == SO_ACCEPTCONN:
+                    val = 1 if isinstance(sock, TcpListenerSocket) else 0
+            try:
+                if args[3]:
+                    _vm_write(cpid, args[3], struct.pack("<i", val))
+                if args[4]:
+                    _vm_write(cpid, args[4], struct.pack("<I", 4))
+            except OSError:
+                reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            reply(MSG_SYSCALL_COMPLETE, 0)
             return False
 
         reply(MSG_SYSCALL_COMPLETE, -EINVAL)
