@@ -59,6 +59,13 @@ typedef struct {
 
 #define IPC_MAX_THREADS 32
 
+/* Custom simulator syscalls, far above the real syscall table (reference
+ * custom syscalls shadow_yield / shadow_hostname_to_addr_ipv4,
+ * handler/mod.rs:333-337). Issued by shim interposers via syscall(2);
+ * seccomp traps and forwards them like any other number. */
+#define SHADOW_SYS_RESOLVE 1000001 /* (name cstr ptr, u32be out ptr) -> 0|-errno */
+#define SHADOW_SYS_SELF_IP 1000002 /* (u32be out ptr) -> 0 */
+
 typedef struct {
     ShimChan to_shadow;
     ShimChan to_shim;

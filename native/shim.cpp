@@ -714,6 +714,236 @@ extern "C" void RAND_add(const void *buf, int num, double entropy) {
     (void)entropy;
 }
 
+/* ------------------------------------------------- addrinfo / ifaddrs
+ *
+ * glibc's getaddrinfo reads the REAL /etc/hosts + resolver config through
+ * NSS (all file reads are native passthrough), so a simulated hostname can
+ * never resolve through it. These interposers answer from the simulator's
+ * DNS registry via the SHADOW_SYS_RESOLVE custom syscall instead.
+ * Reference: src/lib/shim/shim_api_addrinfo.c (453 LoC) + shim_api_ifaddrs.c.
+ * Normal library context (not a signal handler): malloc/dlsym are fine. */
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <ifaddrs.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+
+static int parse_ipv4(const char *s, uint32_t *out_be) {
+    unsigned a, b, c, d;
+    char tail;
+    if (sscanf(s, "%u.%u.%u.%u%c", &a, &b, &c, &d, &tail) != 4)
+        return -1;
+    if (a > 255 || b > 255 || c > 255 || d > 255)
+        return -1;
+    *out_be = htonl((a << 24) | (b << 16) | (c << 8) | d);
+    return 0;
+}
+
+static int resolve_port(const char *service, const struct addrinfo *hints,
+                        int *port_out) {
+    if (!service) {
+        *port_out = 0;
+        return 0;
+    }
+    char *end = nullptr;
+    long p = strtol(service, &end, 10);
+    if (end && *end == 0 && p >= 0 && p <= 65535) {
+        *port_out = (int)p;
+        return 0;
+    }
+    if (hints && (hints->ai_flags & AI_NUMERICSERV))
+        return EAI_NONAME;
+    static const struct { const char *name; int port; } WELL_KNOWN[] = {
+        {"http", 80}, {"https", 443}, {"ftp", 21}, {"ssh", 22},
+        {"domain", 53}, {"telnet", 23}, {"smtp", 25},
+    };
+    for (const auto &w : WELL_KNOWN) {
+        if (!strcmp(service, w.name)) {
+            *port_out = w.port;
+            return 0;
+        }
+    }
+    return EAI_SERVICE;
+}
+
+static struct addrinfo *mk_ai(int socktype, int protocol, uint32_t addr_be,
+                              int port, const char *canon) {
+    auto *ai = (struct addrinfo *)calloc(1, sizeof(struct addrinfo));
+    auto *sa = (struct sockaddr_in *)calloc(1, sizeof(struct sockaddr_in));
+    if (!ai || !sa) {
+        free(ai);
+        free(sa);
+        return nullptr;
+    }
+    sa->sin_family = AF_INET;
+    sa->sin_port = htons((uint16_t)port);
+    sa->sin_addr.s_addr = addr_be;
+    ai->ai_family = AF_INET;
+    ai->ai_socktype = socktype;
+    ai->ai_protocol = protocol;
+    ai->ai_addrlen = sizeof(struct sockaddr_in);
+    ai->ai_addr = (struct sockaddr *)sa;
+    if (canon)
+        ai->ai_canonname = strdup(canon);
+    return ai;
+}
+
+extern "C" int getaddrinfo(const char *node, const char *service,
+                           const struct addrinfo *hints,
+                           struct addrinfo **res) {
+    if (!g_ipc) { /* not under the simulator: defer to the real libc */
+        static int (*real)(const char *, const char *, const struct addrinfo *,
+                           struct addrinfo **) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "getaddrinfo");
+        return real ? real(node, service, hints, res) : EAI_SYSTEM;
+    }
+    if (hints && hints->ai_family != AF_UNSPEC && hints->ai_family != AF_INET)
+        return EAI_NONAME; /* simulated network is IPv4-only */
+    int port = 0;
+    int perr = resolve_port(service, hints, &port);
+    if (perr)
+        return perr;
+    uint32_t addr_be = 0;
+    if (!node) {
+        addr_be = (hints && (hints->ai_flags & AI_PASSIVE))
+                      ? htonl(INADDR_ANY)
+                      : htonl(INADDR_LOOPBACK);
+    } else if (parse_ipv4(node, &addr_be) != 0) {
+        if (!strcmp(node, "localhost")) {
+            addr_be = htonl(INADDR_LOOPBACK);
+        } else {
+            if (hints && (hints->ai_flags & AI_NUMERICHOST))
+                return EAI_NONAME;
+            long rc = syscall(SHADOW_SYS_RESOLVE, node, &addr_be);
+            if (rc != 0)
+                return EAI_NONAME;
+        }
+    }
+    int want = hints ? hints->ai_socktype : 0;
+    const char *canon =
+        (hints && (hints->ai_flags & AI_CANONNAME)) ? node : nullptr;
+    struct addrinfo *head = nullptr, **tail = &head;
+    struct {
+        int st, proto;
+    } kinds[2] = {{SOCK_STREAM, IPPROTO_TCP}, {SOCK_DGRAM, IPPROTO_UDP}};
+    for (const auto &k : kinds) {
+        if (want && want != k.st)
+            continue;
+        struct addrinfo *ai = mk_ai(k.st, k.proto, addr_be, port, canon);
+        if (!ai) {
+            if (head)
+                freeaddrinfo(head);
+            return EAI_MEMORY;
+        }
+        canon = nullptr; /* canonname only on the first entry, like glibc */
+        *tail = ai;
+        tail = &ai->ai_next;
+    }
+    if (!head)
+        return EAI_SOCKTYPE;
+    *res = head;
+    return 0;
+}
+
+extern "C" void freeaddrinfo(struct addrinfo *ai) {
+    while (ai) {
+        struct addrinfo *next = ai->ai_next;
+        free(ai->ai_addr);
+        free(ai->ai_canonname);
+        free(ai);
+        ai = next;
+    }
+}
+
+extern "C" struct hostent *gethostbyname(const char *name) {
+    static struct hostent he;
+    static struct in_addr haddr;
+    static char *addr_list[2];
+    static char hname[256];
+    if (!g_ipc) {
+        static struct hostent *(*real)(const char *) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "gethostbyname");
+        return real ? real(name) : nullptr;
+    }
+    uint32_t addr_be = 0;
+    if (!name)
+        return nullptr;
+    if (parse_ipv4(name, &addr_be) != 0) {
+        if (!strcmp(name, "localhost")) {
+            addr_be = htonl(INADDR_LOOPBACK);
+        } else if (syscall(SHADOW_SYS_RESOLVE, name, &addr_be) != 0) {
+            h_errno = HOST_NOT_FOUND;
+            return nullptr;
+        }
+    }
+    haddr.s_addr = addr_be;
+    addr_list[0] = (char *)&haddr;
+    addr_list[1] = nullptr;
+    strncpy(hname, name, sizeof hname - 1);
+    hname[sizeof hname - 1] = 0;
+    he.h_name = hname;
+    he.h_aliases = addr_list + 1; /* empty, NULL-terminated */
+    he.h_addrtype = AF_INET;
+    he.h_length = 4;
+    he.h_addr_list = addr_list;
+    return &he;
+}
+
+/* two interfaces, like every simulated host: lo + eth0 (reference
+ * namespace.rs builds exactly these) */
+extern "C" int getifaddrs(struct ifaddrs **ifap) {
+    if (!g_ipc) {
+        static int (*real)(struct ifaddrs **) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "getifaddrs");
+        return real ? real(ifap) : -1;
+    }
+    uint32_t self_be = 0;
+    syscall(SHADOW_SYS_SELF_IP, &self_be);
+    struct Blk {
+        struct ifaddrs ifa;
+        struct sockaddr_in addr, mask;
+        char name[8];
+    };
+    auto *lo = (Blk *)calloc(1, sizeof(Blk));
+    auto *eth = (Blk *)calloc(1, sizeof(Blk));
+    if (!lo || !eth) {
+        free(lo);
+        free(eth);
+        return -1;
+    }
+    auto fill = [](Blk *b, const char *nm, uint32_t addr_be, uint32_t mask_be,
+                   unsigned flags) {
+        strcpy(b->name, nm);
+        b->addr.sin_family = AF_INET;
+        b->addr.sin_addr.s_addr = addr_be;
+        b->mask.sin_family = AF_INET;
+        b->mask.sin_addr.s_addr = mask_be;
+        b->ifa.ifa_name = b->name;
+        b->ifa.ifa_flags = flags;
+        b->ifa.ifa_addr = (struct sockaddr *)&b->addr;
+        b->ifa.ifa_netmask = (struct sockaddr *)&b->mask;
+    };
+    /* IFF_UP|IFF_RUNNING (+IFF_LOOPBACK for lo) */
+    fill(lo, "lo", htonl(INADDR_LOOPBACK), htonl(0xff000000u), 0x49);
+    fill(eth, "eth0", self_be, htonl(0xffffff00u), 0x41);
+    lo->ifa.ifa_next = &eth->ifa;
+    *ifap = &lo->ifa;
+    return 0;
+}
+
+extern "C" void freeifaddrs(struct ifaddrs *ifa) {
+    while (ifa) {
+        struct ifaddrs *next = ifa->ifa_next;
+        free(ifa);
+        ifa = next;
+    }
+}
+
 /* -------------------------------------------------------------- seccomp */
 
 static int install_seccomp(void) {

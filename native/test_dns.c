@@ -1,0 +1,56 @@
+/* Hostname / interface identity under the shim: gethostname (via the
+ * virtualized uname), uname nodename, getaddrinfo + gethostbyname against
+ * the simulator DNS, getifaddrs (lo + eth0 with the simulated IP).
+ * Usage: test_dns <peer-hostname> */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <ifaddrs.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/utsname.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    const char *peer = argc > 1 ? argv[1] : "localhost";
+
+    char hn[256] = {0};
+    if (gethostname(hn, sizeof hn)) { perror("gethostname"); return 1; }
+    printf("hostname=%s\n", hn);
+
+    struct utsname u;
+    if (uname(&u)) { perror("uname"); return 1; }
+    printf("nodename=%s release=%s\n", u.nodename, u.release);
+
+    struct addrinfo hints = {0}, *res = NULL;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    int rc = getaddrinfo(peer, "http", &hints, &res);
+    if (rc != 0) { fprintf(stderr, "getaddrinfo: %s\n", gai_strerror(rc)); return 1; }
+    struct sockaddr_in *sa = (struct sockaddr_in *)res->ai_addr;
+    printf("gai %s -> %s:%d\n", peer, inet_ntoa(sa->sin_addr),
+           ntohs(sa->sin_port));
+    freeaddrinfo(res);
+
+    rc = getaddrinfo("no-such-host-xyz", NULL, &hints, &res);
+    printf("gai unknown -> %s\n", rc == 0 ? "RESOLVED?!" : "EAI_NONAME");
+
+    struct hostent *he = gethostbyname(peer);
+    if (!he) { fprintf(stderr, "gethostbyname failed\n"); return 1; }
+    printf("ghbn %s -> %s\n", peer,
+           inet_ntoa(*(struct in_addr *)he->h_addr_list[0]));
+
+    struct ifaddrs *ifa = NULL;
+    if (getifaddrs(&ifa)) { perror("getifaddrs"); return 1; }
+    for (struct ifaddrs *p = ifa; p; p = p->ifa_next) {
+        if (!p->ifa_addr || p->ifa_addr->sa_family != AF_INET)
+            continue;
+        printf("if %s %s\n", p->ifa_name,
+               inet_ntoa(((struct sockaddr_in *)p->ifa_addr)->sin_addr));
+    }
+    freeifaddrs(ifa);
+    printf("dns ok\n");
+    return 0;
+}

@@ -314,10 +314,20 @@ _NATIVE_OK = {
         "sigaltstack", "arch_prctl", "set_tid_address", "set_robust_list",
         "rseq", "prlimit64", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
-        "getdents64", "uname", "getuid", "getgid", "geteuid",
+        "getdents64", "getuid", "getgid", "geteuid",
         "getegid", "pipe2", "umask", "chdir", "fchdir",
     )
 }
+# NOTE: uname is NOT native — its nodename field would leak the real
+# machine's hostname (a determinism hole and wrong identity: glibc's
+# gethostname() is implemented via uname on Linux). It is emulated with the
+# simulated host's name instead.
+
+# custom simulator syscalls (native/ipc.h; reference handler/mod.rs:333-337)
+SHADOW_SYS_RESOLVE = 1000001
+SHADOW_SYS_SELF_IP = 1000002
+_N2NAME[SHADOW_SYS_RESOLVE] = "shadow_resolve"
+_N2NAME[SHADOW_SYS_SELF_IP] = "shadow_self_ip"
 # NOTE: futex is deliberately NOT native: a thread futex-blocking in the
 # kernel is invisible to the simulator (it never syscalls again), deadlocking
 # the one-runner-at-a-time scheduler — so futex is emulated (reference
@@ -1385,6 +1395,52 @@ class NativeProcess:
             try:
                 _vm_write(cpid, args[1], struct.pack(
                     "<4q14q", 0, 0, 0, 0, 10240, *([0] * 13)))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SYS["uname"]:
+            # virtualized: nodename is the SIMULATED host's name (glibc
+            # gethostname() reads it from here); fixed release/version so
+            # two runs on different machines behave identically
+            def field(s: str) -> bytes:
+                return s.encode()[:64].ljust(65, b"\0")
+
+            uts = (field("Linux") + field(self.host.cfg.name)
+                   + field("6.1.0-shadow") + field("#1 SMP")
+                   + field("x86_64") + field("(none)"))
+            try:
+                _vm_write(cpid, args[0], uts)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SHADOW_SYS_RESOLVE:
+            # shim getaddrinfo/gethostbyname: name -> IPv4 from the
+            # simulator DNS (reference shadow_hostname_to_addr_ipv4)
+            try:
+                name = self._read_cstr(cpid, args[0], 256).decode(
+                    "utf-8", "surrogateescape"
+                )
+                ip = self.host.resolve(name)
+                if ip is None:
+                    raise OSError("ENOENT: unknown host")
+                import socket as _socket
+
+                _vm_write(cpid, args[1], _socket.inet_aton(ip))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOENT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SHADOW_SYS_SELF_IP:
+            import socket as _socket
+
+            try:
+                _vm_write(cpid, args[0],
+                          _socket.inet_aton(self.host.cfg.ip))
             except OSError:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
                 return False

@@ -101,3 +101,36 @@ def test_curl_connection_refused():
     )
     net.run(20 * SEC)
     assert cli.exit_code == 7, (cli.exit_code, b"".join(cli.stderr))
+
+
+DNS_BIN = os.path.join(REPO, "native", "build", "test_dns")
+
+
+def test_hostname_identity_and_dns():
+    """gethostname/uname report the SIMULATED host name; getaddrinfo,
+    gethostbyname and getifaddrs answer from the simulator (reference
+    shim_api_addrinfo.c / shim_api_ifaddrs.c + dns.c)."""
+    hosts, net = two_hosts()
+    p = spawn_native(hosts[0], [DNS_BIN, "h1"])
+    net.run(2 * SEC)
+    assert p.exit_code == 0, b"".join(p.stderr)
+    out = b"".join(p.stdout).decode()
+    assert "hostname=h0" in out
+    assert "nodename=h0 release=6.1.0-shadow" in out
+    assert "gai h1 -> 10.0.0.2:80" in out
+    assert "gai unknown -> EAI_NONAME" in out
+    assert "ghbn h1 -> 10.0.0.2" in out
+    assert "if lo 127.0.0.1" in out
+    assert "if eth0 10.0.0.1" in out
+
+
+def test_curl_by_hostname():
+    """An unmodified curl resolves a simulated hostname end to end."""
+    hosts, net = two_hosts()
+    srv = spawn_native(hosts[0], [HTTPD, "8080", "9999", "1"])
+    cli = spawn_native(
+        hosts[1], [CURL, "-s", "http://h0:8080/x"], start_time=100 * MS
+    )
+    net.run(30 * SEC)
+    assert srv.exit_code == 0 and cli.exit_code == 0, b"".join(cli.stderr)
+    assert b"".join(cli.stdout) == _expected(9999)
