@@ -531,11 +531,17 @@ def _run_guarded_chunk(
     def cond(carry):
         stc, i = carry
         gmin = _pmin(jnp.min(_effective_next(cfg, stc)), axis)
+        probe = stop_probe(stc.model)
+        if axis:
+            # the probe sees only the LOCAL shard's model state; the loop
+            # decision must be global or shards exit at different rounds and
+            # the survivors deadlock in the next round's collectives
+            probe = lax.pmax(probe.astype(jnp.int32), axis) > 0
         return (
             (~stc.done)
             & (i < cfg.rounds_per_chunk)
             & (gmin < until)
-            & (~stop_probe(stc.model))
+            & (~probe)
         )
 
     def body(carry):

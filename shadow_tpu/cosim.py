@@ -56,7 +56,7 @@ from shadow_tpu.models.hybrid import (
     PW_SIZE,
 )
 from shadow_tpu.net.dns import Dns
-from shadow_tpu.obs import PcapWriter, PerfTimers, StraceLogger
+from shadow_tpu.obs import PcapWriter, PerfTimers, SimLogger, StraceLogger
 from shadow_tpu.ops import merge_flat_events, next_time, pack_order
 from shadow_tpu.programs import get_program
 from shadow_tpu.simtime import NS_PER_SEC, TIME_MAX
@@ -68,7 +68,13 @@ _BYTES_GC_WINDOWS = 1024  # sweep horizon for lost-packet payloads
 class HybridSimulation:
     """Config-driven co-simulation (CLI-compatible with `Simulation`)."""
 
-    def __init__(self, cfg: ConfigOptions, *, staging_cap: int = 4096):
+    def __init__(
+        self,
+        cfg: ConfigOptions,
+        *,
+        staging_cap: int = 4096,
+        world: int | None = None,
+    ):
         self.cfg = cfg
         self.graph = simmod.load_graph(cfg.network.graph)
         self.specs = simmod.expand_hosts_hybrid(cfg, self.graph)
@@ -77,12 +83,22 @@ class HybridSimulation:
         self.staging_cap = staging_cap
         self.model = HybridModel()
         ex = cfg.experimental
+        world = (
+            simmod.resolve_world(cfg.general.parallelism)
+            if world is None
+            else world
+        )
+        # device plane pads the host count to a multiple of the mesh size
+        # with inert hosts (same scheme as the modeled sim); the CPU plane
+        # only ever touches the real prefix
+        self._num_real = len(self.specs)
+        num_hosts = -(-self._num_real // world) * world
         # emulated TCP bursts land many events per host per window; keep the
         # per-host slab roomy (overflow is counted, never silent — see
         # stats_report queue_overflow_dropped)
         qcap = max(ex.event_queue_capacity, 256)
         self.engine_cfg = eng.EngineConfig(
-            num_hosts=len(self.specs),
+            num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
             bootstrap_end_time=cfg.general.bootstrap_end_time,
             runahead_floor=ex.runahead,
@@ -97,12 +113,17 @@ class HybridSimulation:
             # so it must be >= 1 or nothing would ever advance
             rounds_per_chunk=max(ex.rounds_per_chunk, 1),
             microstep_limit=ex.microstep_limit,
-            world=1,
+            world=world,
             shaping=any(
                 s.bw_up_bits > 0 or s.bw_down_bits > 0 for s in self.specs
             ),
         )
-        self.engine = Engine(self.engine_cfg, self.model, None)
+        self.mesh = None
+        if world > 1:
+            self.mesh = jax.sharding.Mesh(
+                np.array(jax.devices()[:world]), (eng.AXIS,)
+            )
+        self.engine = Engine(self.engine_cfg, self.model, self.mesh)
         self._build()
 
     # ---- build -------------------------------------------------------------
@@ -118,7 +139,7 @@ class HybridSimulation:
             bw_up[h.host_id] = h.bw_up_bits
             bw_down[h.host_id] = h.bw_down_bits
         mparams, mstate, _ = self.model.build(
-            [{"host_id": s.host_id} for s in self.specs], cfg.general.seed
+            [{"host_id": i} for i in range(ecfg.num_hosts)], cfg.general.seed
         )
         with eng.host_build_context():
             params = EngineParams(
@@ -188,11 +209,23 @@ class HybridSimulation:
                 self.procs.append(proc)
 
         # observability (reference §5.1: pcap per interface, strace per
-        # process, perf timers around the hot phases)
+        # process, perf timers around the hot phases; §5.5: async
+        # sim-time-stamped logger, shadow_logger.rs:17-60)
         self.perf = PerfTimers()
         self._pcaps = []
         self._strace_files = []
         data_dir = cfg.general.data_directory
+        self.log = None
+        if cfg.general.log_file:
+            path = cfg.general.log_file
+            if not os.path.isabs(path):
+                path = os.path.join(data_dir, path)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self.log = SimLogger(path, level=cfg.general.log_level)
+            for s, h in zip(self.specs, self.hosts):
+                h.on_process_exit = functools.partial(
+                    _log_process_exit, self.log, h
+                )
         strace_mode = cfg.experimental.strace_logging_mode
         for s, h in zip(self.specs, self.hosts):
             host_dir = os.path.join(data_dir, "hosts", s.name)
@@ -214,46 +247,99 @@ class HybridSimulation:
                     self._strace_files.append(f)
                     p.strace = StraceLogger(f, strace_mode)
 
-        # staging + payload store; tuples are (src, t, dst, size, key, sock)
+        # staging + payload store; tuples are (src, t, dst, size, key, sock).
+        # Sends land in PER-SOURCE buffers (each written only by its own
+        # host, so window execution can be parallel) and are flushed into
+        # `_staged` in host-id order — identical to serial execution order.
         self._staged: list[tuple[int, int, int, int, int, int]] = []
+        self._stage_buf: list[list] = [[] for _ in self.specs]
         self._qdisc = cfg.experimental.interface_qdisc
         self._send_seq = np.zeros((ecfg.num_hosts,), np.int64)
-        self._bytes: dict[tuple[int, int], tuple[int, NetPacket]] = {}
+        self._bytes: list[dict[int, tuple[int, NetPacket]]] = [
+            {} for _ in self.specs
+        ]
         self._window_idx = 0
-        self._unreachable_ips = 0
+        self._unreach = [0] * len(self.specs)
+        # parallel CPU host plane (reference thread_per_core.rs; see
+        # CpuNetwork for the staging argument). GIL caveat: pure-Python
+        # hosts serialize; native hosts block in futex waits off-GIL.
+        self._host_pool = None
+        if cfg.experimental.host_workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
 
-        # jitted ops
-        self._prepare = jax.jit(
-            functools.partial(_prepare_window, self.engine_cfg, self.model),
-            donate_argnums=0,
+            self._host_pool = ThreadPoolExecutor(
+                cfg.experimental.host_workers
+            )
+
+        # jitted ops (shard-mapped over the mesh when world > 1, exactly
+        # like Engine.run_chunk — staged-send arrays ride in replicated and
+        # each shard merges only its own hosts' rows)
+        axis = eng.AXIS if self.mesh is not None else None
+        prepare = functools.partial(
+            _prepare_window, self.engine_cfg, self.model, axis
         )
-        self._guarded = jax.jit(
-            functools.partial(
-                eng._run_guarded_chunk,
-                self.engine_cfg,
-                self.model,
-                None,
-                lambda ms: jnp.any(ms["cap_n"] > 0),
-            ),
-            donate_argnums=0,
+        guarded = functools.partial(
+            eng._run_guarded_chunk,
+            self.engine_cfg,
+            self.model,
+            axis,
+            lambda ms: jnp.any(ms["cap_n"] > 0),
         )
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            state_spec = self.engine.state_specs()
+            param_spec = self.engine.param_specs()
+            rep = P()
+            prepare = jax.shard_map(
+                prepare,
+                mesh=self.mesh,
+                in_specs=(state_spec, rep, rep, rep, rep, rep, rep),
+                out_specs=state_spec,
+                check_vma=False,
+            )
+            guarded = jax.shard_map(
+                guarded,
+                mesh=self.mesh,
+                in_specs=(state_spec, param_spec, rep),
+                out_specs=state_spec,
+                check_vma=False,
+            )
+        self._prepare = jax.jit(prepare, donate_argnums=0)
+        self._guarded = jax.jit(guarded, donate_argnums=0)
         self._clear_caps = jax.jit(_clear_caps, donate_argnums=0)
 
     # ---- egress staging ----------------------------------------------------
 
     def _stage_send(self, host: CpuHost, pkt: NetPacket):
+        gid = host.host_id
         dst_gid = self.ip_to_gid.get(pkt.dst_ip)
         if dst_gid is None:
-            self._unreachable_ips += 1
+            self._unreach[gid] += 1
             return
-        gid = host.host_id
         key = int(self._send_seq[gid] % (1 << 31))
         self._send_seq[gid] += 1
-        self._bytes[(gid, key)] = (self._window_idx, pkt)
+        self._bytes[gid][key] = (self._window_idx, pkt)
         sock = (int(pkt.proto) << 16) | (int(pkt.src_port) & 0xFFFF)
-        self._staged.append(
+        self._stage_buf[gid].append(
             (gid, host.now(), dst_gid, pkt.size_bytes, key, sock)
         )
+
+    def _flush_stage_buf(self):
+        """Move per-source buffers into the flat staging list in host-id
+        order (the deterministic merge point; worker.rs:644-654 analogue)."""
+        for buf in self._stage_buf:
+            if buf:
+                self._staged.extend(buf)
+                buf.clear()
+
+    def _execute_hosts(self, until: int):
+        if self._host_pool is not None:
+            list(self._host_pool.map(lambda h: h.execute(until), self.hosts))
+        else:
+            for h in self.hosts:  # deterministic host order
+                h.execute(until)
+        self._flush_stage_buf()
 
     # ---- window loop -------------------------------------------------------
 
@@ -271,6 +357,8 @@ class HybridSimulation:
             for f in self._strace_files:
                 if not f.closed:
                     f.close()
+            if self.log is not None:
+                self.log.close()
 
     def _run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
         cfg = self.cfg
@@ -290,27 +378,31 @@ class HybridSimulation:
                 break
             window_end = min(t_next + runahead, stop)
             with self.perf.time("host_plane"):
-                for h in self.hosts:  # deterministic host order
-                    h.execute(window_end)
-            # inject staged sends, then run device rounds until the first
-            # round that captures host-bound deliveries (the CPU plane must
-            # react) or the device catches up to the CPU plane's next event.
-            # Loops for staging-cap overflow so no send ever carries a stale
-            # timestamp into a later window.
-            while True:
-                with self.perf.time("device_inject"):
+                self._execute_hosts(window_end)
+            # inject ALL staged sends (multiple merges under staging-cap
+            # overflow — BEFORE any device rounds run, so a tiny cap only
+            # costs extra merge dispatches and cannot shift packet timing),
+            # then run device rounds until the first round that captures
+            # host-bound deliveries (the CPU plane must react) or the
+            # device catches up to the CPU plane's next event.
+            with self.perf.time("device_inject"):
+                self.state = self._inject()
+                while self._staged:
                     self.state = self._inject()
-                until = min(self._cpu_min_next(), stop)
-                with self.perf.time("device_rounds"):
-                    self.state = self._guarded(
-                        self.state, self.params,
-                        jnp.asarray(max(until, window_end), jnp.int64),
-                    )
-                with self.perf.time("drain_captures"):
-                    self._drain_captures()
-                if not self._staged:
-                    break
+            until = min(self._cpu_min_next(), stop)
+            with self.perf.time("device_rounds"):
+                self.state = self._guarded(
+                    self.state, self.params,
+                    jnp.asarray(max(until, window_end), jnp.int64),
+                )
+            with self.perf.time("drain_captures"):
+                self._drain_captures()
             windows += 1
+            if self.log is not None and hb_ns and window_end >= next_hb:
+                self.log.info(
+                    window_end, "manager",
+                    f"heartbeat windows={windows}",
+                )
             if hb_ns and window_end >= next_hb:
                 wall = time.monotonic() - t0
                 print(
@@ -326,8 +418,10 @@ class HybridSimulation:
                 print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
             if self._window_idx % 256 == 0:
                 self._gc_bytes()
-        for h in self.hosts:
-            h.execute(stop)
+        self._execute_hosts(stop)
+        if self._host_pool is not None:
+            self._host_pool.shutdown(wait=False)
+            self._host_pool = None
         # snapshot final states BEFORE reaping: a daemon alive at stop_time
         # satisfies expected_final_state: running even though shutdown kills
         # it (reference free_all_applications semantics, host.rs:791-807)
@@ -407,12 +501,18 @@ class HybridSimulation:
         # batch's probe sees a clean slate and nothing is delivered twice
         self.state = self._clear_caps(self.state)
         for gid in np.nonzero(cap_n > 0)[0]:
+            if gid >= len(self.hosts):
+                continue  # mesh-padding host: nothing can route to it
             host = self.hosts[int(gid)]
             for j in range(int(cap_n[gid])):
                 t = int(ms["cap_t"][gid, j])
                 src = int(ms["cap_src"][gid, j])
                 key = int(ms["cap_key"][gid, j])
-                entry = self._bytes.pop((src, key), None)
+                entry = (
+                    self._bytes[src].pop(key, None)
+                    if 0 <= src < len(self._bytes)
+                    else None
+                )
                 if entry is None:
                     continue  # duplicate capture (cannot happen) or GC'd
                 pkt = entry[1]
@@ -422,15 +522,16 @@ class HybridSimulation:
         horizon = self._window_idx - _BYTES_GC_WINDOWS
         if horizon <= 0:
             return
-        dead = [k for k, (w, _) in self._bytes.items() if w < horizon]
-        for k in dead:  # lost to device-side drop (loss/budget/codel)
-            del self._bytes[k]
+        for store in self._bytes:
+            dead = [k for k, (w, _) in store.items() if w < horizon]
+            for k in dead:  # lost to device-side drop (loss/budget/codel)
+                del store[k]
 
     # ---- outputs -----------------------------------------------------------
 
     def stats_report(self) -> dict:
         s = jax.device_get(self.state.stats)
-        n = self.engine_cfg.num_hosts
+        n = self._num_real  # exclude mesh-padding hosts
         wall = getattr(self, "_wall_seconds", None)
         sim_s = self.cfg.general.stop_time / NS_PER_SEC
         def pstate(p):  # coroutine procs use ProcState, native procs a str
@@ -464,7 +565,7 @@ class HybridSimulation:
             "queue_overflow_dropped": int(
                 np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
             ),
-            "unreachable_ips": self._unreachable_ips,
+            "unreachable_ips": sum(self._unreach),
             "syscalls": sum(h.counters["syscalls"] for h in self.hosts),
             "process_failures": failures,
             "processes_exited": len(zombies),
@@ -498,6 +599,19 @@ class HybridSimulation:
         return data_dir
 
 
+def _log_process_exit(log: SimLogger, host, proc):
+    """Per-host process-lifecycle record (the reference stamps every log
+    line with sim time + host context; process exits are the load-bearing
+    events when debugging a failed expected_final_state)."""
+    code = getattr(proc, "exit_code", None)
+    sig = getattr(proc, "term_signal", None)
+    how = f"signal {sig}" if sig else f"code {code}"
+    log.info(
+        host.now(), host.name,
+        f"process {getattr(proc, 'name', '?')} (pid {proc.pid}) exited with {how}",
+    )
+
+
 def _rr_reorder(staged):
     """Round-robin qdisc (reference QDiscMode::RoundRobin wired into
     network_interface.c): within each source host, interleave this window's
@@ -527,9 +641,19 @@ def _clear_caps(state):
     return state._replace(model=ms)
 
 
-def _prepare_window(cfg, model, state, dst, t, order, kind, payload, valid):
-    """Jitted: clear capture rings + merge staged send-requests."""
+def _prepare_window(cfg, model, axis, state, dst, t, order, kind, payload, valid):
+    """Jitted: clear capture rings + merge staged send-requests. Under a
+    mesh the staged arrays arrive replicated with GLOBAL host ids; each
+    shard keeps only its own rows and rebases them to shard-local ids."""
     state = _clear_caps(state)
+    if axis:
+        import jax.lax as _lax
+
+        h_local = state.queue.t.shape[0]
+        start = _lax.axis_index(axis).astype(jnp.int64) * h_local
+        mine = (dst >= start) & (dst < start + h_local)
+        valid = valid & mine
+        dst = jnp.clip(dst - start, 0, h_local - 1)
     queue = merge_flat_events(
         state.queue, dst, t, order, kind, payload, valid, cfg.max_round_inserts
     )

@@ -280,7 +280,6 @@ def build_simulation(cfg: ConfigOptions, **kw):
     if config_is_hybrid(cfg):
         from shadow_tpu.cosim import HybridSimulation
 
-        kw.pop("world", None)
         return HybridSimulation(cfg, **kw)
     return Simulation(cfg, **kw)
 
@@ -435,6 +434,15 @@ class Simulation:
         t0 = time.monotonic()
         next_hb = hb_ns
         capture = self._pcap_capture_begin()
+        simlog = None
+        if cfg.general.log_file:
+            from shadow_tpu.obs import SimLogger
+
+            path = cfg.general.log_file
+            if not os.path.isabs(path):
+                path = os.path.join(cfg.general.data_directory, path)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            simlog = SimLogger(path, level=cfg.general.log_level)
         chunks = 0
         while not bool(self.state.done):
             if capture is not None:
@@ -455,6 +463,12 @@ class Simulation:
                     f"{resource_heartbeat()}",
                     file=log,
                 )
+                if simlog is not None:
+                    simlog.info(
+                        now_ns, "manager",
+                        f"heartbeat events={ev} "
+                        f"rounds={int(self.state.stats.rounds)}",
+                    )
                 next_hb = (now_ns // hb_ns + 1) * hb_ns
             if show_progress:
                 pct = min(100.0, 100.0 * now_ns / max(cfg.general.stop_time, 1))
@@ -465,6 +479,12 @@ class Simulation:
             capture.close()
         self._wall_seconds = time.monotonic() - t0
         self._chunks = chunks
+        if simlog is not None:
+            simlog.info(
+                int(self.state.now), "manager",
+                f"simulation done chunks={chunks}",
+            )
+            simlog.close()
         return self.stats_report()
 
     def _pcap_capture_begin(self):

@@ -258,3 +258,99 @@ def test_rr_qdisc_reorders_and_stays_deterministic():
         return report["determinism_digest"]
 
     assert run("round-robin") == run("round-robin")  # deterministic
+
+
+def test_mesh_invariance_one_vs_eight_devices():
+    """The co-sim plane must produce IDENTICAL results on a 1-device and an
+    8-device mesh (VERDICT r2 missing #7; same bar as the modeled-sim
+    determinism suite): digests, packet counts, and every client's stdout."""
+
+    def once(world):
+        cfg = _cfg(
+            {
+                "server": {
+                    "network_node_id": 0,
+                    "processes": [
+                        {"path": "udp_echo_server", "args": ["port=9000"]}
+                    ],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "count": 5,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server", "port=9000", "count=3"],
+                            "expected_final_state": {"exited": 0},
+                        }
+                    ],
+                },
+            },
+            stop="4 s",
+        )
+        sim = HybridSimulation(cfg, world=world)
+        report = sim.run()
+        outs = {
+            spec.name: "".join(
+                b"".join(p.stdout).decode() for p in host.processes.values()
+            )
+            for spec, host in zip(sim.specs, sim.hosts)
+        }
+        return report, outs
+
+    r1, o1 = once(1)
+    r8, o8 = once(8)
+    assert r1["determinism_digest"] == r8["determinism_digest"]
+    for k in ("packets_sent", "packets_delivered", "packets_lost",
+              "process_failures", "events_processed", "syscalls"):
+        assert r1[k] == r8[k], k
+    assert o1 == o8
+
+
+def test_parallel_host_plane_matches_serial():
+    """experimental.host_workers > 1 runs CpuHosts on a thread pool inside
+    each window; per-source staging merged in host-id order makes the result
+    byte-identical to serial (reference thread_per_core.rs determinism bar,
+    src/test/determinism scheduler-invariance)."""
+
+    def once(workers):
+        cfg = _cfg(
+            {
+                "server": {
+                    "network_node_id": 0,
+                    "processes": [
+                        {"path": "udp_echo_server", "args": ["port=9000"]}
+                    ],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "count": 30,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server", "port=9000", "count=3"],
+                            "expected_final_state": {"exited": 0},
+                        }
+                    ],
+                },
+            },
+            stop="4 s",
+            extra={"experimental": {"host_workers": workers}},
+        )
+        sim = HybridSimulation(cfg, world=1)
+        report = sim.run()
+        outs = {
+            spec.name: "".join(
+                b"".join(p.stdout).decode() for p in host.processes.values()
+            )
+            for spec, host in zip(sim.specs, sim.hosts)
+        }
+        return report, outs
+
+    r1, o1 = once(1)
+    r4, o4 = once(4)
+    assert r1["determinism_digest"] == r4["determinism_digest"]
+    for k in ("packets_sent", "packets_delivered", "events_processed",
+              "syscalls", "process_failures"):
+        assert r1[k] == r4[k], k
+    assert o1 == o4
