@@ -69,6 +69,9 @@ class GeneralOptions:
     data_directory: str = "shadow.data"
     template_directory: str | None = None
     log_level: str = "info"
+    # sim-time-stamped structured log (reference shadow_logger.rs): None =
+    # off; a relative path lands inside data_directory
+    log_file: str | None = None
     heartbeat_interval: int | None = parse_time_ns("1 s")
     progress: bool = False
     model_unblocked_syscall_latency: bool = False
@@ -87,6 +90,7 @@ class GeneralOptions:
             data_directory=d.pop("data_directory", "shadow.data"),
             template_directory=d.pop("template_directory", None),
             log_level=d.pop("log_level", "info"),
+            log_file=d.pop("log_file", None),
             heartbeat_interval=(
                 parse_time_ns(heartbeat, TimeUnit.SEC) if heartbeat is not None else None
             ),
@@ -152,6 +156,11 @@ class ExperimentalOptions:
     max_round_inserts: int = 0  # max packets merged into one host per round; 0 = auto
     rounds_per_chunk: int = 64  # rounds per jit'd chunk between host syncs
     microstep_limit: int = 0  # safety bound on events/host/round; 0 = capacity
+    # CPU host plane worker threads for the co-sim window loop (reference
+    # thread-per-core scheduler, thread_per_core.rs:25-210). Hosts share
+    # nothing inside a window; results are identical to serial by
+    # construction (per-source staging merged in host-id order)
+    host_workers: int = 1
 
     @staticmethod
     def from_dict(d: dict[str, Any] | None) -> "ExperimentalOptions":
@@ -194,6 +203,7 @@ class ExperimentalOptions:
             "max_round_inserts",
             "rounds_per_chunk",
             "microstep_limit",
+            "host_workers",
         ):
             if f in d:
                 setattr(e, f, int(d.pop(f)))

@@ -11,6 +11,7 @@ listener callbacks never run inside the mutation that caused them.
 from __future__ import annotations
 
 import enum
+import threading
 from typing import Callable
 
 
@@ -57,7 +58,11 @@ class CallbackQueue:
     the outermost caller drains. `CallbackQueue.run(fn)` is the reference's
     `CallbackQueue::queue_and_run` entry point."""
 
-    _active: "CallbackQueue | None" = None
+    # PER-THREAD active queue: the parallel host plane runs hosts on pool
+    # threads and hosts share nothing inside a window — a class-global here
+    # would let thread A drain host B's callbacks mid-mutation (and clear
+    # the queue under B's feet). threading.local restores the invariant.
+    _tls = threading.local()
 
     def __init__(self):
         self._q: list[Callable[[], None]] = []
@@ -71,20 +76,21 @@ class CallbackQueue:
 
     @classmethod
     def current(cls) -> "CallbackQueue | None":
-        return cls._active
+        return getattr(cls._tls, "active", None)
 
     @classmethod
     def run(cls, fn: Callable[["CallbackQueue"], object]):
         """Run fn with an active queue, draining afterwards. Nested calls
         reuse the outer queue (callbacks still run only at the outermost
         unwind, preserving no-reentrancy)."""
-        if cls._active is not None:
-            return fn(cls._active)
+        active = getattr(cls._tls, "active", None)
+        if active is not None:
+            return fn(active)
         q = cls()
-        cls._active = q
+        cls._tls.active = q
         try:
             out = fn(q)
             q.drain()
             return out
         finally:
-            cls._active = None
+            cls._tls.active = None

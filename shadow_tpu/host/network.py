@@ -23,6 +23,7 @@ class CpuNetwork:
         latency_ns: Callable[[int, int], int],
         loss: Callable[[int, int], float] | None = None,
         names: dict[str, str] | None = None,
+        workers: int = 1,
     ):
         self.hosts = hosts
         self.by_ip = {h.ip: h for h in hosts}
@@ -42,8 +43,33 @@ class CpuNetwork:
         for h in hosts:
             h.egress = self._egress
             h.resolver = names.get
-        self.pkts_dropped = 0
-        self.pkts_relayed = 0
+        # parallel host execution (reference thread_per_core.rs:25-210):
+        # hosts share nothing inside a window, so N pool threads can run
+        # them concurrently. Cross-host deliveries are STAGED per source and
+        # merged after the window in host-id order — conservative lookahead
+        # guarantees every arrival lands >= window_end, so staging changes
+        # nothing observable and keeps the merge order deterministic.
+        # (CPython's GIL serializes pure-Python hosts; the win is native
+        # hosts, whose service loops block in futex waits outside the GIL.)
+        self.workers = max(1, workers)
+        self._staged: list[list] = [[] for _ in hosts]
+        self._pool = None
+        if self.workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(self.workers)
+        # per-source counters summed on read: parallel sources must not race
+        # on shared ints
+        self._dropped = [0] * len(hosts)
+        self._relayed = [0] * len(hosts)
+
+    @property
+    def pkts_dropped(self) -> int:
+        return sum(self._dropped)
+
+    @property
+    def pkts_relayed(self) -> int:
+        return sum(self._relayed)
 
     def _egress(self, src: CpuHost, pkt: NetPacket):
         dst = self.by_ip.get(pkt.dst_ip)
@@ -53,10 +79,28 @@ class CpuNetwork:
         p = self.loss(src.host_id, dst.host_id)
         # loss drawn from the source host's RNG (worker.rs:374-390)
         if p > 0.0 and src.rng.random() < p:
-            self.pkts_dropped += 1
+            self._dropped[src.host_id] += 1
             return
-        self.pkts_relayed += 1
-        dst.schedule(src.now() + lat, lambda: dst.deliver_packet(pkt))
+        self._relayed[src.host_id] += 1
+        self._staged[src.host_id].append((src.now() + lat, dst, pkt))
+
+    def _flush_staged(self):
+        """Deliver staged packets in source-host-id order (the reference
+        pushes into each dst's mutex'd queue; here the post-window merge
+        IS the deterministic ordering point, worker.rs:644-654)."""
+        for buf in self._staged:
+            for t, dst, pkt in buf:
+                dst.schedule(t, _mk_delivery(dst, pkt))
+            buf.clear()
+
+    def _execute_all(self, until: int):
+        if self._pool is not None:
+            # list() joins: every host finishes before the staged merge
+            list(self._pool.map(lambda h: h.execute(until), self.hosts))
+        else:
+            for h in self.hosts:  # deterministic host order
+                h.execute(until)
+        self._flush_staged()
 
     # ---- conservative round loop ------------------------------------------
 
@@ -70,9 +114,14 @@ class CpuNetwork:
             if nxt >= stop_ns:
                 break
             window_end = min(nxt + runahead, stop_ns)
-            for h in self.hosts:  # deterministic host order
-                h.execute(window_end)
+            self._execute_all(window_end)
             rounds += 1
-        for h in self.hosts:
-            h.execute(stop_ns)
+        self._execute_all(stop_ns)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
         return rounds
+
+
+def _mk_delivery(dst: CpuHost, pkt: NetPacket):
+    return lambda: dst.deliver_packet(pkt)

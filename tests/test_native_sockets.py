@@ -194,3 +194,29 @@ def test_writev_on_socket_single_datagram():
     # iovs arrived in one message
     assert b"echo: PING 0" in b"".join(cli.stdout)
     assert srv.exit_code == 0
+
+
+def test_parallel_cpu_network_matches_serial_native():
+    """CpuNetwork(workers=2): real binaries on a threaded host plane must be
+    byte-identical to the serial run (staged cross-host merge in host order)."""
+
+    def once(workers):
+        hosts, _ = two_hosts()
+        from shadow_tpu.host.network import CpuNetwork
+
+        net = CpuNetwork(
+            hosts, latency_ns=lambda s, d: 25 * MS, workers=workers
+        )
+        srv = spawn_native(hosts[0], [UDP_ECHO, "9000", "2"])
+        cli = spawn_native(
+            hosts[1], [UDP_CLIENT, "10.0.0.1", "9000", "2"],
+            start_time=50 * MS,
+        )
+        net.run(5 * SEC)
+        return (
+            srv.exit_code, cli.exit_code,
+            b"".join(srv.stdout), b"".join(cli.stdout),
+            srv.syscall_count, cli.syscall_count,
+        )
+
+    assert once(1) == once(2)
