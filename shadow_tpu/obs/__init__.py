@@ -9,5 +9,13 @@ modes off/standard/deterministic at configuration.rs:1162), and the
 from shadow_tpu.obs.pcap import PcapWriter, packet_bytes
 from shadow_tpu.obs.strace import StraceLogger
 from shadow_tpu.obs.perf import PerfTimers
+from shadow_tpu.obs.simlog import SimLogger, format_sim_time
 
-__all__ = ["PcapWriter", "PerfTimers", "StraceLogger", "packet_bytes"]
+__all__ = [
+    "PcapWriter",
+    "PerfTimers",
+    "SimLogger",
+    "StraceLogger",
+    "format_sim_time",
+    "packet_bytes",
+]

@@ -66,6 +66,17 @@ def parse_data_dir(data_dir: str) -> dict:
             )
             hosts[name] = entry
     out["hosts"] = hosts
+    log_path = os.path.join(data_dir, "shadow.log")
+    if os.path.exists(log_path):
+        # per-host record attribution from the sim-time-stamped logger
+        # (shadow_tpu.obs.simlog; reference shadow_logger.rs format role)
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            from shadow_tpu.obs.simlog import parse_log
+
+            out["shadow_log"] = parse_log(log_path)
+        except ImportError:
+            pass
     return out
 
 
