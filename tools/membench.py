@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Managed-memory access microbenchmark (VERDICT r2 #9).
+
+Measures the syscall-crossing cost of reading a managed process's memory:
+
+  per-iovec : one process_vm_readv call per iovec (the pre-round-3 path
+              for writev/sendmsg gathers)
+  batched   : ONE process_vm_readv call carrying all remote iovecs (what
+              native_plane._gather_write / _handle_msg do now)
+
+The reference's MemoryMapper (memory_mapper.rs:84-110) removes the syscall
+entirely via shared-memory remapping; batching is the measured middle
+ground this plane ships. Run: python tools/membench.py [iovs] [size] [reps]
+Prints one JSON line with both rates and the speedup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shadow_tpu.native_plane import _vm_read, _vm_read_multi  # noqa: E402
+
+
+def find_readable_region(pid: int, need: int) -> int:
+    with open(f"/proc/{pid}/maps") as f:
+        for line in f:
+            fields = line.split()
+            if len(fields) < 2 or "r" not in fields[1]:
+                continue
+            lo, hi = (int(x, 16) for x in fields[0].split("-"))
+            if hi - lo >= need:
+                return lo
+    raise RuntimeError("no readable region")
+
+
+def main() -> int:
+    iovs = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    size = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 2000
+
+    child = subprocess.Popen(["sleep", "60"])
+    try:
+        time.sleep(0.05)  # let exec finish so maps are stable
+        base = find_readable_region(child.pid, iovs * size)
+        chunks = [(base + i * size, size) for i in range(iovs)]
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for addr, n in chunks:
+                _vm_read(child.pid, addr, n)
+        per_iovec_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _vm_read_multi(child.pid, chunks)
+        batched_s = time.perf_counter() - t0
+
+        total_mb = reps * iovs * size / 1e6
+        print(
+            json.dumps(
+                {
+                    "metric": "vm_read_gather",
+                    "iovs": iovs,
+                    "size_bytes": size,
+                    "reps": reps,
+                    "per_iovec_us_per_gather": round(
+                        per_iovec_s / reps * 1e6, 2
+                    ),
+                    "batched_us_per_gather": round(batched_s / reps * 1e6, 2),
+                    "speedup": round(per_iovec_s / max(batched_s, 1e-12), 2),
+                    "batched_MBps": round(total_mb / batched_s, 1),
+                }
+            )
+        )
+    finally:
+        child.kill()
+        child.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
