@@ -849,6 +849,16 @@ extern "C" int getaddrinfo(const char *node, const char *service,
 }
 
 extern "C" void freeaddrinfo(struct addrinfo *ai) {
+    if (!g_ipc) { /* list came from the real getaddrinfo (our !g_ipc
+                   * fallback): it is ONE glibc allocation with interior
+                   * pointers — must be freed by the real deallocator */
+        static void (*real)(struct addrinfo *) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "freeaddrinfo");
+        if (real)
+            real(ai);
+        return;
+    }
     while (ai) {
         struct addrinfo *next = ai->ai_next;
         free(ai->ai_addr);
@@ -937,6 +947,14 @@ extern "C" int getifaddrs(struct ifaddrs **ifap) {
 }
 
 extern "C" void freeifaddrs(struct ifaddrs *ifa) {
+    if (!g_ipc) { /* same single-allocation concern as freeaddrinfo */
+        static void (*real)(struct ifaddrs *) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "freeifaddrs");
+        if (real)
+            real(ifa);
+        return;
+    }
     while (ifa) {
         struct ifaddrs *next = ifa->ifa_next;
         free(ifa);

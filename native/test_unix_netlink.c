@@ -1,0 +1,111 @@
+/* AF_UNIX stream sockets (abstract namespace, cross-process via fork) and
+ * a raw rtnetlink RTM_GETADDR dump — the startup paths real network tools
+ * touch. (Reference: socket/unix.rs + abstract_unix_ns.rs, netlink.rs.) */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <linux/netlink.h>
+#include <linux/rtnetlink.h>
+#include <arpa/inet.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static int unix_pair_test(void) {
+    int lfd = socket(AF_UNIX, SOCK_STREAM, 0);
+    struct sockaddr_un a;
+    memset(&a, 0, sizeof a);
+    a.sun_family = AF_UNIX;
+    a.sun_path[0] = 0; /* abstract */
+    strcpy(a.sun_path + 1, "shadow-test");
+    socklen_t alen = (socklen_t)(offsetof(struct sockaddr_un, sun_path) + 1 +
+                                 strlen("shadow-test"));
+    if (bind(lfd, (struct sockaddr *)&a, alen)) { perror("bind"); return 1; }
+    if (listen(lfd, 4)) { perror("listen"); return 1; }
+
+    pid_t pid = fork();
+    if (pid < 0) { perror("fork"); return 1; }
+    if (pid == 0) {
+        int c = socket(AF_UNIX, SOCK_STREAM, 0);
+        if (connect(c, (struct sockaddr *)&a, alen)) { perror("connect"); _exit(2); }
+        if (send(c, "ping", 4, 0) != 4) { perror("send"); _exit(3); }
+        char buf[16];
+        ssize_t n = recv(c, buf, sizeof buf, 0);
+        if (n != 4 || memcmp(buf, "pong", 4)) { _exit(4); }
+        _exit(0);
+    }
+    int s = accept(lfd, NULL, NULL);
+    if (s < 0) { perror("accept"); return 1; }
+    char buf[16];
+    ssize_t n = recv(s, buf, sizeof buf, 0);
+    if (n != 4 || memcmp(buf, "ping", 4)) { fprintf(stderr, "bad ping\n"); return 1; }
+    if (send(s, "pong", 4, 0) != 4) { perror("send"); return 1; }
+    int st = 0;
+    waitpid(pid, &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+        fprintf(stderr, "child failed %d\n", st);
+        return 1;
+    }
+    /* rebinding the same abstract name while held must EADDRINUSE */
+    int dup2fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (bind(dup2fd, (struct sockaddr *)&a, alen) == 0 || errno != EADDRINUSE) {
+        fprintf(stderr, "expected EADDRINUSE\n");
+        return 1;
+    }
+    printf("unix ok\n");
+    return 0;
+}
+
+static int netlink_test(void) {
+    int fd = socket(AF_NETLINK, SOCK_RAW, NETLINK_ROUTE);
+    if (fd < 0) { perror("nl socket"); return 1; }
+    struct sockaddr_nl sa;
+    memset(&sa, 0, sizeof sa);
+    sa.nl_family = AF_NETLINK;
+    if (bind(fd, (struct sockaddr *)&sa, sizeof sa)) { perror("nl bind"); return 1; }
+    struct {
+        struct nlmsghdr nh;
+        struct ifaddrmsg ifa;
+    } req;
+    memset(&req, 0, sizeof req);
+    req.nh.nlmsg_len = NLMSG_LENGTH(sizeof(struct ifaddrmsg));
+    req.nh.nlmsg_type = RTM_GETADDR;
+    req.nh.nlmsg_flags = NLM_F_REQUEST | NLM_F_DUMP;
+    req.nh.nlmsg_seq = 7;
+    req.ifa.ifa_family = AF_INET;
+    if (send(fd, &req, req.nh.nlmsg_len, 0) < 0) { perror("nl send"); return 1; }
+    char buf[8192];
+    int found = 0, done = 0;
+    while (!done) {
+        ssize_t n = recv(fd, buf, sizeof buf, 0);
+        if (n <= 0) { perror("nl recv"); return 1; }
+        for (struct nlmsghdr *nh = (struct nlmsghdr *)buf; NLMSG_OK(nh, n);
+             nh = NLMSG_NEXT(nh, n)) {
+            if (nh->nlmsg_type == NLMSG_DONE) { done = 1; break; }
+            if (nh->nlmsg_type != RTM_NEWADDR) continue;
+            struct ifaddrmsg *ifa = NLMSG_DATA(nh);
+            int rlen = (int)IFA_PAYLOAD(nh);
+            char label[32] = "?", addr[32] = "?";
+            for (struct rtattr *rta = IFA_RTA(ifa); RTA_OK(rta, rlen);
+                 rta = RTA_NEXT(rta, rlen)) {
+                if (rta->rta_type == IFA_LABEL)
+                    snprintf(label, sizeof label, "%s", (char *)RTA_DATA(rta));
+                if (rta->rta_type == IFA_ADDRESS)
+                    inet_ntop(AF_INET, RTA_DATA(rta), addr, sizeof addr);
+            }
+            printf("addr %s %s\n", label, addr);
+            found++;
+        }
+    }
+    printf("netlink ok found=%d\n", found);
+    return found >= 2 ? 0 : 1;
+}
+
+int main(int argc, char **argv) {
+    if (argc > 1 && !strcmp(argv[1], "netlink"))
+        return netlink_test();
+    return unix_pair_test();
+}

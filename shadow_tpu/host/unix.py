@@ -25,6 +25,7 @@ class UnixStreamSocket(StreamEnd):
         # listener state (bound to an abstract name)
         self.listening = False
         self.bound_name: str | None = None
+        self.peer_name: str | None = None  # the address connect()ed to
         self._accept_q: list["UnixStreamSocket"] = []
         self._ns: dict | None = None  # abstract namespace (host-owned)
 
@@ -73,6 +74,10 @@ class UnixStreamSocket(StreamEnd):
         self._tx, self._rx = client_end._tx, client_end._rx
         self.peer = server_end
         server_end.peer = self
+        # getpeername: the client's peer is the LISTENER's address; the
+        # accepted server end's peer (this client) is unnamed
+        self.peer_name = listener.bound_name
+        server_end.bound_name = listener.bound_name
         self._set_state(on=FileState.WRITABLE)
         listener._accept_q.append(server_end)
         listener._set_state(on=FileState.ACCEPTABLE | FileState.READABLE)

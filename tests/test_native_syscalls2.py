@@ -117,3 +117,30 @@ def test_execve_replaces_image_in_place():
     net.run(5 * SEC)
     assert p.exit_code == 42, (p.exit_code, b"".join(p.stderr))
     assert b"worker pid=" in b"".join(p.stdout)
+
+
+UNIXNL = os.path.join(REPO, "native", "build", "test_unix_netlink")
+
+
+def test_unix_sockets_cross_process():
+    """AF_UNIX abstract-namespace stream sockets between two native
+    processes on one host (bind/listen/fork/connect/accept + EADDRINUSE;
+    reference socket/unix.rs + abstract_unix_ns.rs)."""
+    hosts, net = two_hosts()
+    p = spawn_native(hosts[0], [UNIXNL])
+    net.run(10 * SEC)
+    assert p.exit_code == 0, b"".join(p.stderr)
+    assert b"unix ok" in b"".join(p.stdout)
+
+
+def test_netlink_rtm_getaddr_dump():
+    """Raw rtnetlink RTM_GETADDR dump answered with the simulated lo+eth0
+    (reference socket/netlink.rs)."""
+    hosts, net = two_hosts()
+    p = spawn_native(hosts[0], [UNIXNL, "netlink"])
+    net.run(10 * SEC)
+    assert p.exit_code == 0, b"".join(p.stderr)
+    out = b"".join(p.stdout).decode()
+    assert "addr lo 127.0.0.1" in out
+    assert "addr eth0 10.0.0.1" in out
+    assert "netlink ok found=2" in out
