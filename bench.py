@@ -125,9 +125,8 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
     1: 1k-host udp-echo on the basic graph        (tgen-echo analogue)
     2: 10k-host PHOLD all-to-all on a 2D torus    (routing-gather stress)
     3: 100k-host gossip flood, sparse adjacency   (CSR-in-HBM stress)
+    4: 5k-relay Tor-like circuit mix              (packets + continuations)
     5: 1M-host timer-only                         (sort + barrier stress)
-    (4, the 5k-relay Tor-like mix, needs the circuit/TCP device model —
-    not implemented yet.)
     """
     if n == 1:
         hosts = 64 if small else 1000
@@ -207,6 +206,32 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
             },
         }
         return cfg, "gossip_100k_events_per_wall_second", 30
+    if n == 4:
+        n_relays = 64 if small else 5000
+        n_clients = 32 if small else 2500
+        cfg = {
+            "general": {"stop_time": "60 s", "seed": 1},
+            "network": {"graph": {"type": "gml", "inline": PHOLD_GML}},
+            "experimental": {"event_queue_capacity": 32,
+                             "sends_per_host_round": 8,
+                             "rounds_per_chunk": 256},
+            "hosts": {
+                "relay": {
+                    "count": n_relays,
+                    "network_node_id": 0,
+                    "processes": [{"model": "circuit",
+                                   "model_args": {"role": "relay"}}],
+                },
+                "cli": {
+                    "count": n_clients,
+                    "network_node_id": 0,
+                    "processes": [{"model": "circuit",
+                                   "model_args": {"role": "client",
+                                                  "interval": "400 ms"}}],
+                },
+            },
+        }
+        return cfg, "circuit_5k_relay_sim_seconds_per_wall_second", 60
     if n == 5:
         hosts = 4096 if small else 1_000_000
         cfg = {

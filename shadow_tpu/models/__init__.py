@@ -24,6 +24,7 @@ from shadow_tpu.models import timer as _timer  # noqa: F401  (registers)
 from shadow_tpu.models import phold as _phold  # noqa: F401
 from shadow_tpu.models import echo as _echo  # noqa: F401
 from shadow_tpu.models import gossip as _gossip  # noqa: F401
+from shadow_tpu.models import circuit as _circuit  # noqa: F401
 
 __all__ = [
     "HandlerCtx",

@@ -1,0 +1,201 @@
+"""Tor-like relay circuits: the mixed-event workload of BASELINE config 4.
+
+Reference analogue: the minimal Tor network integration test
+(src/test/tor/minimal/tor-minimal.yaml — clients pushing cells through
+3-hop relay circuits). Device recast: every client owns a fixed 3-relay
+circuit (guard, middle, exit) drawn deterministically at build time; a
+cell travels client -> guard -> middle -> exit, turns around, and returns
+exit -> middle -> guard -> client. Each relay charges a processing delay
+(a LocalPush continuation) before forwarding — so the load is an even mix
+of packet events, local continuations, and timer ticks, unlike PHOLD's
+pure packet churn.
+
+The full route rides in the packet payload as 16-bit host ids (the event
+payload is 4 words and params are shard-local, so a relay cannot gather
+the client's route from its own tables) — circuit sims are therefore
+bounded to 65,535 hosts, enforced at build. Clients keep at most one cell
+outstanding (send-on-tick when idle), giving an exact per-cell RTT without
+carrying timestamps in the payload.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config.units import TimeUnit, parse_time_ns
+from shadow_tpu.models.base import (
+    HandlerCtx,
+    HandlerOut,
+    LocalPush,
+    PacketSend,
+    register_model,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+
+KIND_TICK = 0  # client timer
+KIND_CELL = 1  # cell packet arriving at a relay or back at the client
+KIND_FWD = 2  # relay continuation: processing delay elapsed, forward now
+
+ROLE_RELAY = 0
+ROLE_CLIENT = 1
+
+# payload words (word 0 is the engine-owned size)
+PW_GM = 1  # guard | middle << 16
+PW_EC = 2  # exit | client << 16
+PW_HD = 3  # hop | dir << 8
+
+
+@register_model
+class CircuitModel:
+    name = "circuit"
+
+    def build(self, hosts, seed):
+        h = len(hosts)
+        if h > 0xFFFF:
+            raise ValueError(
+                f"circuit model routes via 16-bit host ids: {h} hosts > 65535"
+            )
+        role = np.zeros((h,), np.int32)
+        interval = np.zeros((h,), np.int64)
+        proc = np.zeros((h,), np.int64)
+        size = np.zeros((h,), np.int32)
+        for i, hh in enumerate(hosts):
+            a = hh["model_args"]
+            role[i] = ROLE_CLIENT if a.get("role", "relay") == "client" else ROLE_RELAY
+            interval[i] = parse_time_ns(a.get("interval", "200 ms"), TimeUnit.MS)
+            proc[i] = parse_time_ns(a.get("relay_delay", "2 ms"), TimeUnit.MS)
+            size[i] = int(a.get("cell_bytes", 512))
+        relays = np.nonzero(role == ROLE_RELAY)[0]
+        clients = np.nonzero(role == ROLE_CLIENT)[0]
+        if len(relays) < 3 and len(clients):
+            raise ValueError("circuit model needs >= 3 relay hosts")
+        rng = np.random.default_rng(seed)
+        route = np.zeros((h, 3), np.int32)
+        for c in clients:
+            route[c] = relays[rng.choice(len(relays), size=3, replace=False)]
+        params = {
+            "role": jnp.asarray(role),
+            "route": jnp.asarray(route),
+            "interval": jnp.asarray(interval),
+            "proc": jnp.asarray(proc),
+            "size": jnp.asarray(size),
+        }
+        state = {
+            "outstanding": jnp.zeros((h,), bool),
+            "launch_t": jnp.zeros((h,), jnp.int64),
+            "cells_done": jnp.zeros((h,), jnp.int64),
+            "rtt_sum": jnp.zeros((h,), jnp.int64),
+            "fwd": jnp.zeros((h,), jnp.int64),
+        }
+        events = [
+            (hh["host_id"], hh["start_time"], KIND_TICK, ())
+            for i, hh in enumerate(hosts)
+            if role[i] == ROLE_CLIENT
+        ]
+        return params, state, events
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        h = ctx.kind.shape[0]
+        st = ctx.state
+        p = ctx.params
+        is_client = p["role"] == ROLE_CLIENT
+        tick = ctx.active & ~ctx.is_packet & (ctx.kind == KIND_TICK)
+        cell_in = ctx.active & ctx.is_packet & (ctx.kind == KIND_CELL)
+        fwd = ctx.active & ~ctx.is_packet & (ctx.kind == KIND_FWD)
+
+        cell_back = cell_in & is_client  # full round trip completed
+        cell_at_relay = cell_in & ~is_client
+
+        # ---- client tick: launch a cell when idle; always re-arm the tick
+        launch = tick & ~st["outstanding"]
+        guard = p["route"][:, 0].astype(jnp.int64)
+        gm = p["route"][:, 0].astype(jnp.int32) | (
+            p["route"][:, 1].astype(jnp.int32) << 16
+        )
+        ec = p["route"][:, 2].astype(jnp.int32) | (
+            ctx.host_id.astype(jnp.int32) << 16
+        )
+        launch_payload = jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32)
+        launch_payload = launch_payload.at[:, PW_GM].set(gm)
+        launch_payload = launch_payload.at[:, PW_EC].set(ec)
+        launch_payload = launch_payload.at[:, PW_HD].set(0)  # hop 0, dir 0
+        send_launch = PacketSend(
+            mask=launch,
+            dst=guard,
+            size_bytes=p["size"],
+            kind=jnp.full((h,), KIND_CELL, jnp.int32),
+            payload=launch_payload,
+        )
+        tick_push = LocalPush(
+            mask=tick,
+            t=ctx.t + p["interval"],
+            kind=jnp.full((h,), KIND_TICK, jnp.int32),
+            payload=jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32),
+        )
+
+        # ---- relay: charge the processing delay, then forward (KIND_FWD)
+        proc_push = LocalPush(
+            mask=cell_at_relay,
+            t=ctx.t + p["proc"],
+            kind=jnp.full((h,), KIND_FWD, jnp.int32),
+            payload=ctx.payload,
+        )
+
+        # ---- forward continuation: next hop from the packed route
+        pl = ctx.payload
+        g = (pl[:, PW_GM] & 0xFFFF).astype(jnp.int64)
+        m = ((pl[:, PW_GM] >> 16) & 0xFFFF).astype(jnp.int64)
+        e = (pl[:, PW_EC] & 0xFFFF).astype(jnp.int64)
+        c = ((pl[:, PW_EC] >> 16) & 0xFFFF).astype(jnp.int64)
+        hop = pl[:, PW_HD] & 0xFF
+        dn = (pl[:, PW_HD] >> 8) & 1
+        at_exit = (dn == 0) & (hop == 2)
+        nxt_dst = jnp.where(
+            dn == 0,
+            jnp.where(hop == 0, m, jnp.where(hop == 1, e, m)),
+            jnp.where(hop == 1, g, c),
+        )
+        nxt_hop = jnp.where(
+            dn == 0,
+            jnp.where(hop == 0, 1, jnp.where(hop == 1, 2, 1)),
+            jnp.where(hop == 1, 0, 0),
+        )
+        nxt_dir = jnp.where(at_exit, 1, dn)
+        fwd_payload = pl.at[:, PW_HD].set(
+            (nxt_hop | (nxt_dir << 8)).astype(jnp.int32)
+        )
+        send_fwd = PacketSend(
+            mask=fwd,
+            dst=nxt_dst,
+            size_bytes=p["size"],
+            kind=jnp.full((h,), KIND_CELL, jnp.int32),
+            payload=fwd_payload,
+        )
+
+        rtt = ctx.t - st["launch_t"]
+        state = {
+            "outstanding": jnp.where(
+                launch, True, jnp.where(cell_back, False, st["outstanding"])
+            ),
+            "launch_t": jnp.where(launch, ctx.t, st["launch_t"]),
+            "cells_done": st["cells_done"] + cell_back,
+            "rtt_sum": st["rtt_sum"] + jnp.where(cell_back, rtt, 0),
+            "fwd": st["fwd"] + fwd,
+        }
+        return HandlerOut(
+            state=state,
+            rng=ctx.rng,
+            pushes=(tick_push, proc_push),
+            sends=(send_launch, send_fwd),
+        )
+
+    def report(self, state, hosts):
+        done = np.asarray(state["cells_done"])
+        rtt = np.asarray(state["rtt_sum"])
+        n = int(done.sum())
+        return {
+            "cells_completed": n,
+            "mean_rtt_ms": (float(rtt.sum()) / n / 1e6) if n else None,
+            "relay_forwards": int(np.asarray(state["fwd"]).sum()),
+        }

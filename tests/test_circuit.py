@@ -1,0 +1,77 @@
+"""Circuit model (Tor-like 3-hop relay chains, BASELINE config 4 workload;
+reference: src/test/tor/minimal)."""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+
+def _cfg(n_relays=6, n_clients=4, stop="5 s", seed=11, sched="tpu"):
+    return ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": stop, "seed": seed},
+            "network": {
+                "graph": {
+                    "type": "gml",
+                    "inline": """
+graph [ directed 0
+  node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "20 ms" ]
+]""",
+                }
+            },
+            "experimental": {"scheduler": sched},
+            "hosts": {
+                "relay": {
+                    "count": n_relays,
+                    "network_node_id": 0,
+                    "processes": [{"model": "circuit",
+                                   "model_args": {"role": "relay"}}],
+                },
+                "cli": {
+                    "count": n_clients,
+                    "network_node_id": 0,
+                    "processes": [{"model": "circuit",
+                                   "model_args": {"role": "client",
+                                                  "interval": "500 ms"}}],
+                },
+            },
+        }
+    )
+
+
+def test_cells_complete_round_trips():
+    sim = Simulation(_cfg(), world=1)
+    r = sim.run(progress=False)
+    m = r["model_report"]
+    assert m["cells_completed"] > 0
+    # 6 wire hops per completed cell (3 out + 3 back)
+    assert r["packets_delivered"] >= m["cells_completed"] * 6
+    # RTT >= 6 x 20 ms wire + 5 relay processing delays (2 ms each)
+    assert m["mean_rtt_ms"] >= 6 * 20 + 5 * 2 - 1
+    # every forward was charged a processing delay first
+    assert m["relay_forwards"] >= m["cells_completed"] * 5
+
+
+def test_matches_golden_oracle():
+    dev = Simulation(_cfg(seed=3), world=1).run(progress=False)
+    gold = Simulation(_cfg(seed=3, sched="cpu-reference"), world=1).run(
+        progress=False
+    )
+    assert dev["determinism_digest"] == gold["determinism_digest"]
+    assert dev["model_report"] == gold["model_report"]
+
+
+def test_mesh_invariant():
+    a = Simulation(_cfg(seed=5), world=1).run(progress=False)
+    b = Simulation(_cfg(seed=5), world=8).run(progress=False)
+    assert a["determinism_digest"] == b["determinism_digest"]
+    assert a["model_report"] == b["model_report"]
+
+
+def test_needs_three_relays():
+    with pytest.raises(Exception, match="3 relay"):
+        Simulation(_cfg(n_relays=2), world=1)
