@@ -104,8 +104,46 @@ static int netlink_test(void) {
     return found >= 2 ? 0 : 1;
 }
 
+static int unix_dgram_test(void) {
+    /* named dgram (the syslog /dev/log shape) */
+    int srv = socket(AF_UNIX, SOCK_DGRAM, 0);
+    struct sockaddr_un a;
+    memset(&a, 0, sizeof a);
+    a.sun_family = AF_UNIX;
+    a.sun_path[0] = 0;
+    strcpy(a.sun_path + 1, "dgram-log");
+    socklen_t alen = (socklen_t)(offsetof(struct sockaddr_un, sun_path) + 1 +
+                                 strlen("dgram-log"));
+    if (bind(srv, (struct sockaddr *)&a, alen)) { perror("bind"); return 1; }
+    int cli = socket(AF_UNIX, SOCK_DGRAM, 0);
+    if (connect(cli, (struct sockaddr *)&a, alen)) { perror("connect"); return 1; }
+    /* two sends = two datagrams; boundaries must be preserved */
+    if (send(cli, "first", 5, 0) != 5) { perror("send"); return 1; }
+    if (sendto(cli, "second!", 7, 0, (struct sockaddr *)&a, alen) != 7) {
+        perror("sendto");
+        return 1;
+    }
+    char buf[64];
+    ssize_t n1 = recv(srv, buf, sizeof buf, 0);
+    if (n1 != 5 || memcmp(buf, "first", 5)) { fprintf(stderr, "dg1\n"); return 1; }
+    ssize_t n2 = recv(srv, buf, sizeof buf, 0);
+    if (n2 != 7 || memcmp(buf, "second!", 7)) { fprintf(stderr, "dg2\n"); return 1; }
+
+    /* dgram socketpair */
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_DGRAM, 0, sv)) { perror("socketpair"); return 1; }
+    if (write(sv[0], "abc", 3) != 3) { perror("write"); return 1; }
+    if (write(sv[0], "de", 2) != 2) { perror("write"); return 1; }
+    if (read(sv[1], buf, sizeof buf) != 3) { fprintf(stderr, "sp1\n"); return 1; }
+    if (read(sv[1], buf, sizeof buf) != 2) { fprintf(stderr, "sp2\n"); return 1; }
+    printf("dgram ok\n");
+    return 0;
+}
+
 int main(int argc, char **argv) {
     if (argc > 1 && !strcmp(argv[1], "netlink"))
         return netlink_test();
+    if (argc > 1 && !strcmp(argv[1], "dgram"))
+        return unix_dgram_test();
     return unix_pair_test();
 }

@@ -11,6 +11,7 @@ namespace.
 
 from __future__ import annotations
 
+from shadow_tpu.host.descriptor import File
 from shadow_tpu.host.filestate import FileState
 from shadow_tpu.host.pipe import StreamEnd, _SharedBuf
 
@@ -110,4 +111,92 @@ class UnixStreamSocket(StreamEnd):
         for child in self._accept_q:
             child.close()
         self._accept_q.clear()
+        super().close()
+
+
+UNIX_DGRAM_QUEUE = 512  # datagrams buffered per receiving socket
+
+
+class UnixDgramSocket(File):
+    """Unix-domain DATAGRAM socket: message boundaries preserved, sendto by
+    bound name or connected peer (glibc syslog()'s /dev/log transport;
+    reference socket/unix.rs dgram support). Delivery is immediate and
+    reliable within a host; a full receive queue rejects the send with
+    ENOBUFS (the kernel blocks or drops depending on flags — rejecting
+    loudly keeps the plane deterministic)."""
+
+    def __init__(self):
+        super().__init__()
+        self.bound_name: str | None = None
+        self.peer_name: str | None = None
+        self._ns: dict | None = None
+        self._rcv: list[tuple[str, bytes]] = []  # (src name or "", data)
+        self._set_state(on=FileState.WRITABLE)
+
+    @staticmethod
+    def make_pair() -> tuple["UnixDgramSocket", "UnixDgramSocket"]:
+        a, b = UnixDgramSocket(), UnixDgramSocket()
+        a.peer, b.peer = b, a
+        return a, b
+
+    peer: "UnixDgramSocket | None" = None
+
+    def bind_abstract(self, ns: dict, name: str):
+        if name in ns:
+            raise OSError(f"EADDRINUSE: @{name}")
+        ns[name] = self
+        self._ns = ns
+        self.bound_name = name
+
+    def connect_name(self, ns: dict, name: str):
+        if name not in ns or not isinstance(ns[name], UnixDgramSocket):
+            raise OSError("ECONNREFUSED")
+        self.peer_name = name
+        self._ns = ns if self._ns is None else self._ns
+
+    def _deliver(self, src_name: str, data: bytes) -> None:
+        if len(self._rcv) >= UNIX_DGRAM_QUEUE:
+            raise OSError("ENOBUFS: receive queue full")
+        self._rcv.append((src_name, data))
+        self._set_state(on=FileState.READABLE)
+
+    def send_to(self, ns: dict, name: str | None, data: bytes) -> int:
+        """sendto: explicit name wins; otherwise the connected peer (by
+        name) or the socketpair peer object."""
+        target = None
+        if name is not None:
+            target = ns.get(name)
+        elif self.peer_name is not None:
+            target = ns.get(self.peer_name)
+        elif self.peer is not None and not self.peer.closed:
+            target = self.peer
+        if not isinstance(target, UnixDgramSocket) or target.closed:
+            raise OSError("ECONNREFUSED")
+        target._deliver(self.bound_name or "", bytes(data))
+        return len(data)
+
+    def recv_from(self, n: int) -> tuple[bytes, str] | None:
+        if not self._rcv:
+            return None
+        src, data = self._rcv.pop(0)
+        if not self._rcv:
+            self._set_state(off=FileState.READABLE)
+        return data[:n], src  # short buffer truncates, like SOCK_DGRAM
+
+    def read(self, n: int) -> bytes | None:
+        r = self.recv_from(n)
+        return None if r is None else r[0]
+
+    def peek(self, n: int) -> bytes | None:
+        if not self._rcv:
+            return None
+        return self._rcv[0][1][:n]
+
+    def write(self, data: bytes) -> int:
+        return self.send_to(self._ns or {}, None, data)
+
+    def close(self):
+        if self.bound_name is not None and self._ns is not None:
+            self._ns.pop(self.bound_name, None)
+        self._rcv.clear()
         super().close()

@@ -605,7 +605,11 @@ class NativeProcess:
     # reference's resource watchdog, manager.rs:447-454), NOT a scheduling
     # device — a slow machine only ever makes the sim slower, never changes
     # results, unless a child genuinely exceeds this budget.
-    WALL_TIMEOUT_S = 60.0
+    # wall-clock watchdogs, NOT simulated time: generous because a loaded
+    # box (e.g. an XLA compile hogging the only core) can starve the child
+    # for tens of seconds; overridable for slower CI machines
+    WALL_TIMEOUT_S = float(os.environ.get("SHADOW_TPU_WALL_TIMEOUT", 60.0))
+    START_TIMEOUT_S = float(os.environ.get("SHADOW_TPU_START_TIMEOUT", 30.0))
 
     def __init__(self, host, pid: int, name: str, argv: list[str],
                  env: dict | None = None, ipc_path: str | None = None):
@@ -678,7 +682,7 @@ class NativeProcess:
             stdin=subprocess.DEVNULL,
         )
         self.state = "running"
-        msg = self.ipc.recv_any(timeout_s=10.0)
+        msg = self.ipc.recv_any(timeout_s=self.START_TIMEOUT_S)
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return
@@ -1059,7 +1063,7 @@ class NativeProcess:
         if self.state != "running":
             return
         self.ipc.set_time(self.host.now())
-        msg = self.ipc.recv_any(timeout_s=10.0)
+        msg = self.ipc.recv_any(timeout_s=self.START_TIMEOUT_S)
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return
@@ -2351,16 +2355,20 @@ class NativeProcess:
         return True
 
     def _handle_socketpair(self, args: list[int]) -> bool:
-        from shadow_tpu.host.unix import UnixStreamSocket
+        from shadow_tpu.host.unix import UnixDgramSocket, UnixStreamSocket
 
         domain, typ = args[0], args[1]
         if domain != AF_UNIX:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAFNOSUPPORT)
             return False
-        if typ & SOCK_TYPE_MASK != SOCK_STREAM:
+        kind = typ & SOCK_TYPE_MASK
+        if kind == SOCK_STREAM:
+            a, b = UnixStreamSocket.make_pair()
+        elif kind == SOCK_DGRAM:
+            a, b = UnixDgramSocket.make_pair()
+        else:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EOPNOTSUPP)
             return False
-        a, b = UnixStreamSocket.make_pair()
         fds = []
         for s in (a, b):
             fd = self._next_vfd
@@ -2540,7 +2548,7 @@ class NativeProcess:
         self.argv = argv or [path]
         self.ipc = new_ipc
         self._child = new_child
-        msg = self.ipc.recv_any(timeout_s=10.0)
+        msg = self.ipc.recv_any(timeout_s=self.START_TIMEOUT_S)
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return True
@@ -2738,6 +2746,10 @@ class NativeProcess:
                 from shadow_tpu.host.unix import UnixStreamSocket
 
                 sock = UnixStreamSocket()
+            elif domain == AF_UNIX and kind == SOCK_DGRAM:
+                from shadow_tpu.host.unix import UnixDgramSocket
+
+                sock = UnixDgramSocket()
             elif domain == AF_NETLINK:
                 from shadow_tpu.host.netlink import NetlinkSocket
 
@@ -2760,10 +2772,12 @@ class NativeProcess:
             return False
 
         from shadow_tpu.host.netlink import NetlinkSocket
-        from shadow_tpu.host.unix import UnixStreamSocket
+        from shadow_tpu.host.unix import UnixDgramSocket, UnixStreamSocket
 
         if isinstance(sock, UnixStreamSocket):
             return self._handle_unix_socket(num, args, sock)
+        if isinstance(sock, UnixDgramSocket):
+            return self._handle_unix_dgram(num, args, sock)
         if isinstance(sock, NetlinkSocket):
             return self._handle_netlink_socket(num, args, sock)
 
@@ -2986,6 +3000,17 @@ class NativeProcess:
         (reference keeps real fs sockets; abstract_unix_ns.rs for @names)."""
         return self.host.netns.abstract_unix
 
+    def _read_sun(self, ptr: int, alen: int) -> str | None:
+        """Decode a sockaddr_un into the namespace key ('@name' for
+        abstract, the path otherwise)."""
+        raw = _vm_read(self._child.pid, ptr, min(max(alen, 2), 110))
+        if len(raw) < 2 or struct.unpack("<H", raw[:2])[0] != AF_UNIX:
+            return None
+        path = raw[2:]
+        if path.startswith(b"\0"):  # abstract: name is length-bounded
+            return "@" + path[1:].decode("utf-8", "surrogateescape")
+        return path.split(b"\0", 1)[0].decode("utf-8", "surrogateescape")
+
     def _handle_unix_socket(self, num: int, args: list[int], sock) -> bool:
         """AF_UNIX stream sockets for native binaries: bind (abstract or
         path), listen, accept, connect — same-host only, like the kernel
@@ -2997,15 +3022,7 @@ class NativeProcess:
         S = SYS
         reply = self.ipc.reply
         fd = args[0]
-
-        def read_sun(ptr: int, alen: int) -> str | None:
-            raw = _vm_read(cpid, ptr, min(max(alen, 2), 110))
-            if len(raw) < 2 or struct.unpack("<H", raw[:2])[0] != AF_UNIX:
-                return None
-            path = raw[2:]
-            if path.startswith(b"\0"):  # abstract: name is length-bounded
-                return "@" + path[1:].decode("utf-8", "surrogateescape")
-            return path.split(b"\0", 1)[0].decode("utf-8", "surrogateescape")
+        read_sun = self._read_sun
 
         if num == S["bind"]:
             name = read_sun(args[1], args[2])
@@ -3150,6 +3167,104 @@ class NativeProcess:
                         _vm_write(cpid, args[4], struct.pack("<I", 4))
                 except OSError:
                     pass
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+        return False
+
+    def _handle_unix_dgram(self, num: int, args: list[int], sock) -> bool:
+        """AF_UNIX datagram sockets (glibc syslog's /dev/log transport;
+        reference socket/unix.rs dgram): boundaries preserved, sendto by
+        name or connected peer, same-host only."""
+        from shadow_tpu.host.filestate import FileState
+
+        cpid = self._child.pid
+        S = SYS
+        reply = self.ipc.reply
+        fd = args[0]
+
+        if num == S["bind"]:
+            name = self._read_sun(args[1], args[2])
+            if not name:
+                reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                return False
+            try:
+                sock.bind_abstract(self._unix_ns(), name)
+            except OSError:
+                reply(MSG_SYSCALL_COMPLETE, -errno.EADDRINUSE)
+                return False
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num == S["connect"]:
+            name = self._read_sun(args[1], args[2])
+            try:
+                sock.connect_name(self._unix_ns(), name or "")
+            except OSError:
+                reply(MSG_SYSCALL_COMPLETE, -ECONNREFUSED)
+                return False
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num == S["sendto"]:
+            data = _vm_read(cpid, args[1], min(args[2], 1 << 20))
+            name = self._read_sun(args[4], args[5]) if args[4] else None
+            try:
+                n = sock.send_to(self._unix_ns(), name, data)
+            except OSError as e:
+                reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+                return False
+            reply(MSG_SYSCALL_COMPLETE, n)
+            return False
+
+        if num == S["recvfrom"]:
+            peek = bool(args[3] & MSG_PEEK)
+            n_req = min(args[2], 1 << 20)
+            if peek:
+                pk = sock.peek(n_req)
+                r = None if pk is None else (pk, "")
+            else:
+                r = sock.recv_from(n_req)
+            if r is None:
+                if self._nonblock(fd):
+                    reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                    return False
+                self._block_on(
+                    [(sock, FileState.READABLE | FileState.CLOSED)],
+                    num, args,
+                )
+                return True
+            data, src = r
+            _vm_write(cpid, args[1], data)
+            if args[4] and src:
+                sa = struct.pack("<H", AF_UNIX)
+                sa += (b"\0" + src[1:].encode()) if src.startswith("@") \
+                    else src.encode() + b"\0"
+                try:
+                    _write_sockaddr(cpid, args[4], args[5], sa)
+                except OSError:
+                    pass
+            reply(MSG_SYSCALL_COMPLETE, len(data))
+            return False
+
+        if num in (S["getsockname"], S["getpeername"]):
+            name = (sock.bound_name if num == S["getsockname"]
+                    else sock.peer_name) or ""
+            sa = struct.pack("<H", AF_UNIX)
+            if name.startswith("@"):
+                sa += b"\0" + name[1:].encode()
+            elif name:
+                sa += name.encode() + b"\0"
+            try:
+                _write_sockaddr(cpid, args[1], args[2], sa)
+            except OSError:
+                reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+
+        if num in (S["setsockopt"], S["getsockopt"], S["shutdown"]):
             reply(MSG_SYSCALL_COMPLETE, 0)
             return False
 

@@ -144,3 +144,13 @@ def test_netlink_rtm_getaddr_dump():
     assert "addr lo 127.0.0.1" in out
     assert "addr eth0 10.0.0.1" in out
     assert "netlink ok found=2" in out
+
+
+def test_unix_dgram_sockets():
+    """AF_UNIX datagram sockets: named (syslog /dev/log shape) with
+    preserved message boundaries, plus dgram socketpair."""
+    hosts, net = two_hosts()
+    p = spawn_native(hosts[0], [UNIXNL, "dgram"])
+    net.run(5 * SEC)
+    assert p.exit_code == 0, b"".join(p.stderr)
+    assert b"dgram ok" in b"".join(p.stdout)
