@@ -6,6 +6,13 @@ static neighbors. Fan-out uses the engine's continuation pattern: one packet
 per microstep, with a same-timestamp local continuation event walking the
 neighbor list — deterministic order, no dynamic shapes (see
 models/base.py contract).
+
+Repeated-flood mode: `publisher: true` + `publish_interval: "1 s"` floods a
+fresh GENERATION every interval (the steady-state pubsub measurement —
+one-shot floods are compile-dominated at 100k hosts). Hosts adopt a message
+whose generation exceeds their own, reset their forwarding walk, and drop
+stale continuations; assumes a single publisher (generations are its
+sequence numbers).
 """
 
 from __future__ import annotations
@@ -48,16 +55,29 @@ class GossipModel:
         # avoid self-loops deterministically
         self_rows = neighbors == np.arange(h)[:, None]
         neighbors = np.where(self_rows, (neighbors + 1) % h, neighbors)
+        from shadow_tpu.config.units import TimeUnit, parse_time_ns
+
+        interval = np.array(
+            [
+                parse_time_ns(
+                    hh["model_args"].get("publish_interval", 0), TimeUnit.MS
+                )
+                for hh in hosts
+            ],
+            np.int64,
+        )
         params = {
             "neighbors": jnp.asarray(neighbors),
             "size": jnp.asarray(size),
             "fanout": jnp.asarray(fanout),
+            "interval": jnp.asarray(interval),
         }
         state = {
-            "seen": jnp.zeros((h,), bool),
+            "gen": jnp.zeros((h,), jnp.int32),
             "recv_time": jnp.full((h,), -1, jnp.int64),
             "hops": jnp.full((h,), -1, jnp.int32),
             "fwd_idx": jnp.zeros((h,), jnp.int32),
+            "adopted": jnp.zeros((h,), jnp.int64),  # total fresh adoptions
         }
         events = []
         for hh in hosts:
@@ -67,28 +87,46 @@ class GossipModel:
 
     def handle(self, ctx: HandlerCtx) -> HandlerOut:
         h = ctx.kind.shape[0]
-        seen = ctx.state["seen"]
-        msg = ctx.active & ((ctx.kind == KIND_MSG) | (ctx.kind == KIND_PUB))
-        fresh = msg & ~seen
-        hop = jnp.where(ctx.kind == KIND_PUB, 0, ctx.payload[:, 1] + 1)
+        gen = ctx.state["gen"]
+        pub = ctx.active & (ctx.kind == KIND_PUB)
+        msg = ctx.active & (ctx.kind == KIND_MSG)
+        # a publish starts generation own_gen+1; a message carries its
+        # generation in payload word 2 and is fresh if it beats ours
+        msg_gen = jnp.where(pub, gen + 1, ctx.payload[:, 2])
+        fresh = (pub | msg) & (msg_gen > gen)
+        hop = jnp.where(pub, 0, ctx.payload[:, 1] + 1)
 
-        # first sight: record + start the forwarding walk at neighbor 0
+        # fresh adoption: record + restart the forwarding walk at neighbor 0
         state = {
-            "seen": seen | fresh,
+            "gen": jnp.where(fresh, msg_gen, gen),
             "recv_time": jnp.where(fresh, ctx.t, ctx.state["recv_time"]),
             "hops": jnp.where(fresh, hop, ctx.state["hops"]),
-            "fwd_idx": ctx.state["fwd_idx"],
+            "fwd_idx": jnp.where(fresh, 0, ctx.state["fwd_idx"]),
+            "adopted": ctx.state["adopted"] + fresh,
         }
         zeros_payload = jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32)
         start_fwd = LocalPush(
             mask=fresh,
             t=ctx.t,
             kind=jnp.full((h,), KIND_FWD, jnp.int32),
-            payload=zeros_payload.at[:, 1].set(hop),
+            payload=zeros_payload.at[:, 1].set(hop).at[:, 2].set(msg_gen),
+        )
+        # repeated-flood mode: the publisher re-arms its own tick
+        repub = pub & (ctx.params["interval"] > 0)
+        pub_push = LocalPush(
+            mask=repub,
+            t=ctx.t + ctx.params["interval"],
+            kind=jnp.full((h,), KIND_PUB, jnp.int32),
+            payload=zeros_payload,
         )
 
-        # continuation: send to neighbors[fwd_idx], re-push until fanout done
-        fwd = ctx.active & (ctx.kind == KIND_FWD)
+        # continuation: send to neighbors[fwd_idx], re-push until fanout
+        # done; a continuation from a SUPERSEDED generation is dropped
+        fwd = (
+            ctx.active
+            & (ctx.kind == KIND_FWD)
+            & (ctx.payload[:, 2] == state["gen"])
+        )
         idx = state["fwd_idx"]
         more = fwd & (idx < ctx.params["fanout"])
         nbr = jnp.take_along_axis(
@@ -113,17 +151,21 @@ class GossipModel:
             payload=ctx.payload,
         )
         return HandlerOut(
-            state=state, rng=ctx.rng, pushes=(start_fwd, cont), sends=(send,)
+            state=state, rng=ctx.rng,
+            pushes=(start_fwd, pub_push, cont), sends=(send,),
         )
 
     def report(self, state, hosts):
-        seen = np.asarray(state["seen"])
+        g = np.asarray(state["gen"])
         hops = np.asarray(state["hops"])
         rt = np.asarray(state["recv_time"])
-        reached = seen.sum()
+        gmax = int(g.max())
+        reached = int((g == gmax).sum()) if gmax > 0 else 0
         return {
-            "reached": int(reached),
-            "coverage": float(reached / len(seen)),
+            "reached": reached,  # of the latest generation
+            "coverage": float(reached / len(g)) if gmax > 0 else 0.0,
+            "generations": gmax,
+            "adoptions": int(np.asarray(state["adopted"]).sum()),
             "max_hops": int(hops.max()),
             "spread_ms": float((rt.max() - rt[rt >= 0].min()) / 1e6) if reached else 0.0,
         }
