@@ -93,8 +93,11 @@ def main(argv: list[str] | None = None) -> int:
         print(f"config error: {e}", file=sys.stderr)
         return 2
     if args.dry_run:
+        # specs counts every simulated host; `hosts` on the co-sim plane
+        # holds only the CPU-backed (program) subset of a mixed config
+        n = len(getattr(sim, "specs", None) or sim.hosts)
         print(
-            f"config ok: {len(sim.hosts)} hosts, "
+            f"config ok: {n} hosts, "
             f"{sim.graph.num_nodes} graph nodes, "
             f"world={sim.engine_cfg.world}",
             file=sys.stderr,

@@ -403,7 +403,21 @@ class Engine:
     # ---- sharding specs ----------------------------------------------------
 
     def _model_specs(self, tree):
-        return jax.tree.map(lambda _: P(AXIS), tree)
+        """Model pytree sharding: host-dim sharded, EXCEPT dict keys named
+        `global_*`, which stay replicated — cross-host lookup tables a lane
+        must gather by GLOBAL host id (e.g. the mixed model's plane map;
+        same role as the engine's replicated node_of)."""
+
+        def walk(t):
+            if isinstance(t, dict):
+                return {
+                    k: (jax.tree.map(lambda _: P(), v)
+                        if k.startswith("global_") else walk(v))
+                    for k, v in t.items()
+                }
+            return jax.tree.map(lambda _: P(AXIS), t)
+
+        return walk(tree)
 
     def state_specs(self):
         sh, rep = P(AXIS), P()

@@ -64,6 +64,26 @@ from shadow_tpu import sim as simmod
 
 _BYTES_GC_WINDOWS = 1024  # sweep horizon for lost-packet payloads
 
+# magic value in the hybrid payload's flags word marking "the byte store
+# holds this key" — the echo-reconstruction in _drain_captures must fire
+# ONLY for payloads that originated as bridge send-requests (a model's own
+# payload words can collide with small live keys)
+BYTES_KEY_MAGIC = 0x53484457  # "SHDW"
+
+
+def _pad_tree(tree, pad: int):
+    """Zero-pad every [H_real, ...] leaf to [H_real + pad, ...] (the mixed
+    plane's analogue of sim.Simulation._pad)."""
+
+    def f(a):
+        a = np.asarray(a)
+        if pad == 0:
+            return jnp.asarray(a)
+        width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.asarray(np.pad(a, width))
+
+    return jax.tree.map(f, tree)
+
 
 class HybridSimulation:
     """Config-driven co-simulation (CLI-compatible with `Simulation`)."""
@@ -81,7 +101,23 @@ class HybridSimulation:
         if not self.specs:
             raise ConfigError("config defines no hosts")
         self.staging_cap = staging_cap
-        self.model = HybridModel()
+        # mixed simulations: any spec carrying a device model makes the
+        # lane plane heterogeneous (models/mixed.py); pure-program configs
+        # keep the plain hybrid proxy
+        model_names = {s.model for s in self.specs if s.model != "hybrid"}
+        if model_names:
+            from shadow_tpu.models.base import get_model
+            from shadow_tpu.models.mixed import MixedModel
+
+            if len(model_names) > 1:
+                raise ConfigError(
+                    f"mixed simulation supports one device model, got "
+                    f"{sorted(model_names)}"
+                )
+            inner_name = model_names.pop()
+            self.model = MixedModel(get_model(inner_name)(), inner_name)
+        else:
+            self.model = HybridModel()
         ex = cfg.experimental
         world = (
             simmod.resolve_world(cfg.general.parallelism)
@@ -138,9 +174,30 @@ class HybridSimulation:
             node_of[h.host_id] = h.node_index
             bw_up[h.host_id] = h.bw_up_bits
             bw_down[h.host_id] = h.bw_down_bits
-        mparams, mstate, _ = self.model.build(
-            [{"host_id": i} for i in range(ecfg.num_hosts)], cfg.general.seed
+        from shadow_tpu.models.mixed import MixedModel
+
+        if isinstance(self.model, MixedModel):
+            # build over the REAL lanes only, then zero-pad to the mesh
+            # size (exactly sim.py's _pad): building the inner model at the
+            # padded width would make results world-dependent — phold's
+            # num_hosts and gossip's neighbor draws change with H
+            lane_hosts = [
+                {
+                    "host_id": s.host_id,
+                    "name": s.name,
+                    "plane": "native" if s.programs else "model",
+                    "model_args": dict(s.model_args) if not s.programs else {},
+                    "start_time": s.start_time,
+                }
+                for s in self.specs
+            ]
+        else:
+            lane_hosts = [{"host_id": i} for i in range(self._num_real)]
+        mparams, mstate, initial_events = self.model.build(
+            lane_hosts, cfg.general.seed
         )
+        mparams = _pad_tree(mparams, ecfg.num_hosts - self._num_real)
+        mstate = _pad_tree(mstate, ecfg.num_hosts - self._num_real)
         with eng.host_build_context():
             params = EngineParams(
                 node_of=jnp.asarray(node_of),
@@ -153,17 +210,22 @@ class HybridSimulation:
             )
             mstate_dev = jax.tree.map(jnp.asarray, mstate)
         self.state, self.params = self.engine.init_state(
-            params, mstate_dev, [], seed=cfg.general.seed
+            params, mstate_dev, initial_events, seed=cfg.general.seed
         )
 
-        # CPU side
-        self.hosts: list[CpuHost] = []
+        # CPU side: one CpuHost per PROGRAM spec; modeled specs live only
+        # on device (but are registered in DNS and the IP map, so real
+        # processes can address them by name or IP)
         self.ip_to_gid: dict[str, int] = {}
         self.dns = Dns()
         for s in self.specs:
             self.dns.register(s.name, s.ip)
             self.ip_to_gid[s.ip] = s.host_id
-        for s in self.specs:
+        self.native_specs = [s for s in self.specs if s.programs]
+        self._model_gids = {s.host_id for s in self.specs if not s.programs}
+        self.hosts: list[CpuHost] = []
+        self._host_by_gid: dict[int, CpuHost] = {}
+        for s in self.native_specs:
             h = CpuHost(
                 HostConfig(
                     name=s.name,
@@ -176,8 +238,9 @@ class HybridSimulation:
             h.egress = self._stage_send
             h.resolver = self.dns.resolve
             self.hosts.append(h)
+            self._host_by_gid[s.host_id] = h
         self.procs = []
-        for s, h in zip(self.specs, self.hosts):
+        for s, h in zip(self.native_specs, self.hosts):
             for p in s.programs:
                 args = dict(p.get("args") or {})
                 if "/" in p["path"]:
@@ -222,12 +285,12 @@ class HybridSimulation:
                 path = os.path.join(data_dir, path)
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self.log = SimLogger(path, level=cfg.general.log_level)
-            for s, h in zip(self.specs, self.hosts):
+            for s, h in zip(self.native_specs, self.hosts):
                 h.on_process_exit = functools.partial(
                     _log_process_exit, self.log, h
                 )
         strace_mode = cfg.experimental.strace_logging_mode
-        for s, h in zip(self.specs, self.hosts):
+        for s, h in zip(self.native_specs, self.hosts):
             host_dir = os.path.join(data_dir, "hosts", s.name)
             if s.pcap_enabled:
                 os.makedirs(host_dir, exist_ok=True)
@@ -344,7 +407,9 @@ class HybridSimulation:
     # ---- window loop -------------------------------------------------------
 
     def _cpu_min_next(self) -> int:
-        return min(h.next_event_time() for h in self.hosts)
+        return min(
+            (h.next_event_time() for h in self.hosts), default=TIME_MAX
+        )
 
     def run(self, *, progress: bool | None = None, log=sys.stderr) -> dict:
         try:
@@ -463,6 +528,9 @@ class HybridSimulation:
             src[i] = gid
             t[i] = t_ns
             dstw[i] = gid  # send-request is a LOCAL event on the source host
+            # flags word: marks "bytes stored under (src, key)" so the echo
+            # reconstruction can trust the key (see BYTES_KEY_MAGIC)
+            payload[i, 3] = BYTES_KEY_MAGIC
             # key doubles as the order tiebreak: under round-robin the list
             # order changed, so re-sequence (the payload keeps the original
             # key for the byte-store lookup)
@@ -493,29 +561,61 @@ class HybridSimulation:
         m = self.state.model
         ms = dict(
             zip(
-                ("cap_t", "cap_src", "cap_key"),
-                jax.device_get((m["cap_t"], m["cap_src"], m["cap_key"])),
+                ("cap_t", "cap_src", "cap_key", "cap_size", "cap_flags"),
+                jax.device_get(
+                    (m["cap_t"], m["cap_src"], m["cap_key"], m["cap_size"],
+                     m["cap_flags"])
+                ),
             )
         )
         # rings are drained: clear the device-side counters so the guarded
         # batch's probe sees a clean slate and nothing is delivered twice
         self.state = self._clear_caps(self.state)
         for gid in np.nonzero(cap_n > 0)[0]:
-            if gid >= len(self.hosts):
-                continue  # mesh-padding host: nothing can route to it
-            host = self.hosts[int(gid)]
+            host = self._host_by_gid.get(int(gid))
+            if host is None:
+                continue  # modeled or mesh-padding lane: no CPU plane
+
             for j in range(int(cap_n[gid])):
                 t = int(ms["cap_t"][gid, j])
                 src = int(ms["cap_src"][gid, j])
                 key = int(ms["cap_key"][gid, j])
-                entry = (
-                    self._bytes[src].pop(key, None)
-                    if 0 <= src < len(self._bytes)
-                    else None
-                )
-                if entry is None:
-                    continue  # duplicate capture (cannot happen) or GC'd
-                pkt = entry[1]
+                pkt = None
+                if src in self._model_gids:
+                    # model-plane origin: there is no byte store. If the
+                    # payload still carries our send-request magic, the
+                    # modeled peer ECHOED our request payload verbatim
+                    # (udp_echo does): reconstruct the endpoint-swapped
+                    # reply from our own bytes — exact echo semantics
+                    # including ports. Without the magic, the key is just a
+                    # model payload word (possibly colliding with a live
+                    # key): synthesize a zero-filled datagram instead.
+                    echoed = int(ms["cap_flags"][gid, j]) == BYTES_KEY_MAGIC
+                    own = self._bytes[gid].pop(key, None) if echoed else None
+                    src_ip = self.specs[src].ip
+                    if own is not None:
+                        q = own[1]
+                        pkt = NetPacket(
+                            src_ip=src_ip, src_port=q.dst_port,
+                            dst_ip=q.src_ip, dst_port=q.src_port,
+                            proto=q.proto, payload=q.payload,
+                        )
+                    else:
+                        size = max(int(ms["cap_size"][gid, j]), 0)
+                        pkt = NetPacket(
+                            src_ip=src_ip, src_port=40000,
+                            dst_ip=host.ip, dst_port=40000,
+                            proto=17, payload=b"\0" * size,
+                        )
+                else:
+                    entry = (
+                        self._bytes[src].pop(key, None)
+                        if 0 <= src < len(self._bytes)
+                        else None
+                    )
+                    if entry is None:
+                        continue  # duplicate capture (cannot happen) or GC'd
+                    pkt = entry[1]
                 host.schedule(t, functools.partial(host.deliver_packet, pkt))
 
     def _gc_bytes(self):
@@ -585,7 +685,7 @@ class HybridSimulation:
             json.dump(report or self.stats_report(), f, indent=2)
         with open(os.path.join(data_dir, "hosts.txt"), "w") as f:
             f.write(self.dns.hosts_file())  # reference per-host hostname files
-        for spec, host in zip(self.specs, self.hosts):
+        for spec, host in zip(self.native_specs, self.hosts):
             hd = os.path.join(data_dir, "hosts", spec.name)
             os.makedirs(hd, exist_ok=True)
             for p in host.processes.values():

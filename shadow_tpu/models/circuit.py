@@ -49,6 +49,7 @@ PW_HD = 3  # hop | dir << 8
 @register_model
 class CircuitModel:
     name = "circuit"
+    wire_kind = KIND_CELL  # cross-plane packets arrive as cells (mixed sims)
 
     def build(self, hosts, seed):
         h = len(hosts)

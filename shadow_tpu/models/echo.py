@@ -46,6 +46,7 @@ def _unpack_t(lo, hi):
 @register_model
 class UdpEchoModel:
     name = "udp_echo"
+    wire_kind = KIND_REQ  # cross-plane packets arrive as requests (mixed sims)
 
     def build(self, hosts, seed):
         h = len(hosts)

@@ -37,6 +37,7 @@ KIND_PUB = 2  # publisher's initial event
 @register_model
 class GossipModel:
     name = "gossip"
+    wire_kind = KIND_MSG  # cross-plane packets arrive as gossip messages (mixed sims)
 
     def build(self, hosts, seed):
         h = len(hosts)

@@ -61,6 +61,7 @@ class HybridModel:
             "cap_src": np.zeros((h, c), np.int64),
             "cap_key": np.zeros((h, c), np.int32),
             "cap_size": np.zeros((h, c), np.int32),
+            "cap_flags": np.zeros((h, c), np.int32),
             "cap_n": np.zeros((h,), np.int32),
             "cap_lost": np.zeros((h,), np.int64),  # ring overflow (observability)
         }
@@ -89,6 +90,9 @@ class HybridModel:
             "cap_size": st["cap_size"]
             .at[hh, slot]
             .set(ctx.payload[:, PW_SIZE], mode="drop"),
+            "cap_flags": st["cap_flags"]
+            .at[hh, slot]
+            .set(ctx.payload[:, PW_FLAGS], mode="drop"),
             "cap_n": n + slot_ok.astype(jnp.int32),
             "cap_lost": st["cap_lost"] + (is_data & ~slot_ok),
         }

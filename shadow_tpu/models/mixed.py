@@ -1,0 +1,157 @@
+"""Mixed plane: device-modeled hosts and CPU-emulated hosts in ONE simulation.
+
+The reference runs every host as a managed process; this framework's scale
+comes from modeling most hosts on device. Mixed simulations combine both:
+e.g. thousands of modeled servers under load from a handful of REAL
+binaries — the traffic all flows through one device network (same token
+buckets, loss, latency, exchange), so the real processes experience the
+modeled fleet's congestion and vice versa.
+
+Mechanics: every host owns one device lane. Native lanes run the hybrid
+proxy (capture ring + send requests, models/hybrid.py); modeled lanes run
+the inner model. A replicated `global_is_native` table (gathered by global
+host id, like the engine's node_of) routes each event to the right handler
+and translates packet kinds at the plane boundary:
+
+  native -> model : delivered as `inner.wire_kind` (the kind the model
+                    treats as its network packet; models declare it)
+  model -> native : delivered as the hybrid KIND_DATA so the capture ring
+                    picks it up
+
+Cross-plane BYTES: device payloads carry no bytes. When a model lane
+*echoes* a request payload back (udp_echo does), the bridge reconstructs
+the reply from the requester's own byte store (endpoint-swapped) — exact
+echo semantics including ports. Non-echo model->native deliveries have no
+bytes to reconstruct and are synthesized as zero-filled datagrams
+(cosim._drain_captures), mirroring the modeled-pcap convention.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.models.base import HandlerCtx, HandlerOut, KIND_MASK
+from shadow_tpu.models.hybrid import KIND_DATA, HybridModel
+
+
+class MixedModel:
+    name = "mixed"
+
+    def __init__(self, inner, inner_name: str):
+        self.hybrid = HybridModel()
+        self.inner = inner
+        self.inner_name = inner_name
+        self.wire_kind = getattr(inner, "wire_kind", None)
+        self.capture_cap = self.hybrid.capture_cap
+
+    def build(self, hosts, seed):
+        """`hosts`: per-lane dicts with "plane" in {"native", "model"};
+        modeled lanes carry real model_args, native lanes get a benign
+        stand-in (they are fully masked in the inner handler)."""
+        is_native = np.array(
+            [h.get("plane") == "native" for h in hosts], bool
+        )
+        model_hosts = [h for h in hosts if not is_native[h["host_id"]]]
+        if model_hosts:
+            proto_args = model_hosts[0].get("model_args", {})
+        else:
+            proto_args = {}
+        inner_hosts = [
+            dict(h) if not is_native[h["host_id"]]
+            else {**h, "model_args": dict(proto_args)}
+            for h in hosts
+        ]
+        hyb_params, hyb_state, _ = self.hybrid.build(hosts, seed)
+        in_params, in_state, in_events = self.inner.build(inner_hosts, seed)
+        self._inner_hosts = inner_hosts  # for report(): per-lane args/roles
+        # keep only REAL modeled lanes' initial events: native lanes boot
+        # their processes on the CPU plane; mesh-padding lanes stay inert
+        live_model = np.array(
+            [not h.get("pad") and h.get("plane") != "native" for h in hosts],
+            bool,
+        )
+        events = [e for e in in_events if live_model[e[0]]]
+        params = {
+            **hyb_params,
+            "inner": in_params,
+            "global_is_native": is_native,
+        }
+        state = {**hyb_state, "inner": in_state}
+        return params, state, events
+
+    def handle(self, ctx: HandlerCtx) -> HandlerOut:
+        p = ctx.params
+        # replicated table gathered by GLOBAL host id: this lane's plane
+        native_lane = p["global_is_native"][ctx.host_id]
+
+        hyb_ctx = HandlerCtx(
+            t=ctx.t, window_end=ctx.window_end, kind=ctx.kind,
+            payload=ctx.payload, active=ctx.active & native_lane,
+            is_packet=ctx.is_packet, src=ctx.src, host_id=ctx.host_id,
+            state={k: v for k, v in ctx.state.items() if k != "inner"},
+            params={k: v for k, v in p.items()
+                    if k not in ("inner", "global_is_native")},
+            rng=ctx.rng,
+        )
+        hyb_out = self.hybrid.handle(hyb_ctx)
+
+        # packets crossing INTO the model plane arrive with hybrid kinds;
+        # deliver them as the inner model's wire kind so its handler fires
+        in_kind = ctx.kind
+        if self.wire_kind is not None:
+            from_native = ctx.is_packet & p["global_is_native"][
+                jnp.clip(ctx.src, 0, p["global_is_native"].shape[0] - 1)
+            ]
+            in_kind = jnp.where(
+                from_native, jnp.int32(self.wire_kind), in_kind
+            )
+        in_ctx = HandlerCtx(
+            t=ctx.t, window_end=ctx.window_end, kind=in_kind,
+            payload=ctx.payload, active=ctx.active & ~native_lane,
+            is_packet=ctx.is_packet, src=ctx.src, host_id=ctx.host_id,
+            state=ctx.state["inner"], params=p["inner"], rng=hyb_out.rng,
+        )
+        in_out = self.inner.handle(in_ctx)
+
+        # packets crossing OUT of the model plane become hybrid data so the
+        # destination's capture ring picks them up
+        def translate(send):
+            dst_safe = jnp.clip(
+                send.dst, 0, p["global_is_native"].shape[0] - 1
+            )
+            to_native = send.mask & p["global_is_native"][dst_safe]
+            return send._replace(
+                kind=jnp.where(
+                    to_native, jnp.int32(KIND_DATA), send.kind & KIND_MASK
+                )
+            )
+
+        state = {
+            **hyb_out.state,
+            "inner": in_out.state,
+        }
+        return HandlerOut(
+            state=state,
+            rng=in_out.rng,
+            pushes=tuple(hyb_out.pushes) + tuple(in_out.pushes),
+            sends=tuple(hyb_out.sends)
+            + tuple(translate(s) for s in in_out.sends),
+        )
+
+    def report(self, state, hosts):
+        # state arrives mesh-PADDED; slice every leaf back to the real
+        # lanes so inner reports line up with their host list
+        n = len(self._inner_hosts)
+        state = {
+            k: (jnp.asarray(v)[:n] if not isinstance(v, dict)
+                else {kk: jnp.asarray(vv)[:n] for kk, vv in v.items()})
+            for k, v in state.items()
+        }
+        rep = dict(self.hybrid.report(
+            {k: v for k, v in state.items() if k != "inner"}, hosts
+        ))
+        rep[f"model_{self.inner_name}"] = self.inner.report(
+            state["inner"], hosts if hosts is not None else self._inner_hosts
+        )
+        return rep

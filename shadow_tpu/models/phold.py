@@ -32,6 +32,7 @@ KIND_MSG = 1  # message arrives: hold it, then it matures
 @register_model
 class PholdModel:
     name = "phold"
+    wire_kind = KIND_MSG  # cross-plane packets count as held messages (mixed sims)
 
     def build(self, hosts, seed):
         h = len(hosts)

@@ -204,18 +204,47 @@ def config_is_hybrid(cfg: ConfigOptions) -> bool:
 
 
 def expand_hosts_hybrid(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpec]:
-    """Config -> specs for co-simulation: every process is a managed program
-    run on a CpuHost; the device lane runs the hybrid proxy model."""
+    """Config -> specs for co-simulation. Program hosts (`path:`) run on
+    CpuHosts behind the hybrid device proxy; model hosts (`model:`) run
+    fully on device — a MIXED simulation shares one device network between
+    both planes (models/mixed.py)."""
     from shadow_tpu.programs import PROGRAM_REGISTRY
 
     specs: list[HostSpec] = []
     for i, h, node, ip, bw_down, bw_up in _resolve_host_basics(cfg, graph):
-        bad = [p for p in h.processes if p.path is None]
-        if bad:
-            raise ConfigError(
-                f"host {h.name!r}: mixing device models and managed programs "
-                f"in one simulation is not supported yet"
+        model_procs = [p for p in h.processes if p.model is not None]
+        if model_procs:
+            if len(h.processes) != 1:
+                raise ConfigError(
+                    f"host {h.name!r}: a modeled host runs exactly one "
+                    f"model process (got {len(h.processes)} processes)"
+                )
+            p = model_procs[0]
+            # loud rejection instead of silent intent-dropping: the mixed
+            # plane does not (yet) honor these on modeled lanes
+            if p.shutdown_time is not None:
+                raise ConfigError(
+                    f"host {h.name!r}: shutdown_time on a modeled host in a "
+                    f"mixed simulation is not supported"
+                )
+            if h.host_options.pcap_enabled:
+                raise ConfigError(
+                    f"host {h.name!r}: pcap on a modeled host in a mixed "
+                    f"simulation is not supported (model packets carry no "
+                    f"bytes; enable pcap on the program hosts instead)"
+                )
+            specs.append(
+                HostSpec(
+                    host_id=i, name=h.name, node_index=node, ip=ip,
+                    bw_down_bits=bw_down, bw_up_bits=bw_up,
+                    model=p.model, model_args=dict(p.model_args),
+                    start_time=p.start_time, shutdown_time=None,
+                    pcap_enabled=False,
+                    pcap_capture_size=h.host_options.pcap_capture_size,
+                    programs=[],
+                )
             )
+            continue
         for p in h.processes:
             if "/" in p.path:
                 # real binary for the native managed-process plane

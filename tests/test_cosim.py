@@ -127,7 +127,10 @@ def test_hybrid_determinism_two_runs():
     assert once() == once()
 
 
-def test_mixed_model_and_program_rejected():
+def test_mixed_model_and_program_builds():
+    """Mixing device models and managed programs is supported since round 3
+    (models/mixed.py; full behavior covered in tests/test_mixed.py) — the
+    config simply builds a MixedModel-backed co-simulation."""
     cfg_dict = {
         "general": {"stop_time": "1 s"},
         "network": {"graph": {"type": "1_gbit_switch"}},
@@ -142,11 +145,14 @@ def test_mixed_model_and_program_rejected():
             },
         },
     }
-    from shadow_tpu.config.options import ConfigError
-
     cfg = ConfigOptions.from_dict(cfg_dict)
-    with pytest.raises(ConfigError, match="mixing"):
-        HybridSimulation(cfg)
+    sim = HybridSimulation(cfg, world=1)
+    from shadow_tpu.models.mixed import MixedModel
+
+    assert isinstance(sim.model, MixedModel)
+    r = sim.run()
+    # the modeled timer ticked on device while the program host idled
+    assert r["events_processed"] >= 1
 
 
 def test_build_simulation_factory_dispatch():
