@@ -323,6 +323,7 @@ class HybridSimulation:
         ]
         self._window_idx = 0
         self._unreach = [0] * len(self.specs)
+        self._model_pkts_unrouted = 0  # model->native with no UDP listener
         # parallel CPU host plane (reference thread_per_core.rs; see
         # CpuNetwork for the staging argument). GIL caveat: pure-Python
         # hosts serialize; native hosts block in futex waits off-GIL.
@@ -601,10 +602,25 @@ class HybridSimulation:
                             proto=q.proto, payload=q.payload,
                         )
                     else:
+                        # no byte store for model-plane origins: synthesize
+                        # a zero-filled datagram. Aim it at the host's
+                        # lowest bound UDP port (deterministic) so modeled-
+                        # initiated traffic actually reaches a native
+                        # listener; with none bound, fall back to 40000 and
+                        # count it (visible in stats, not a silent drop)
                         size = max(int(ms["cap_size"][gid, j]), 0)
+                        udp_ports = sorted(
+                            port for (proto, port) in host.netns._ports
+                            if proto == 17
+                        )
+                        if udp_ports:
+                            dst_port = udp_ports[0]
+                        else:
+                            dst_port = 40000
+                            self._model_pkts_unrouted += 1
                         pkt = NetPacket(
                             src_ip=src_ip, src_port=40000,
-                            dst_ip=host.ip, dst_port=40000,
+                            dst_ip=host.ip, dst_port=dst_port,
                             proto=17, payload=b"\0" * size,
                         )
                 else:
@@ -666,6 +682,7 @@ class HybridSimulation:
                 np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
             ),
             "unreachable_ips": sum(self._unreach),
+            "model_pkts_unrouted": self._model_pkts_unrouted,
             "syscalls": sum(h.counters["syscalls"] for h in self.hosts),
             "process_failures": failures,
             "processes_exited": len(zombies),

@@ -47,6 +47,11 @@ def _unpack_t(lo, hi):
 class UdpEchoModel:
     name = "udp_echo"
     wire_kind = KIND_REQ  # cross-plane packets arrive as requests (mixed sims)
+    # this protocol IS echo-the-payload: a native request's payload words
+    # (byte-store key + magic) must ride back verbatim so the bridge can
+    # reconstruct the exact reply bytes (cosim._drain_captures); the server
+    # path reads only word 0 (size), so raw hybrid words are harmless here
+    sanitize_wire_payload = False
 
     def build(self, hosts, seed):
         h = len(hosts)

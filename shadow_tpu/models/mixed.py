@@ -99,6 +99,7 @@ class MixedModel:
         # packets crossing INTO the model plane arrive with hybrid kinds;
         # deliver them as the inner model's wire kind so its handler fires
         in_kind = ctx.kind
+        in_payload = ctx.payload
         if self.wire_kind is not None:
             from_native = ctx.is_packet & p["global_is_native"][
                 jnp.clip(ctx.src, 0, p["global_is_native"].shape[0] - 1)
@@ -106,9 +107,24 @@ class MixedModel:
             in_kind = jnp.where(
                 from_native, jnp.int32(self.wire_kind), in_kind
             )
+            if getattr(self.inner, "sanitize_wire_payload", True):
+                # native-origin payload words are bridge bookkeeping (dst,
+                # byte-store key, magic), not the inner protocol's fields —
+                # e.g. gossip would adopt the monotonically increasing key
+                # as a fresh generation. Keep only word 0 (packet size, the
+                # one cross-plane-meaningful word) so foreign packets count
+                # as network load without forging protocol state. Models
+                # whose protocol IS echo-the-payload opt out (udp_echo: the
+                # echoed words carry the byte-store key back to the bridge).
+                keep = jnp.zeros_like(ctx.payload).at[:, 0].set(
+                    ctx.payload[:, 0]
+                )
+                in_payload = jnp.where(
+                    from_native[:, None], keep, ctx.payload
+                )
         in_ctx = HandlerCtx(
             t=ctx.t, window_end=ctx.window_end, kind=in_kind,
-            payload=ctx.payload, active=ctx.active & ~native_lane,
+            payload=in_payload, active=ctx.active & ~native_lane,
             is_packet=ctx.is_packet, src=ctx.src, host_id=ctx.host_id,
             state=ctx.state["inner"], params=p["inner"], rng=hyb_out.rng,
         )

@@ -95,7 +95,11 @@ def _vm_read_multi(pid: int, chunks: list[tuple[int, int]]) -> bytes:
     sendmsg with K iovecs costs one syscall instead of K. Returns the
     concatenation; a fault mid-way truncates at the faulting range, like
     the kernel's partial-transfer contract."""
-    chunks = [(a, n) for a, n in chunks if n > 0 and a != 0]
+    if any(a == 0 and n > 0 for a, n in chunks):
+        # a NULL base with nonzero length is EFAULT in the kernel; silently
+        # skipping it would shift subsequent data into the next iovec
+        raise OSError(errno.EFAULT, "iovec with NULL base")
+    chunks = [(a, n) for a, n in chunks if n > 0]
     if not chunks:
         return b""
     if len(chunks) == 1:
@@ -117,7 +121,9 @@ def _vm_read_multi(pid: int, chunks: list[tuple[int, int]]) -> bytes:
 def _vm_write_multi(pid: int, chunks: list[tuple[int, int]], data: bytes) -> int:
     """Scatter `data` across MANY remote ranges in ONE process_vm_writev
     call (readv/recvmsg out-params: K iovecs, one syscall)."""
-    chunks = [(a, n) for a, n in chunks if n > 0 and a != 0]
+    if any(a == 0 and n > 0 for a, n in chunks):
+        raise OSError(errno.EFAULT, "iovec with NULL base")
+    chunks = [(a, n) for a, n in chunks if n > 0]
     total = min(sum(n for _, n in chunks), len(data))
     if total == 0:
         return 0
@@ -633,6 +639,10 @@ class NativeProcess:
         self._vfd_flags: dict[int, int] = {}  # O_NONBLOCK etc.
         self._stdio_dups: dict[int, int] = {}  # vfd -> 1|2 (dup'd stdio)
         self._next_vfd = VFD_BASE
+        # fd numbers the child owns as REAL kernel fds in the vfd range
+        # (native dup2(realfd, N>=VFD_BASE)): the allocator must never hand
+        # them out as vfds or every intercepted syscall would shadow them
+        self._reserved_fds: set[int] = set()
         # threads: slot -> _Thread; slot 0 = main (vtid == pid, Linux-style)
         self.threads: dict[int, _Thread] = {0: _Thread(0, pid)}
         self.threads[0].state = "running"
@@ -1034,6 +1044,7 @@ class NativeProcess:
         child._vfd_flags = dict(self._vfd_flags)
         child._stdio_dups = dict(self._stdio_dups)
         child._next_vfd = self._next_vfd
+        child._reserved_fds = set(self._reserved_fds)
         for sock in child._vfds.values():
             sock._nrefs = getattr(sock, "_nrefs", 1) + 1
         self._pending_forks[fork_id] = child
@@ -1330,8 +1341,7 @@ class NativeProcess:
                 return False
             tgt = self._stdio_target(args[0])
             if tgt is not None:
-                nfd = self._next_vfd
-                self._next_vfd += 1
+                nfd = self._alloc_vfd()
                 self._stdio_dups[nfd] = tgt
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
             else:
@@ -1347,8 +1357,7 @@ class NativeProcess:
             # dup-via-fcntl of a captured stdio fd: must stay virtual, same
             # as dup(2) — a native dup would alias the child's real
             # stderr/stdout (DEVNULL) and silently swallow output
-            nfd = self._next_vfd
-            self._next_vfd += 1
+            nfd = self._alloc_vfd()
             self._stdio_dups[nfd] = self._stdio_target(args[0])
             self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
             return False
@@ -1388,8 +1397,7 @@ class NativeProcess:
             except OSError:
                 pathname = b""
             if pathname in (b"/dev/urandom", b"/dev/random"):
-                vfd = self._next_vfd
-                self._next_vfd += 1
+                vfd = self._alloc_vfd()
                 self._vfds[vfd] = _RandomFile(self.host)
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
                 return False
@@ -2001,10 +2009,15 @@ class NativeProcess:
         self._vfd_flags[new] = self._vfd_flags.get(old, 0)
         return new
 
-    def _dup_vfd(self, old: int) -> int:
+    def _alloc_vfd(self) -> int:
+        while self._next_vfd in self._reserved_fds:
+            self._next_vfd += 1
         nfd = self._next_vfd
         self._next_vfd += 1
-        return self._share_vfd(old, nfd)
+        return nfd
+
+    def _dup_vfd(self, old: int) -> int:
+        return self._share_vfd(old, self._alloc_vfd())
 
     def _close_virtual(self, fd: int):
         """Silently drop whatever virtual thing occupies `fd` (dup2 target
@@ -2040,6 +2053,10 @@ class NativeProcess:
         # target, so any virtual thing occupying that number must die too,
         # or the stale vfd would shadow the freshly dup'ed kernel fd
         self._close_virtual(new)
+        if new >= VFD_BASE:
+            # the child now owns a REAL kernel fd at this number; the vfd
+            # allocator must never hand it out (it would shadow the live fd)
+            self._reserved_fds.add(new)
         self.ipc.reply(MSG_SYSCALL_NATIVE)
         return False
 
@@ -2157,7 +2174,12 @@ class NativeProcess:
             try:
                 iovs = self._read_iovs(cpid, iov_ptr, iovlen)
             except OSError:
-                iovs = []
+                # faulting iovec array = EFAULT (not a 0-byte transfer the
+                # peer could observe), same contract as the msghdr fault
+                if done:
+                    break
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
             if sending:
                 try:
                     data = _vm_read_multi(
@@ -2371,8 +2393,7 @@ class NativeProcess:
             return False
         fds = []
         for s in (a, b):
-            fd = self._next_vfd
-            self._next_vfd += 1
+            fd = self._alloc_vfd()
             self._vfds[fd] = s
             if typ & SOCK_NONBLOCK:
                 self._vfd_flags[fd] = 0x800
@@ -2569,8 +2590,7 @@ class NativeProcess:
         reply = self.ipc.reply
 
         def new_vfd(obj) -> int:
-            fd = self._next_vfd
-            self._next_vfd += 1
+            fd = self._alloc_vfd()
             self._vfds[fd] = obj
             return fd
 
@@ -2757,8 +2777,7 @@ class NativeProcess:
             else:
                 reply(MSG_SYSCALL_COMPLETE, -EAFNOSUPPORT)
                 return False
-            fd = self._next_vfd
-            self._next_vfd += 1
+            fd = self._alloc_vfd()
             self._vfds[fd] = sock
             if typ & SOCK_NONBLOCK:
                 self._vfd_flags[fd] = 0x800
@@ -2825,8 +2844,7 @@ class NativeProcess:
                     [(sock, FileState.ACCEPTABLE | FileState.CLOSED)], num, args
                 )
                 return True
-            nfd = self._next_vfd
-            self._next_vfd += 1
+            nfd = self._alloc_vfd()
             self._vfds[nfd] = child
             if num == S["accept4"] and args[3] & SOCK_NONBLOCK:
                 self._vfd_flags[nfd] = 0x800
@@ -3074,8 +3092,7 @@ class NativeProcess:
                     num, args,
                 )
                 return True
-            nfd = self._next_vfd
-            self._next_vfd += 1
+            nfd = self._alloc_vfd()
             self._vfds[nfd] = child
             if num == S["accept4"] and args[3] & SOCK_NONBLOCK:
                 self._vfd_flags[nfd] = 0x800

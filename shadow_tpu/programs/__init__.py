@@ -72,6 +72,26 @@ def udp_ping(ctx):
 
 
 @register_program
+def udp_blast(ctx):
+    """Fire `count` datagrams at `server` without awaiting replies (one-way
+    load source for mixed-plane tests where the modeled peer never echoes)."""
+    server = ctx.args.get("server", "server")
+    port = int(ctx.args.get("port", 9000))
+    count = int(ctx.args.get("count", 5))
+    interval = int(ctx.args.get("interval_ns", 100 * MS))
+    size = int(ctx.args.get("size", 64))
+    ip = yield ("resolve", server)
+    fd = yield ("socket", "udp")
+    yield ("connect", fd, (ip, port))
+    for i in range(count):
+        yield ("sendto", fd, bytes([i % 256]) * size)
+        if i + 1 < count:
+            yield ("nanosleep", interval)
+    yield ("write_stdout", f"blast done {count}\n".encode())
+    yield ("exit", 0)
+
+
+@register_program
 def tgen_server(ctx):
     """Accept TCP connections; drain each until EOF (tgen fixed_size sink).
 

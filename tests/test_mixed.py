@@ -97,6 +97,47 @@ def test_real_binary_against_modeled_server():
     assert r["model_report"]["model_udp_echo"]["requests_served"] == 6
 
 
+def test_native_traffic_does_not_forge_gossip_state():
+    """Regression (r3 advisor): native-origin packets delivered to gossip
+    lanes carried the bridge's byte-store key in payload word 2 and were
+    adopted as spurious fresh generations, corrupting the flood state. The
+    mixed-plane crossing now sanitizes native payload words (models/mixed.py)
+    so foreign traffic counts as load, not protocol state."""
+    cfg = ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "2 s", "seed": 3},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {
+                "g": {
+                    "count": 4,
+                    "network_node_id": 0,
+                    "processes": [
+                        {"model": "gossip", "model_args": {"fanout": 2}}
+                    ],
+                },
+                "blaster": {
+                    "network_node_id": 0,
+                    "processes": [
+                        {
+                            "path": "udp_blast",
+                            "args": ["server=g1", "port=9000", "count=5"],
+                            "expected_final_state": {"exited": 0},
+                        }
+                    ],
+                },
+            },
+        }
+    )
+    sim = HybridSimulation(cfg, world=1)
+    r = sim.run()
+    assert r["process_failures"] == 0
+    assert r["packets_delivered"] >= 5  # the blasts did cross planes
+    m = r["model_report"]["model_gossip"]
+    # no publisher in this sim: native packets must not mint generations
+    assert m["generations"] == 0
+    assert m["adoptions"] == 0
+
+
 def test_mixed_two_runs_identical():
     def once():
         cfg = _cfg(

@@ -256,3 +256,26 @@ def test_rdtsc_rng_aslr_determinism():
     assert p_out(a) == p_out(b)
     c = run_one([TEST_DET], seed=99)[1]
     assert p_out(a) != p_out(c)
+
+
+def test_vm_multi_null_iovec_is_efault():
+    """Regression (r3 advisor): a NULL iov_base with nonzero length must be
+    EFAULT (kernel contract), not silently skipped — skipping shifted
+    subsequent bytes into the wrong iovec on readv/recvmsg paths."""
+    import ctypes
+    import errno
+
+    from shadow_tpu.native_plane import _vm_read_multi, _vm_write_multi
+
+    buf = ctypes.create_string_buffer(b"hello", 5)
+    addr = ctypes.addressof(buf)
+    pid = os.getpid()
+    assert _vm_read_multi(pid, [(addr, 5)]) == b"hello"
+    with pytest.raises(OSError) as e:
+        _vm_read_multi(pid, [(addr, 5), (0, 3)])
+    assert e.value.errno == errno.EFAULT
+    with pytest.raises(OSError) as e:
+        _vm_write_multi(pid, [(0, 3), (addr, 5)], b"abc")
+    assert e.value.errno == errno.EFAULT
+    # zero-length NULL iovec stays legal (kernel ignores it)
+    assert _vm_read_multi(pid, [(addr, 5), (0, 0)]) == b"hello"
