@@ -609,9 +609,17 @@ class HybridSimulation:
                         # listener; with none bound, fall back to 40000 and
                         # count it (visible in stats, not a silent drop)
                         size = max(int(ms["cap_size"][gid, j]), 0)
+                        # LISTENERS only: explicit binds below the ephemeral
+                        # range and not connected to a peer (a connected
+                        # client socket would filter our src anyway, and an
+                        # autobound client port is not a service endpoint)
+                        from shadow_tpu.host.netns import EPHEMERAL_START
+
                         udp_ports = sorted(
-                            port for (proto, port) in host.netns._ports
-                            if proto == 17
+                            port
+                            for (proto, port), s in host.netns._ports.items()
+                            if proto == 17 and port < EPHEMERAL_START
+                            and getattr(s, "peer_ip", None) is None
                         )
                         if udp_ports:
                             dst_port = udp_ports[0]

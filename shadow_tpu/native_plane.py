@@ -1424,7 +1424,11 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
                 return False
             # pwritev on captured stdio: treat as a plain gather write
-            data = self._gather_write(cpid, SYS["writev"], args)
+            try:
+                data = self._gather_write(cpid, SYS["writev"], args)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
             (self.stdout if tgt == 1 else self.stderr).append(data)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
             return False
@@ -1552,7 +1556,11 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
                 return False
             tgt = self._stdio_target(args[0])
-            data = self._gather_write(cpid, num, args)
+            try:
+                data = self._gather_write(cpid, num, args)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
             (self.stdout if tgt == 1 else self.stderr).append(data)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, len(data))
             return False
@@ -1578,7 +1586,11 @@ class NativeProcess:
             if args[2] > IOV_MAX:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
                 return False
-            data = self._gather_write(cpid, num, args)
+            try:
+                data = self._gather_write(cpid, num, args)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
             if not hasattr(sock, "PROTO"):
                 # eventfd/timerfd: same semantics as write(2) on the vfd
                 try:
