@@ -261,7 +261,7 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
             },
         }
         return cfg, "timer_1m_sim_seconds_per_wall_second", 30
-    raise SystemExit(f"unknown --config {n} (1, 2, 3, 5 supported)")
+    raise SystemExit(f"unknown --config {n} (1-5 supported)")
 
 
 def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:

@@ -65,6 +65,7 @@ typedef struct {
  * seccomp traps and forwards them like any other number. */
 #define SHADOW_SYS_RESOLVE 1000001 /* (name cstr ptr, u32be out ptr) -> 0|-errno */
 #define SHADOW_SYS_SELF_IP 1000002 /* (u32be out ptr) -> 0 */
+#define SHADOW_SYS_RESOLVE_REV 1000003 /* (u32be addr, buf ptr, len) -> 0|-errno */
 
 typedef struct {
     ShimChan to_shadow;

@@ -903,6 +903,199 @@ extern "C" struct hostent *gethostbyname(const char *name) {
     return &he;
 }
 
+/* Reverse lookups: glibc's gethostbyaddr/getnameinfo go through NSS and
+ * the REAL resolver (queries leak into the simulated network and time out
+ * — python's HTTPServer calls getfqdn() at startup and would stall 10
+ * simulated seconds). Answer from the simulator's registry instead.
+ * Reference: shim_api_addrinfo.c covers the same family. */
+extern "C" struct hostent *gethostbyaddr(const void *addr, socklen_t len,
+                                         int type) {
+    static struct hostent he;
+    static struct in_addr haddr;
+    static char *addr_list[2];
+    static char hname[256];
+    if (!g_ipc) {
+        static struct hostent *(*real)(const void *, socklen_t, int) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "gethostbyaddr");
+        return real ? real(addr, len, type) : nullptr;
+    }
+    if (type != AF_INET || len < 4 || !addr) {
+        h_errno = HOST_NOT_FOUND;
+        return nullptr;
+    }
+    uint32_t addr_be;
+    memcpy(&addr_be, addr, 4);
+    if (syscall(SHADOW_SYS_RESOLVE_REV, (long)addr_be, hname,
+                (long)sizeof hname) != 0) {
+        h_errno = HOST_NOT_FOUND;
+        return nullptr;
+    }
+    haddr.s_addr = addr_be;
+    addr_list[0] = (char *)&haddr;
+    addr_list[1] = nullptr;
+    he.h_name = hname;
+    he.h_aliases = addr_list + 1; /* empty, NULL-terminated */
+    he.h_addrtype = AF_INET;
+    he.h_length = 4;
+    he.h_addr_list = addr_list;
+    return &he;
+}
+
+/* CPython's socketmodule (and other NSS clients) use the reentrant _r
+ * forms; glibc's go through NSS/DNS, so they need the same interposition */
+extern "C" int gethostbyaddr_r(const void *addr, socklen_t len, int type,
+                               struct hostent *ret, char *buf, size_t buflen,
+                               struct hostent **result, int *h_errnop) {
+    if (!g_ipc) {
+        static int (*real)(const void *, socklen_t, int, struct hostent *,
+                           char *, size_t, struct hostent **, int *) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "gethostbyaddr_r");
+        return real ? real(addr, len, type, ret, buf, buflen, result, h_errnop)
+                    : ENOSYS;
+    }
+    *result = nullptr;
+    if (type != AF_INET || len < 4 || !addr) {
+        if (h_errnop)
+            *h_errnop = HOST_NOT_FOUND;
+        return EINVAL;
+    }
+    char name[256];
+    uint32_t addr_be;
+    memcpy(&addr_be, addr, 4);
+    if (syscall(SHADOW_SYS_RESOLVE_REV, (long)addr_be, name,
+                (long)sizeof name) != 0) {
+        if (h_errnop)
+            *h_errnop = HOST_NOT_FOUND;
+        return 0; /* glibc convention: 0 with *result == NULL */
+    }
+    /* layout into the caller's buffer: name cstr + 4-byte addr + ptr array */
+    size_t nlen = strlen(name) + 1;
+    size_t need = nlen + 4 + 3 * sizeof(char *) + 16 /* alignment slack */;
+    if (buflen < need) {
+        if (h_errnop)
+            *h_errnop = NETDB_INTERNAL;
+        return ERANGE;
+    }
+    char *p = buf;
+    memcpy(p, name, nlen);
+    char *nm = p;
+    p += nlen;
+    p += (8 - ((uintptr_t)p & 7)) & 7;
+    memcpy(p, &addr_be, 4);
+    char *ab = p;
+    p += 8;
+    char **ptrs = (char **)p;
+    ptrs[0] = ab;
+    ptrs[1] = nullptr;
+    ptrs[2] = nullptr;
+    ret->h_name = nm;
+    ret->h_aliases = ptrs + 1;
+    ret->h_addrtype = AF_INET;
+    ret->h_length = 4;
+    ret->h_addr_list = ptrs;
+    *result = ret;
+    return 0;
+}
+
+extern "C" int gethostbyname_r(const char *name, struct hostent *ret,
+                               char *buf, size_t buflen,
+                               struct hostent **result, int *h_errnop) {
+    if (!g_ipc) {
+        static int (*real)(const char *, struct hostent *, char *, size_t,
+                           struct hostent **, int *) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "gethostbyname_r");
+        return real ? real(name, ret, buf, buflen, result, h_errnop) : ENOSYS;
+    }
+    *result = nullptr;
+    uint32_t addr_be = 0;
+    if (!name || buflen < 64) {
+        if (h_errnop)
+            *h_errnop = NETDB_INTERNAL;
+        return name ? ERANGE : EINVAL;
+    }
+    if (parse_ipv4(name, &addr_be) != 0) {
+        if (!strcmp(name, "localhost")) {
+            addr_be = htonl(INADDR_LOOPBACK);
+        } else if (syscall(SHADOW_SYS_RESOLVE, name, &addr_be) != 0) {
+            if (h_errnop)
+                *h_errnop = HOST_NOT_FOUND;
+            return 0;
+        }
+    }
+    size_t nlen = strlen(name) + 1;
+    if (buflen < nlen + 4 + 3 * sizeof(char *) + 16) {
+        if (h_errnop)
+            *h_errnop = NETDB_INTERNAL;
+        return ERANGE;
+    }
+    char *p = buf;
+    memcpy(p, name, nlen);
+    char *nm = p;
+    p += nlen;
+    p += (8 - ((uintptr_t)p & 7)) & 7;
+    memcpy(p, &addr_be, 4);
+    char *ab = p;
+    p += 8;
+    char **ptrs = (char **)p;
+    ptrs[0] = ab;
+    ptrs[1] = nullptr;
+    ptrs[2] = nullptr;
+    ret->h_name = nm;
+    ret->h_aliases = ptrs + 1;
+    ret->h_addrtype = AF_INET;
+    ret->h_length = 4;
+    ret->h_addr_list = ptrs;
+    *result = ret;
+    return 0;
+}
+
+extern "C" int getnameinfo(const struct sockaddr *sa, socklen_t salen,
+                           char *host, socklen_t hostlen, char *serv,
+                           socklen_t servlen, int flags) {
+    if (!g_ipc) {
+        static int (*real)(const struct sockaddr *, socklen_t, char *,
+                           socklen_t, char *, socklen_t, int) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "getnameinfo");
+        return real ? real(sa, salen, host, hostlen, serv, servlen, flags)
+                    : EAI_SYSTEM;
+    }
+    if (!sa || salen < (socklen_t)sizeof(struct sockaddr_in))
+        return EAI_FAMILY;
+    if (sa->sa_family != AF_INET) {
+        /* non-IPv4 (axon's own event loop binds ::1): numeric-only via the
+         * real implementation — NI_NUMERICHOST keeps NSS/DNS out of it */
+        static int (*real)(const struct sockaddr *, socklen_t, char *,
+                           socklen_t, char *, socklen_t, int) = nullptr;
+        if (!real)
+            real = (decltype(real))dlsym(RTLD_NEXT, "getnameinfo");
+        return real ? real(sa, salen, host, hostlen, serv, servlen,
+                           flags | NI_NUMERICHOST)
+                    : EAI_FAMILY;
+    }
+    const struct sockaddr_in *sin = (const struct sockaddr_in *)sa;
+    if (serv && servlen > 0)
+        snprintf(serv, servlen, "%u", (unsigned)ntohs(sin->sin_port));
+    if (host && hostlen > 0) {
+        char name[256];
+        if (!(flags & NI_NUMERICHOST) &&
+            syscall(SHADOW_SYS_RESOLVE_REV, (long)sin->sin_addr.s_addr, name,
+                    (long)sizeof name) == 0) {
+            snprintf(host, hostlen, "%s", name);
+        } else if (!(flags & NI_NAMEREQD)) {
+            uint32_t a = ntohl(sin->sin_addr.s_addr);
+            snprintf(host, hostlen, "%u.%u.%u.%u", (a >> 24) & 255,
+                     (a >> 16) & 255, (a >> 8) & 255, a & 255);
+        } else {
+            return EAI_NONAME;
+        }
+    }
+    return 0;
+}
+
 /* two interfaces, like every simulated host: lo + eth0 (reference
  * namespace.rs builds exactly these) */
 extern "C" int getifaddrs(struct ifaddrs **ifap) {

@@ -1,0 +1,185 @@
+/* SCM_RIGHTS fd passing + signalfd under the shim.
+ * (Reference: socket/unix.rs ancillary handling; handler signalfd arm.)
+ *
+ * Parent forks a child connected by a unix STREAM socketpair; the parent
+ * creates a second socketpair ("payload") and passes one end to the child
+ * via SCM_RIGHTS. The child talks back over the passed fd — proving the
+ * descriptor object itself crossed processes. Then the parent routes
+ * SIGUSR1 into a signalfd and reads the siginfo record. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/signalfd.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#define CHECK(c) do { if (!(c)) { \
+    fprintf(stderr, "FAIL %s:%d %s errno=%d\n", __FILE__, __LINE__, #c, \
+            errno); return 1; } \
+} while (0)
+
+static int send_fd(int sock, int fd, const char *tag) {
+    struct iovec iov = { (void *)tag, strlen(tag) };
+    char cbuf[CMSG_SPACE(sizeof(int))];
+    memset(cbuf, 0, sizeof cbuf);
+    struct msghdr mh = {0};
+    mh.msg_iov = &iov;
+    mh.msg_iovlen = 1;
+    mh.msg_control = cbuf;
+    mh.msg_controllen = sizeof cbuf;
+    struct cmsghdr *cm = CMSG_FIRSTHDR(&mh);
+    cm->cmsg_level = SOL_SOCKET;
+    cm->cmsg_type = SCM_RIGHTS;
+    cm->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(cm), &fd, sizeof(int));
+    return sendmsg(sock, &mh, 0) == (ssize_t)strlen(tag) ? 0 : -1;
+}
+
+static int recv_fd(int sock, char *tag, size_t taglen) {
+    struct iovec iov = { tag, taglen };
+    char cbuf[CMSG_SPACE(sizeof(int))];
+    struct msghdr mh = {0};
+    mh.msg_iov = &iov;
+    mh.msg_iovlen = 1;
+    mh.msg_control = cbuf;
+    mh.msg_controllen = sizeof cbuf;
+    ssize_t n = recvmsg(sock, &mh, 0);
+    if (n <= 0) return -1;
+    tag[n] = 0;
+    for (struct cmsghdr *cm = CMSG_FIRSTHDR(&mh); cm;
+         cm = CMSG_NXTHDR(&mh, cm)) {
+        if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS) {
+            int fd;
+            memcpy(&fd, CMSG_DATA(cm), sizeof(int));
+            return fd;
+        }
+    }
+    return -2;
+}
+
+int main(void) {
+    int ctl[2];
+    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, ctl) == 0);
+    pid_t pid = fork();
+    CHECK(pid >= 0);
+    if (pid == 0) {  /* child: receive an fd, use it */
+        close(ctl[0]);
+        char tag[32];
+        int pfd = recv_fd(ctl[1], tag, sizeof tag - 1);
+        if (pfd < 0 || strcmp(tag, "payload") != 0) _exit(2);
+        if (write(pfd, "via-passed-fd", 13) != 13) _exit(3);
+        char ack[16];
+        ssize_t n = read(pfd, ack, sizeof ack);
+        if (n != 3 || memcmp(ack, "ack", 3) != 0) _exit(4);
+        _exit(0);
+    }
+    close(ctl[1]);
+    int pay[2];
+    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, pay) == 0);
+    CHECK(send_fd(ctl[0], pay[1], "payload") == 0);
+    close(pay[1]);
+    char buf[32];
+    ssize_t n = read(pay[0], buf, sizeof buf);
+    CHECK(n == 13 && memcmp(buf, "via-passed-fd", 13) == 0);
+    CHECK(write(pay[0], "ack", 3) == 3);
+    int status = -1;
+    CHECK(waitpid(pid, &status, 0) == pid);
+    CHECK(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+    printf("scm_rights ok\n");
+
+    /* signalfd: route SIGUSR1 to an fd instead of a handler */
+    sigset_t mask;
+    sigemptyset(&mask);
+    sigaddset(&mask, SIGUSR1);
+    sigprocmask(SIG_BLOCK, &mask, NULL);
+    int sfd = signalfd(-1, &mask, 0);
+    CHECK(sfd >= 0);
+    CHECK(kill(getpid(), SIGUSR1) == 0);
+    struct signalfd_siginfo si;
+    CHECK(read(sfd, &si, sizeof si) == sizeof si);
+    CHECK(si.ssi_signo == SIGUSR1);
+    CHECK(si.ssi_pid == (uint32_t)getpid()); /* sender attribution */
+    CHECK(close(sfd) == 0);
+    printf("signalfd ok\n");
+
+    /* addressed DGRAM sendmsg with rights: bind two abstract names, send
+     * a datagram BY NAME carrying an eventfd; a MSG_PEEK recvmsg must see
+     * the bytes but NOT consume the rights; the real recvmsg gets the fd
+     * and the sender's name */
+    int a = socket(AF_UNIX, SOCK_DGRAM, 0), b2 = socket(AF_UNIX, SOCK_DGRAM, 0);
+    CHECK(a >= 0 && b2 >= 0);
+    struct sockaddr_un ua = {0}, ub = {0};
+    ua.sun_family = ub.sun_family = AF_UNIX;
+    memcpy(ua.sun_path, "\0scm-a", 6);
+    memcpy(ub.sun_path, "\0scm-b", 6);
+    CHECK(bind(a, (struct sockaddr *)&ua, sizeof(sa_family_t) + 6) == 0);
+    CHECK(bind(b2, (struct sockaddr *)&ub, sizeof(sa_family_t) + 6) == 0);
+    int efd = eventfd(0, 0); /* an EMULATED descriptor (vfds cross; real
+                              * kernel fds are refused loudly) */
+    CHECK(efd >= 0);
+    {
+        struct iovec iov = { (void *)"dgram", 5 };
+        char cbuf[CMSG_SPACE(sizeof(int))];
+        memset(cbuf, 0, sizeof cbuf);
+        struct msghdr mh = {0};
+        mh.msg_name = &ub;
+        mh.msg_namelen = sizeof(sa_family_t) + 6;
+        mh.msg_iov = &iov;
+        mh.msg_iovlen = 1;
+        mh.msg_control = cbuf;
+        mh.msg_controllen = sizeof cbuf;
+        struct cmsghdr *cm = CMSG_FIRSTHDR(&mh);
+        cm->cmsg_level = SOL_SOCKET;
+        cm->cmsg_type = SCM_RIGHTS;
+        cm->cmsg_len = CMSG_LEN(sizeof(int));
+        memcpy(CMSG_DATA(cm), &efd, sizeof(int));
+        CHECK(sendmsg(a, &mh, 0) == 5);
+    }
+    char dbuf[16];
+    {   /* peek: bytes visible, rights NOT consumed */
+        struct iovec iov = { dbuf, sizeof dbuf };
+        char cbuf[CMSG_SPACE(sizeof(int))];
+        struct msghdr mh = {0};
+        mh.msg_iov = &iov;
+        mh.msg_iovlen = 1;
+        mh.msg_control = cbuf;
+        mh.msg_controllen = sizeof cbuf;
+        CHECK(recvmsg(b2, &mh, MSG_PEEK) == 5);
+        CHECK(CMSG_FIRSTHDR(&mh) == NULL); /* no rights on the peek */
+    }
+    {   /* consuming recvmsg: fd + sender name */
+        struct sockaddr_un from = {0};
+        struct iovec iov = { dbuf, sizeof dbuf };
+        char cbuf[CMSG_SPACE(sizeof(int))];
+        struct msghdr mh = {0};
+        mh.msg_name = &from;
+        mh.msg_namelen = sizeof from;
+        mh.msg_iov = &iov;
+        mh.msg_iovlen = 1;
+        mh.msg_control = cbuf;
+        mh.msg_controllen = sizeof cbuf;
+        CHECK(recvmsg(b2, &mh, 0) == 5 && !memcmp(dbuf, "dgram", 5));
+        CHECK(mh.msg_namelen >= sizeof(sa_family_t) + 6);
+        CHECK(!memcmp(from.sun_path, "\0scm-a", 6));
+        struct cmsghdr *cm = CMSG_FIRSTHDR(&mh);
+        CHECK(cm && cm->cmsg_type == SCM_RIGHTS);
+        int rfd;
+        memcpy(&rfd, CMSG_DATA(cm), sizeof(int));
+        CHECK(rfd != efd);
+        uint64_t v = 7;
+        CHECK(write(efd, &v, 8) == 8); /* write via the original... */
+        v = 0;
+        CHECK(read(rfd, &v, 8) == 8 && v == 7); /* ...read via the passed */
+        close(rfd);
+    }
+    close(a);
+    close(b2);
+    close(efd);
+    printf("dgram rights ok\n");
+    return 0;
+}

@@ -21,12 +21,33 @@ Design notes (vs reference architecture, cited per SURVEY.md):
     which is what makes the simulation bit-deterministic under any sharding.
 """
 
+import os as _os
+
 import jax as _jax
 
 # Simulated time is int64 nanoseconds (reference SimulationTime,
 # src/lib/shadow-shim-helper-rs/src/simulation_time.rs). TPU emulates i64; the
 # precision is required for deterministic event ordering.
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the reference starts instantly (main.c:11);
+# our first-chunk XLA compiles cost 40-140 s per fresh process. Caching
+# compiled executables on disk amortizes that across runs of the same
+# config (second run: <5 s, see BASELINE.md "warm start"). Opt out with
+# SHADOW_TPU_COMPILE_CACHE=off or point it elsewhere with =<dir>.
+_cache = _os.environ.get("SHADOW_TPU_COMPILE_CACHE", "")
+if _cache != "off":
+    _jax.config.update(
+        "jax_compilation_cache_dir",
+        _cache
+        or _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            ".xla_cache",
+        ),
+    )
+    # cache every compile that takes noticeable time (default threshold
+    # is 1 s; our engine compiles are the whole point of the cache)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from shadow_tpu.simtime import (  # noqa: E402
     NS_PER_SEC,

@@ -25,8 +25,12 @@ hard part 6):
   at >= window_end, so a packet staged in window N is always delivered into
   window N+1 or later on both planes.
 
-Single-device for now (the CPU plane itself is one Python process); pure
-modeled simulations scale over the mesh via `shadow_tpu.sim`.
+Multi-device: with `general.parallelism > 1` the device plane is
+shard-mapped over the mesh exactly like `Engine.run_chunk` — staged sends
+arrive replicated, each shard merges only its own hosts' rows, and capture
+rings are gathered back (mesh-invariance: `tests/test_cosim.py`,
+`tests/test_mixed.py`). The CPU plane stays one Python process; its
+parallelism is `experimental.host_workers`.
 """
 
 from __future__ import annotations
@@ -237,6 +241,7 @@ class HybridSimulation:
             )
             h.egress = self._stage_send
             h.resolver = self.dns.resolve
+            h.rev_resolver = self.dns.reverse
             self.hosts.append(h)
             self._host_by_gid[s.host_id] = h
         self.procs = []

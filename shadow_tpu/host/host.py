@@ -68,6 +68,8 @@ class CpuHost:
         self.pcap_eth = None
         # name -> ip resolution (DNS); wired by the simulation driver
         self.resolver: Callable[[str], str] | None = None
+        # ip -> name reverse resolution (gethostbyaddr/getnameinfo)
+        self.rev_resolver: Callable[[str], str | None] | None = None
         # counters (tracker.c analogue)
         self.counters = {
             "events": 0,
@@ -117,6 +119,19 @@ class CpuHost:
         if self.resolver is None:
             raise OSError(f"EAI_NONAME: no resolver for {name!r}")
         return self.resolver(name)
+
+    def rev_resolve(self, ip: str) -> str | None:
+        """IPv4 -> simulated hostname (reverse DNS); the host always knows
+        itself and loopback even without a wired registry."""
+        if self.rev_resolver is not None:
+            name = self.rev_resolver(ip)
+            if name is not None:
+                return name
+        if ip == self.ip:
+            return self.name
+        if ip == "127.0.0.1":
+            return "localhost"
+        return None
 
     def next_iss(self) -> int:
         return self.rng.getrandbits(32)

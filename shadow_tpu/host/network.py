@@ -40,9 +40,11 @@ class CpuNetwork:
             else 1_000_000
         )
         names = names or {h.name: h.ip for h in hosts}
+        rev = {ip: name for name, ip in names.items()}
         for h in hosts:
             h.egress = self._egress
             h.resolver = names.get
+            h.rev_resolver = rev.get
         # parallel host execution (reference thread_per_core.rs:25-210):
         # hosts share nothing inside a window, so N pool threads can run
         # them concurrently. Cross-host deliveries are STAGED per source and

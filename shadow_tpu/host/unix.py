@@ -18,6 +18,17 @@ from shadow_tpu.host.pipe import StreamEnd, _SharedBuf
 UNIX_BUF = 212992  # Linux default unix-socket buffer
 
 
+def _drop_ref(obj):
+    """Refcounted release of an in-flight SCM_RIGHTS object (mirrors the
+    native plane's _drop_vfd: fork-shared descriptors die with their last
+    holder; an unclaimed passed fd is one dropped reference)."""
+    refs = getattr(obj, "_nrefs", 1)
+    if refs > 1:
+        obj._nrefs = refs - 1
+    else:
+        obj.close()
+
+
 class UnixStreamSocket(StreamEnd):
     """One end of a connected unix stream pair (or a listener)."""
 
@@ -29,6 +40,11 @@ class UnixStreamSocket(StreamEnd):
         self.peer_name: str | None = None  # the address connect()ed to
         self._accept_q: list["UnixStreamSocket"] = []
         self._ns: dict | None = None  # abstract namespace (host-owned)
+        # SCM_RIGHTS in transit to THIS end (reference socket/unix.rs
+        # ancillary support): one entry per sendmsg that carried fds.
+        # Divergence from the kernel: entries are not pinned to byte
+        # positions in the stream — a recvmsg claims the oldest entry.
+        self.anc_rx: list[list] = []
 
     @property
     def connected(self) -> bool:
@@ -111,6 +127,10 @@ class UnixStreamSocket(StreamEnd):
         for child in self._accept_q:
             child.close()
         self._accept_q.clear()
+        for ent in self.anc_rx:  # unclaimed passed fds die with the socket
+            for obj in ent:
+                _drop_ref(obj)
+        self.anc_rx.clear()
         super().close()
 
 
@@ -130,7 +150,11 @@ class UnixDgramSocket(File):
         self.bound_name: str | None = None
         self.peer_name: str | None = None
         self._ns: dict | None = None
-        self._rcv: list[tuple[str, bytes]] = []  # (src name or "", data)
+        # (src name or "", data, SCM_RIGHTS objects or None) — rights ride
+        # WITH their datagram (kernel semantics for dgram ancillary)
+        self._rcv: list[tuple[str, bytes, list | None]] = []
+        self._pending_rights: list | None = None  # set by sendmsg
+        self.last_rights: list | None = None  # popped with the last recv
         self._set_state(on=FileState.WRITABLE)
 
     @staticmethod
@@ -154,15 +178,20 @@ class UnixDgramSocket(File):
         self.peer_name = name
         self._ns = ns if self._ns is None else self._ns
 
-    def _deliver(self, src_name: str, data: bytes) -> None:
+    def _deliver(self, src_name: str, data: bytes,
+                 rights: list | None = None) -> None:
         if len(self._rcv) >= UNIX_DGRAM_QUEUE:
+            if rights:
+                for obj in rights:
+                    _drop_ref(obj)
             raise OSError("ENOBUFS: receive queue full")
-        self._rcv.append((src_name, data))
+        self._rcv.append((src_name, data, rights))
         self._set_state(on=FileState.READABLE)
 
     def send_to(self, ns: dict, name: str | None, data: bytes) -> int:
         """sendto: explicit name wins; otherwise the connected peer (by
         name) or the socketpair peer object."""
+        rights, self._pending_rights = self._pending_rights, None
         target = None
         if name is not None:
             target = ns.get(name)
@@ -171,17 +200,31 @@ class UnixDgramSocket(File):
         elif self.peer is not None and not self.peer.closed:
             target = self.peer
         if not isinstance(target, UnixDgramSocket) or target.closed:
+            if rights:
+                for obj in rights:
+                    _drop_ref(obj)
             raise OSError("ECONNREFUSED")
-        target._deliver(self.bound_name or "", bytes(data))
+        target._deliver(self.bound_name or "", bytes(data), rights)
         return len(data)
 
     def recv_from(self, n: int) -> tuple[bytes, str] | None:
         if not self._rcv:
             return None
-        src, data = self._rcv.pop(0)
+        src, data, rights = self._rcv.pop(0)
+        if self.last_rights:  # previous receive's rights went unclaimed
+            for obj in self.last_rights:
+                _drop_ref(obj)
+        self.last_rights = rights
         if not self._rcv:
             self._set_state(off=FileState.READABLE)
         return data[:n], src  # short buffer truncates, like SOCK_DGRAM
+
+    def claim_rights(self) -> list | None:
+        """recvmsg collects the rights attached to the datagram just
+        popped; any other receive path leaves them to be dropped on the
+        next pop (read(2)/recvfrom(2) discard ancillary, like the kernel)."""
+        r, self.last_rights = self.last_rights, None
+        return r
 
     def read(self, n: int) -> bytes | None:
         r = self.recv_from(n)
@@ -198,5 +241,13 @@ class UnixDgramSocket(File):
     def close(self):
         if self.bound_name is not None and self._ns is not None:
             self._ns.pop(self.bound_name, None)
+        for _, _, rights in self._rcv:
+            if rights:
+                for obj in rights:
+                    _drop_ref(obj)
         self._rcv.clear()
+        if self.last_rights:
+            for obj in self.last_rights:
+                _drop_ref(obj)
+            self.last_rights = None
         super().close()

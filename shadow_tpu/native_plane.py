@@ -361,6 +361,29 @@ SYS = {
     "epoll_pwait": 281, "epoll_create1": 291,
     "timerfd_create": 283, "timerfd_settime": 286, "timerfd_gettime": 287,
     "eventfd2": 290, "eventfd": 284,
+    # filesystem mutation + metadata families (r4; reference dispatch arms
+    # handler/mod.rs:371-539, handler/fileat.c, handler/file.c): governed
+    # passthrough like openat/read/write — paths resolve natively in the
+    # child; the simulator sees the request first (inotify hook, vfd guard)
+    "flock": 73, "fsync": 74, "fdatasync": 75, "truncate": 76,
+    "ftruncate": 77, "getdents": 78, "rename": 82, "mkdir": 83, "rmdir": 84,
+    "creat": 85, "link": 86, "unlink": 87, "symlink": 88, "chmod": 90,
+    "fchmod": 91, "chown": 92, "fchown": 93, "lchown": 94, "getrlimit": 97,
+    "times": 100, "statfs": 137, "fstatfs": 138, "mknod": 133,
+    "fadvise64": 221, "mkdirat": 258, "unlinkat": 263, "renameat": 264,
+    "linkat": 265,
+    "symlinkat": 266, "readlinkat": 267, "fchmodat": 268, "faccessat": 269,
+    "fchownat": 260, "mknodat": 259, "utimensat": 280, "fallocate": 285,
+    "renameat2": 316, "memfd_create": 319, "faccessat2": 439,
+    "mremap": 25, "msync": 26, "sendfile": 40, "copy_file_range": 326,
+    "getxattr": 191, "lgetxattr": 192, "fgetxattr": 193, "listxattr": 194,
+    "llistxattr": 195, "flistxattr": 196, "setxattr": 188, "lsetxattr": 189,
+    "fsetxattr": 190, "removexattr": 197,
+    # notification + signal fds (emulated; reference handler/eventfd.c
+    # neighbors, signalfd/inotify arms in handler/mod.rs)
+    "signalfd": 282, "signalfd4": 289,
+    "inotify_init": 253, "inotify_add_watch": 254, "inotify_rm_watch": 255,
+    "inotify_init1": 294,
 }
 _N2NAME = {v: k for k, v in SYS.items()}
 
@@ -375,6 +398,14 @@ _NATIVE_OK = {
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
         "getdents64", "getuid", "getgid", "geteuid",
         "getegid", "pipe2", "umask", "chdir", "fchdir",
+        # r4: read-only / child-local additions for real application
+        # binaries (python3 et al) — none touch shared mutable state the
+        # simulator governs
+        "mremap", "msync", "getdents", "readlinkat", "faccessat",
+        "faccessat2", "getrlimit", "statfs", "fadvise64",
+        "getxattr", "lgetxattr", "listxattr", "llistxattr",
+        # memfd is an anonymous child-local file: determinism-neutral
+        "memfd_create",
     )
 }
 # NOTE: uname is NOT native — its nodename field would leak the real
@@ -385,8 +416,10 @@ _NATIVE_OK = {
 # custom simulator syscalls (native/ipc.h; reference handler/mod.rs:333-337)
 SHADOW_SYS_RESOLVE = 1000001
 SHADOW_SYS_SELF_IP = 1000002
+SHADOW_SYS_RESOLVE_REV = 1000003
 _N2NAME[SHADOW_SYS_RESOLVE] = "shadow_resolve"
 _N2NAME[SHADOW_SYS_SELF_IP] = "shadow_self_ip"
+_N2NAME[SHADOW_SYS_RESOLVE_REV] = "shadow_resolve_rev"
 # NOTE: futex is deliberately NOT native: a thread futex-blocking in the
 # kernel is invisible to the simulator (it never syscalls again), deadlocking
 # the one-runner-at-a-time scheduler — so futex is emulated (reference
@@ -543,6 +576,62 @@ _EPOLL_SYSCALLS = {
     )
 }
 
+# inotify event masks (uapi/linux/inotify.h — ABI constants)
+IN_ACCESS = 0x001
+IN_MODIFY = 0x002
+IN_ATTRIB = 0x004
+IN_MOVED_FROM = 0x040
+IN_MOVED_TO = 0x080
+IN_CREATE = 0x100
+IN_DELETE = 0x200
+IN_DELETE_SELF = 0x400
+IN_MOVE_SELF = 0x800
+IN_IGNORED = 0x8000
+IN_ISDIR = 0x40000000
+
+# path-based filesystem mutations: inotify hook first, then passthrough
+# (reference handler/fileat.c + handler/file.c arms)
+_FS_PATH_SYSCALLS = {
+    SYS[n]
+    for n in (
+        "truncate", "rename", "renameat", "renameat2", "mkdir", "mkdirat",
+        "rmdir", "creat", "link", "linkat", "unlink", "unlinkat", "symlink",
+        "symlinkat", "chmod", "chown", "lchown", "fchmodat", "fchownat",
+        "mknod", "mknodat", "utimensat", "setxattr", "lsetxattr",
+        "removexattr",
+    )
+}
+
+# fd-based filesystem mutations: vfd-guarded passthrough
+_FS_FD_SYSCALLS = {
+    SYS[n]
+    for n in (
+        "ftruncate", "fsync", "fdatasync", "flock", "fchmod", "fchown",
+        "fallocate", "fstatfs", "fgetxattr", "flistxattr", "fsetxattr",
+    )
+}
+
+AT_FDCWD = -100
+AT_REMOVEDIR = 0x200
+O_CREAT = 0x40
+O_NONBLOCK = 0x800
+SOCKFS_MAGIC = 0x534F434B
+
+# inotify event selection per mutation syscall: (mask, extra-for-dirs)
+_FS_EVENT = {
+    SYS["unlink"]: IN_DELETE, SYS["unlinkat"]: IN_DELETE,
+    SYS["rmdir"]: IN_DELETE | IN_ISDIR,
+    SYS["mkdir"]: IN_CREATE | IN_ISDIR, SYS["mkdirat"]: IN_CREATE | IN_ISDIR,
+    SYS["creat"]: IN_CREATE, SYS["link"]: IN_CREATE, SYS["linkat"]: IN_CREATE,
+    SYS["symlink"]: IN_CREATE, SYS["symlinkat"]: IN_CREATE,
+    SYS["mknod"]: IN_CREATE, SYS["mknodat"]: IN_CREATE,
+    SYS["truncate"]: IN_MODIFY,
+    SYS["chmod"]: IN_ATTRIB, SYS["chown"]: IN_ATTRIB, SYS["lchown"]: IN_ATTRIB,
+    SYS["fchmodat"]: IN_ATTRIB, SYS["fchownat"]: IN_ATTRIB,
+    SYS["utimensat"]: IN_ATTRIB, SYS["setxattr"]: IN_ATTRIB,
+    SYS["lsetxattr"]: IN_ATTRIB, SYS["removexattr"]: IN_ATTRIB,
+}
+
 
 class _RandomFile:
     """Deterministic /dev/urandom|/dev/random stand-in (the reference
@@ -569,6 +658,167 @@ class _RandomFile:
 
     def remove_listener(self, lst):
         pass
+
+
+class SignalFd:
+    """signalfd(2) emulation (reference handler signalfd arm + its
+    descriptor type). Signals whose bit is set in `mask` are routed here by
+    `_post_signal` instead of the handler/default path; read() returns
+    packed 128-byte signalfd_siginfo records. Divergence from the kernel
+    (documented): routing ignores the thread sigprocmask — the simulator
+    emulates dispositions but passes rt_sigprocmask through natively, so a
+    signal claimed by any signalfd goes to the fd unconditionally."""
+
+    SIGINFO_BYTES = 128
+
+    def __init__(self, mask: int):
+        from shadow_tpu.host.descriptor import File
+
+        self._file = File()  # composition: state bits + listeners
+        self.mask = mask
+        self._q: list[tuple[int, int]] = []  # (signo, sender pid)
+
+    # File-protocol surface used by the vfd plane / poll / epoll
+    @property
+    def state(self):
+        return self._file.state
+
+    def add_listener(self, lst):
+        self._file.add_listener(lst)
+
+    def remove_listener(self, lst):
+        self._file.remove_listener(lst)
+
+    def push(self, signo: int, sender_pid: int):
+        from shadow_tpu.host.filestate import FileState
+
+        self._q.append((signo, sender_pid))
+        self._file._set_state(on=FileState.READABLE)
+
+    def read(self, n: int) -> bytes | None:
+        from shadow_tpu.host.filestate import FileState
+
+        if n < self.SIGINFO_BYTES:
+            raise OSError(errno.EINVAL, "signalfd read < siginfo size")
+        if not self._q:
+            return None  # would block
+        out = bytearray()
+        while self._q and len(out) + self.SIGINFO_BYTES <= n:
+            signo, spid = self._q.pop(0)
+            rec = bytearray(self.SIGINFO_BYTES)
+            struct.pack_into("<I", rec, 0, signo)  # ssi_signo
+            struct.pack_into("<i", rec, 8, 0)  # ssi_code (SI_USER)
+            struct.pack_into("<I", rec, 12, spid)  # ssi_pid
+            out += rec
+        if not self._q:
+            self._file._set_state(off=FileState.READABLE)
+        return bytes(out)
+
+    def close(self):
+        self._q.clear()
+        self._file.close()
+
+
+class InotifyFd:
+    """inotify(7) emulation over the passthrough filesystem. The simulator
+    cannot see the kernel-side effects of passthrough syscalls, but it DOES
+    see every request first — so mutations observable at the dispatch layer
+    (unlink/rename/mkdir/creat/chmod/truncate/O_CREAT opens and the
+    fd-based ftruncate/fchmod via /proc fd resolution) generate events for
+    watches registered by any process on the same host. write(2) to real
+    fds is not hooked (it is pure passthrough); IN_MODIFY therefore fires
+    on truncate paths, not on plain writes — documented minimal support
+    (reference has full coverage via its virtual fs layer)."""
+
+    def __init__(self, host):
+        from shadow_tpu.host.descriptor import File
+
+        self._file = File()
+        self.host = host
+        self.watches: dict[int, tuple[str, int]] = {}  # wd -> (path, mask)
+        self._next_wd = 1
+        self._q: list[bytes] = []
+        host.__dict__.setdefault("_inotify_fds", []).append(self)
+
+    @property
+    def state(self):
+        return self._file.state
+
+    def add_listener(self, lst):
+        self._file.add_listener(lst)
+
+    def remove_listener(self, lst):
+        self._file.remove_listener(lst)
+
+    def add_watch(self, path: str, mask: int) -> int:
+        path = os.path.normpath(path)
+        for wd, (p, _) in self.watches.items():
+            if p == path:  # kernel: same path updates and reuses the wd
+                self.watches[wd] = (p, mask)
+                return wd
+        wd = self._next_wd
+        self._next_wd += 1
+        self.watches[wd] = (path, mask)
+        return wd
+
+    def rm_watch(self, wd: int) -> int:
+        if wd not in self.watches:
+            return -EINVAL
+        del self.watches[wd]
+        self._push(wd, IN_IGNORED, 0, "")
+        return 0
+
+    def _push(self, wd: int, mask: int, cookie: int, name: str):
+        from shadow_tpu.host.filestate import FileState
+
+        nb = name.encode()
+        if nb:
+            pad = 8 - (len(nb) + 1) % 8 if (len(nb) + 1) % 8 else 0
+            nb = nb + b"\0" * (1 + pad)
+        self._q.append(
+            struct.pack("<iIII", wd, mask, cookie, len(nb)) + nb
+        )
+        self._file._set_state(on=FileState.READABLE)
+
+    def note(self, path: str, mask: int, cookie: int = 0):
+        """A mutation of `path` happened: deliver to matching watches —
+        the parent-directory watch (with the basename) and the exact-path
+        watch (self events for delete/move, plain otherwise)."""
+        path = os.path.normpath(path)
+        parent, name = os.path.split(path)
+        for wd, (wpath, wmask) in list(self.watches.items()):
+            if wpath == parent and (wmask & mask & ~IN_ISDIR):
+                self._push(wd, mask, cookie, name)
+            elif wpath == path:
+                smask = mask
+                if mask & IN_DELETE:
+                    smask = IN_DELETE_SELF
+                elif mask & (IN_MOVED_FROM | IN_MOVE_SELF):
+                    smask = IN_MOVE_SELF
+                if wmask & smask & ~IN_ISDIR:
+                    self._push(wd, smask | (mask & IN_ISDIR), cookie, "")
+
+    def read(self, n: int) -> bytes | None:
+        from shadow_tpu.host.filestate import FileState
+
+        if not self._q:
+            return None  # would block
+        if n < len(self._q[0]):
+            raise OSError(errno.EINVAL, "inotify read buffer too small")
+        out = bytearray()
+        while self._q and len(out) + len(self._q[0]) <= n:
+            out += self._q.pop(0)
+        if not self._q:
+            self._file._set_state(off=FileState.READABLE)
+        return bytes(out)
+
+    def close(self):
+        fds = self.host.__dict__.get("_inotify_fds", [])
+        if self in fds:
+            fds.remove(self)
+        self.watches.clear()
+        self._q.clear()
+        self._file.close()
 
 
 class _Adopted:
@@ -675,6 +925,15 @@ class NativeProcess:
         """Spawn the child (posix_spawn + LD_PRELOAD, managed_thread.rs:548)
         and service it until it blocks or exits."""
         env = dict(os.environ)
+        # the guest must not inherit the SIMULATOR's python/JAX runtime:
+        # PYTHONPATH here pulls the TPU client's sitecustomize into every
+        # managed python3 (wrong machine identity, real TPU connections,
+        # nondeterministic startup). A config that wants these sets them
+        # explicitly via the process `environment`.
+        for k in list(env):
+            if k in ("PYTHONPATH", "PYTHONHOME", "PYTHONSTARTUP") or \
+                    k.startswith(("JAX_", "XLA_", "TPU_")):
+                del env[k]
         env.update(self.env)
         env["LD_PRELOAD"] = shim_path()
         env["SHADOW_SHM_PATH"] = self.ipc.path
@@ -913,12 +1172,22 @@ class NativeProcess:
             return True
         return False
 
-    def _post_signal(self, sig: int, slot: int | None = None):
+    def _post_signal(self, sig: int, slot: int | None = None,
+                     sender: int = 0):
         """Queue a signal for this process (or a specific thread), applying
-        dispositions (handler/ignore/default-terminate). Reference:
-        handler/signal.rs + process.rs signal delivery."""
+        dispositions (handler/ignore/default-terminate). `sender` is the
+        originating pid (0 = kernel-generated), surfaced as ssi_pid.
+        Reference: handler/signal.rs + process.rs signal delivery."""
         if self.state != "running":
             return
+        # signalfd routing first: a signal claimed by any signalfd mask is
+        # queued on the fd instead of running the handler/default path
+        # (divergence from the kernel's procmask gating noted on SignalFd)
+        if sig not in (SIGKILL, SIGSTOP):
+            for f in self._vfds.values():
+                if isinstance(f, SignalFd) and (f.mask >> (sig - 1)) & 1:
+                    f.push(sig, sender)
+                    return
         handler, _flags = self._sigactions.get(sig, (SIG_DFL, 0))
         if sig in (SIGKILL, SIGSTOP) or (
             handler == SIG_DFL and sig not in _SIG_DEFAULT_IGNORE
@@ -1135,7 +1404,7 @@ class NativeProcess:
                 self._kick_runner()
         # SIGCHLD after wait retries: a parked wait4 must win the status,
         # not be EINTR'd by its own child's death notification
-        self._post_signal(SIGCHLD)
+        self._post_signal(SIGCHLD, sender=child.pid)
 
     def _kick_runner(self):
         """Enter the service loop for an already-resumed runner if we are
@@ -1401,6 +1670,13 @@ class NativeProcess:
                 self._vfds[vfd] = _RandomFile(self.host)
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
                 return False
+            # inotify: O_CREAT open of a not-yet-existing path is IN_CREATE
+            # (the simulator shares the child's fs view, so the existence
+            # probe here matches what the native open will see)
+            if args[2] & O_CREAT and self.host.__dict__.get("_inotify_fds"):
+                p = self._child_path(args[0], args[1])
+                if p is not None and not os.path.exists(p):
+                    self._fs_note(p, IN_CREATE)
             self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
         if num in (SYS["readv"], SYS["preadv"], SYS["preadv2"]):
@@ -1516,6 +1792,27 @@ class NativeProcess:
                 return False
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
+        if num == SHADOW_SYS_RESOLVE_REV:
+            # shim gethostbyaddr/getnameinfo: IPv4 -> simulated hostname
+            # (glibc's reverse path would leak real DNS queries into the
+            # simulated network; reference dns.c address registry)
+            import socket as _socket
+
+            ip = _socket.inet_ntoa(
+                struct.pack("<I", args[0] & 0xFFFFFFFF)
+            )
+            name = self.host.rev_resolve(ip)
+            if name is None:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOENT)
+                return False
+            data = name.encode()[: max(args[2] - 1, 0)] + b"\0"
+            try:
+                _vm_write(cpid, args[1], data)
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
         if num in (SYS["getpgid"], SYS["getpgrp"], SYS["getsid"]):
             # single-session model: every process leads its own group
             self.ipc.reply(MSG_SYSCALL_COMPLETE, self.pid)
@@ -1525,6 +1822,39 @@ class NativeProcess:
                 MSG_SYSCALL_COMPLETE,
                 0 if num == SYS["setpgid"] else self.pid,
             )
+            return False
+        if num == SYS["times"]:
+            # SIMULATED clock ticks, not real jiffies (clock(3)/timeout
+            # loops must see the same timeline as clock_gettime); tms cpu
+            # fields zeroed like getrusage
+            CLK_TCK = 100
+            ticks = self.host.now() * CLK_TCK // NS_PER_SEC
+            try:
+                if args[0]:
+                    _vm_write(cpid, args[0], struct.pack("<4q", 0, 0, 0, 0))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, ticks)
+            return False
+        if num in _FS_PATH_SYSCALLS:
+            return self._handle_fs_path(num, args)
+        if num in _FS_FD_SYSCALLS:
+            return self._handle_fs_fd(num, args)
+        if num in (SYS["signalfd"], SYS["signalfd4"]):
+            return self._handle_signalfd(num, args)
+        if num in (SYS["inotify_init"], SYS["inotify_init1"],
+                   SYS["inotify_add_watch"], SYS["inotify_rm_watch"]):
+            return self._handle_inotify(num, args)
+        if num == SYS["sendfile"]:
+            return self._handle_sendfile(args)
+        if num == SYS["copy_file_range"]:
+            # regular-file-only syscall: emulated descriptors are EINVAL
+            # (kernel contract), real files pass through
+            if args[0] in self._vfds or args[2] in self._vfds:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            else:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
         if num in _NATIVE_OK:
             self.ipc.reply(MSG_SYSCALL_NATIVE)
@@ -1779,7 +2109,7 @@ class NativeProcess:
                 return False
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             if sig != 0:
-                target._post_signal(sig, tslot)
+                target._post_signal(sig, tslot, sender=self.pid)
             return False
         if num == SYS["pause"]:
             thr = self._cur
@@ -2072,6 +2402,254 @@ class NativeProcess:
         self.ipc.reply(MSG_SYSCALL_NATIVE)
         return False
 
+    # ---- filesystem mutation / notification family (r4) --------------------
+    # Reference: handler/fileat.c + handler/file.c dispatch arms
+    # (handler/mod.rs:371-539). Policy mirrors the openat/read/write
+    # passthrough: paths resolve natively inside the child; the simulator
+    # vets the request first (vfd guard + inotify fan-out).
+
+    def _child_path(self, dirfd: int, ptr: int) -> str | None:
+        """Resolve a child path argument to an absolute simulator-side path
+        (for inotify matching and existence probes only — the syscall
+        itself still resolves natively in the child)."""
+        try:
+            raw = self._read_cstr(self._child.pid, ptr, 4096)
+        except OSError:
+            return None
+        path = raw.decode("utf-8", "surrogateescape")
+        if path.startswith("/"):
+            return path
+        dirfd &= 0xFFFFFFFF
+        if dirfd >= 1 << 31:
+            dirfd -= 1 << 32
+        try:
+            if dirfd == AT_FDCWD:
+                base = os.readlink(f"/proc/{self._child.pid}/cwd")
+            else:
+                base = os.readlink(f"/proc/{self._child.pid}/fd/{dirfd}")
+        except OSError:
+            return None
+        return os.path.join(base, path)
+
+    def _fs_note(self, path: str | None, mask: int, cookie: int = 0):
+        """Fan a filesystem event out to every inotify instance on this
+        host (watches are host-scoped: the host's processes share one fs
+        view, like the reference's per-host filesystem)."""
+        if path is None or not mask:
+            return
+        for ifd in self.host.__dict__.get("_inotify_fds", []):
+            ifd.note(path, mask, cookie)
+
+    def _handle_fs_path(self, num: int, args: list[int]) -> bool:
+        # the inotify fan-out is gated on live watchers AND on an
+        # existence probe matching what the native syscall will see
+        # (mkdir-EEXIST / unlink-ENOENT must not emit phantom events; the
+        # simulator shares the child's fs view, so the probe agrees with
+        # the syscall's outcome modulo permissions)
+        if self.host.__dict__.get("_inotify_fds"):
+            self._fs_path_events(num, args)
+        self.ipc.reply(MSG_SYSCALL_NATIVE)
+        return False
+
+    def _fs_path_events(self, num: int, args: list[int]):
+        S = SYS
+        exists = os.path.lexists
+        if num in (S["rename"], S["renameat"], S["renameat2"]):
+            if num == S["rename"]:
+                old = self._child_path(AT_FDCWD, args[0])
+                new = self._child_path(AT_FDCWD, args[1])
+            else:
+                old = self._child_path(args[0], args[1])
+                new = self._child_path(args[2], args[3])
+            if not (old and exists(old)):
+                return  # the rename will fail with ENOENT
+            self._fs_cookie = getattr(self, "_fs_cookie", 0) + 1
+            isdir = IN_ISDIR if os.path.isdir(old) else 0
+            self._fs_note(old, IN_MOVED_FROM | isdir, self._fs_cookie)
+            self._fs_note(new, IN_MOVED_TO | isdir, self._fs_cookie)
+            return
+        if num in (S["link"], S["symlink"], S["symlinkat"], S["linkat"],
+                   S["mknod"], S["mknodat"], S["creat"]):
+            if num in (S["link"], S["symlink"]):
+                p = self._child_path(AT_FDCWD, args[1])
+            elif num == S["symlinkat"]:
+                p = self._child_path(args[1], args[2])
+            elif num == S["linkat"]:
+                p = self._child_path(args[2], args[3])
+            elif num == S["mknodat"]:
+                p = self._child_path(args[0], args[1])
+            else:  # mknod, creat
+                p = self._child_path(AT_FDCWD, args[0])
+            if p and not exists(p):  # EEXIST emits nothing
+                self._fs_note(p, IN_CREATE)
+            return
+        if num in (S["mkdir"], S["mkdirat"]):
+            p = (self._child_path(AT_FDCWD, args[0]) if num == S["mkdir"]
+                 else self._child_path(args[0], args[1]))
+            if p and not exists(p):
+                self._fs_note(p, IN_CREATE | IN_ISDIR)
+            return
+        if num in (S["unlink"], S["rmdir"], S["unlinkat"]):
+            if num == S["unlinkat"]:
+                p = self._child_path(args[0], args[1])
+                mask = (IN_DELETE | IN_ISDIR if args[2] & AT_REMOVEDIR
+                        else IN_DELETE)
+            else:
+                p = self._child_path(AT_FDCWD, args[0])
+                mask = (IN_DELETE | IN_ISDIR if num == S["rmdir"]
+                        else IN_DELETE)
+            if p and exists(p):  # ENOENT emits nothing
+                self._fs_note(p, mask)
+            return
+        # attrib/modify family: target must exist for the syscall to work
+        if num in (S["fchmodat"], S["fchownat"], S["utimensat"]):
+            p = self._child_path(args[0], args[1])
+        else:
+            p = self._child_path(AT_FDCWD, args[0])
+        if p and exists(p):
+            self._fs_note(p, _FS_EVENT.get(num, IN_ATTRIB))
+
+    def _handle_fs_fd(self, num: int, args: list[int]) -> bool:
+        fd = args[0]
+        if fd in self._vfds or fd in self._stdio_dups:
+            if num == SYS["fstatfs"]:
+                # minimal sockfs-shaped statfs for emulated descriptors
+                buf = struct.pack("<16q", SOCKFS_MAGIC, 4096, *([0] * 14))
+                try:
+                    _vm_write(self._child.pid, args[1], buf)
+                except OSError:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
+            # ftruncate/fsync/flock/chmod/xattr on an emulated descriptor:
+            # EINVAL (the kernel's answer for non-regular files)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+        # real kernel fd: resolve its path for inotify, then pass through
+        if num in (SYS["ftruncate"], SYS["fallocate"], SYS["fchmod"],
+                   SYS["fchown"], SYS["fsetxattr"]):
+            mask = (IN_MODIFY if num in (SYS["ftruncate"], SYS["fallocate"])
+                    else IN_ATTRIB)
+            try:
+                path = os.readlink(f"/proc/{self._child.pid}/fd/{fd}")
+            except OSError:
+                path = None
+            if path and path.startswith("/"):
+                self._fs_note(path, mask)
+        self.ipc.reply(MSG_SYSCALL_NATIVE)
+        return False
+
+    def _handle_signalfd(self, num: int, args: list[int]) -> bool:
+        fd = args[0] & 0xFFFFFFFF
+        if fd >= 1 << 31:
+            fd -= 1 << 32
+        try:
+            raw = _vm_read(self._child.pid, args[1], 8)
+            mask = struct.unpack("<Q", raw)[0] if len(raw) == 8 else 0
+        except OSError:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+            return False
+        if fd == -1:
+            vfd = self._alloc_vfd()
+            self._vfds[vfd] = SignalFd(mask)
+            if num == SYS["signalfd4"] and args[3] & 0x800:  # SFD_NONBLOCK
+                self._vfd_flags[vfd] = O_NONBLOCK
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
+            return False
+        sfd = self._vfds.get(fd)
+        if not isinstance(sfd, SignalFd):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+        sfd.mask = mask  # update-in-place form
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, fd)
+        return False
+
+    def _handle_inotify(self, num: int, args: list[int]) -> bool:
+        S = SYS
+        if num in (S["inotify_init"], S["inotify_init1"]):
+            vfd = self._alloc_vfd()
+            self._vfds[vfd] = InotifyFd(self.host)
+            if num == S["inotify_init1"] and args[0] & 0x800:  # IN_NONBLOCK
+                self._vfd_flags[vfd] = O_NONBLOCK
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
+            return False
+        ifd = self._vfds.get(args[0])
+        if not isinstance(ifd, InotifyFd):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+        if num == S["inotify_add_watch"]:
+            path = self._child_path(AT_FDCWD, args[1])
+            if path is None:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            if not os.path.exists(path):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOENT)
+                return False
+            self.ipc.reply(
+                MSG_SYSCALL_COMPLETE, ifd.add_watch(path, args[2])
+            )
+            return False
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, ifd.rm_watch(args[1]))
+        return False
+
+    def _handle_sendfile(self, args: list[int]) -> bool:
+        """sendfile(out_fd, in_fd, offset*, count) with out_fd an emulated
+        socket: python's http.server / socket.sendfile fast path. The
+        child's file is read via /proc/<pid>/fd (same inode, simulator-side
+        offset) and pushed through the emulated socket; the offset word is
+        advanced in child memory like the kernel does. NULL offset would
+        require mutating the child's file position from outside —
+        unsupported, EINVAL (callers fall back to a send loop, python
+        does)."""
+        sock = self._vfds.get(args[0])
+        if sock is None:
+            # out_fd not emulated: regular-file-to-file copy, pass through
+            self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if not hasattr(sock, "PROTO") or not args[2]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+        count = min(args[3], 1 << 20)
+        try:
+            raw = _vm_read(self._child.pid, args[2], 8)
+            off = struct.unpack("<q", raw)[0]
+            with open(f"/proc/{self._child.pid}/fd/{args[1]}", "rb") as f:
+                f.seek(off)
+                data = f.read(count)
+        except (OSError, struct.error):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+        if not data:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        try:
+            n = self._do_send(sock, data, None)
+        except (ConnectionResetError, BrokenPipeError):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EPIPE)
+            return False
+        except OSError as e:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
+            return False
+        if n is None:  # would block
+            from shadow_tpu.host.filestate import FileState
+
+            if self._nonblock(args[0]):
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                return False
+            self._block_on(
+                [(sock, FileState.WRITABLE | FileState.ERROR
+                  | FileState.CLOSED)],
+                SYS["sendfile"], args,
+            )
+            return True
+        try:
+            _vm_write(self._child.pid, args[2], struct.pack("<q", off + n))
+        except OSError:
+            pass
+        self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+        return False
+
     def _read_iovs(self, cpid: int, iov_ptr: int, iovcnt: int):
         iovcnt = min(iovcnt, IOV_MAX)
         raw = _vm_read(cpid, iov_ptr, iovcnt * 16)
@@ -2135,7 +2713,67 @@ class NativeProcess:
         name, namelen, iov, iovlen, control, controllen, flags = (
             struct.unpack(self._MSGHDR_FMT, raw)
         )
-        return name, namelen, iov, iovlen
+        return name, namelen, iov, iovlen, control, controllen
+
+    # ---- SCM_RIGHTS (r4; reference socket/unix.rs ancillary handling) ------
+
+    def _parse_scm_rights(self, cpid: int, ctrl: int, ctrl_len: int):
+        """Walk the sender's cmsg region; returns the list of emulated
+        descriptor objects being passed (each with an in-flight reference
+        taken), or a negative errno. Only vfds can cross: a real kernel fd
+        lives in the sender's fd table and cannot be grafted into another
+        process from outside — EBADF, loudly."""
+        try:
+            raw = _vm_read(cpid, ctrl, min(ctrl_len, 1024))
+        except OSError:
+            return -errno.EFAULT
+        objs: list = []
+        off = 0
+        while off + 16 <= len(raw):
+            clen, level, ctype = struct.unpack_from("<qii", raw, off)
+            if clen < 16 or off + clen > len(raw):
+                break
+            if level == 1 and ctype == 0x01:  # SOL_SOCKET, SCM_RIGHTS
+                for i in range((clen - 16) // 4):
+                    fd = struct.unpack_from("<i", raw, off + 16 + 4 * i)[0]
+                    obj = self._vfds.get(fd)
+                    if obj is None:
+                        for o in objs:
+                            self._drop_vfd(o)
+                        return -EBADF
+                    obj._nrefs = getattr(obj, "_nrefs", 1) + 1
+                    objs.append(obj)
+            off += (clen + 7) & ~7
+        return objs
+
+    def _emit_rights(self, cpid: int, mptr: int, ctrl: int, ctrl_len: int,
+                     objs: list):
+        """Install received fds into this process's vfd table and write the
+        SCM_RIGHTS cmsg + msg_controllen back into child memory. Rights
+        that don't fit the control buffer are dropped (kernel: MSG_CTRUNC)."""
+        space = (min(ctrl_len, 1024) - 16) // 4 if ctrl else 0
+        take, spill = objs[: max(space, 0)], objs[max(space, 0):]
+        for obj in spill:
+            self._drop_vfd(obj)
+        new_len = 0
+        if take:
+            fds = []
+            for obj in take:
+                nfd = self._alloc_vfd()
+                self._vfds[nfd] = obj  # the in-flight ref transfers here
+                fds.append(nfd)
+            cms = struct.pack("<qii", 16 + 4 * len(fds), 1, 0x01)
+            cms += struct.pack(f"<{len(fds)}i", *fds)
+            new_len = len(cms)
+            try:
+                _vm_write(cpid, ctrl, cms)
+            except OSError:
+                pass
+        if ctrl:
+            try:  # kernel updates msg_controllen in place (offset 40)
+                _vm_write(cpid, mptr + 40, struct.pack("<Q", new_len))
+            except OSError:
+                pass
 
     def _do_send(self, sock, data: bytes, addr):
         """Returns bytes sent or None = would-block; raises OSError."""
@@ -2146,12 +2784,17 @@ class NativeProcess:
         return sock.write(data)
 
     def _do_recv(self, sock, total: int, peek: bool = False):
-        """Returns (data, addr|None) or None = would-block."""
+        """Returns (data, addr|None) or None = would-block. addr is
+        (ip, port) for inet, ("@unix", src_name) for unix datagrams."""
         from shadow_tpu.host.sockets import UdpSocket
+        from shadow_tpu.host.unix import UnixDgramSocket
 
         if isinstance(sock, UdpSocket):
             r = sock.peekfrom(total) if peek else sock.recvfrom(total)
             return None if r is None else r
+        if isinstance(sock, UnixDgramSocket) and not peek:
+            r = sock.recv_from(total)  # keeps the sender for msg_name
+            return None if r is None else (r[0], ("@unix", r[1]))
         data = sock.peek(total) if peek else sock.read(total)
         return None if data is None else (data, None)
 
@@ -2182,7 +2825,7 @@ class NativeProcess:
                     break
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
                 return False
-            name, namelen, iov_ptr, iovlen = hdr
+            name, namelen, iov_ptr, iovlen, ctrl, ctrl_len = hdr
             try:
                 iovs = self._read_iovs(cpid, iov_ptr, iovlen)
             except OSError:
@@ -2193,20 +2836,54 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
                 return False
             if sending:
+                from shadow_tpu.host.unix import (
+                    UnixDgramSocket,
+                    UnixStreamSocket,
+                )
+
+                unix_dgram = isinstance(sock, UnixDgramSocket)
                 try:
                     data = _vm_read_multi(
                         cpid, [(b, min(ln, 1 << 20)) for b, ln in iovs]
                     )
-                    addr = None
-                    if name and namelen >= 8:
+                    addr = sun = None
+                    if name and unix_dgram:
+                        # msg_name is a sockaddr_un: addressed datagram
+                        # (the canonical fd-passing / sd_notify pattern)
+                        sun = self._read_sun(name, namelen)
+                    elif name and namelen >= 8:
                         addr = _parse_sockaddr_in(_vm_read(cpid, name, 16))
                 except OSError:
                     if done:
                         break
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
                     return False
+                rights = None
+                if ctrl and ctrl_len >= 16:
+                    rights = self._parse_scm_rights(cpid, ctrl, ctrl_len)
+                    if isinstance(rights, int):  # negative errno
+                        if done:
+                            break
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, rights)
+                        return False
+                    if rights and not isinstance(
+                        sock, (UnixStreamSocket, UnixDgramSocket)
+                    ):
+                        # fd passing is a unix-domain feature
+                        for o in rights:
+                            self._drop_vfd(o)
+                        if done:
+                            break
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+                        return False
+                    if rights and unix_dgram:
+                        # rides WITH this datagram through send_to
+                        sock._pending_rights = rights
                 try:
-                    n = self._do_send(sock, bytes(data), addr)
+                    if unix_dgram and sun is not None:
+                        n = sock.send_to(self._unix_ns(), sun, bytes(data))
+                    else:
+                        n = self._do_send(sock, bytes(data), addr)
                 except (ConnectionResetError, BrokenPipeError):
                     if done:
                         break
@@ -2218,6 +2895,10 @@ class NativeProcess:
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, _errno_of(e))
                     return False
                 if n is None:  # would block
+                    if rights and isinstance(sock, UnixStreamSocket):
+                        # undo the in-flight refs: the re-run re-parses
+                        for o in rights:
+                            self._drop_vfd(o)
                     if done:
                         break
                     if self._nonblock(args[0]):
@@ -2225,6 +2906,13 @@ class NativeProcess:
                         return False
                     self._block_on([(sock, wait_w)], num, args)
                     return True
+                if rights and isinstance(sock, UnixStreamSocket):
+                    peer = getattr(sock, "peer", None)
+                    if peer is not None and not peer.closed:
+                        peer.anc_rx.append(rights)
+                    else:
+                        for o in rights:
+                            self._drop_vfd(o)
                 if single:
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
                     return False
@@ -2260,21 +2948,48 @@ class NativeProcess:
                     self._block_on([(sock, wait_r)], num, args)
                     return True
                 data, addr = r
+                from shadow_tpu.host.unix import (
+                    UnixDgramSocket,
+                    UnixStreamSocket,
+                )
+
+                # rights transfer only on a CONSUMING read (kernel: a
+                # MSG_PEEK leaves ancillary attached for the real recvmsg)
+                robjs = None
+                if not peek:
+                    if isinstance(sock, UnixDgramSocket):
+                        robjs = sock.claim_rights()
+                    elif isinstance(sock, UnixStreamSocket) and sock.anc_rx:
+                        robjs = sock.anc_rx.pop(0)
                 # the payload is consumed at this point: out-param faults
                 # degrade to partial writes instead of losing the syscall
                 n = 0
                 try:
                     n = self._scatter(cpid, iovs, data)
-                    # peer name (value-result via the namelen field), no
-                    # control data, no flags
+                    # peer name (value-result via the namelen field) + any
+                    # passed fds (SCM_RIGHTS), flags zeroed
                     if name and addr is not None:
-                        sa = _build_sockaddr_in(addr[0], addr[1])
+                        if addr[0] == "@unix":
+                            src = addr[1]
+                            sa = struct.pack("<H", AF_UNIX)
+                            if src:
+                                sa += ((b"\0" + src[1:].encode())
+                                       if src.startswith("@")
+                                       else src.encode() + b"\0")
+                        else:
+                            sa = _build_sockaddr_in(addr[0], addr[1])
                         _vm_write(cpid, name, sa[: min(namelen, len(sa))])
                         _vm_write(cpid, mptr + 8, struct.pack("<I", len(sa)))
-                    _vm_write(cpid, mptr + 40, struct.pack("<Q", 0))
+                    if robjs:
+                        self._emit_rights(cpid, mptr, ctrl, ctrl_len, robjs)
+                        robjs = None
+                    else:
+                        _vm_write(cpid, mptr + 40, struct.pack("<Q", 0))
                     _vm_write(cpid, mptr + 48, struct.pack("<i", 0))
                 except OSError:
-                    pass
+                    if robjs:
+                        for o in robjs:
+                            self._drop_vfd(o)
                 if single:
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
                     return False
