@@ -80,7 +80,16 @@ typedef struct {
                           * to the simulator so busy-poll loops advance sim
                           * time (reference handler/mod.rs:268-318) */
     ShimChanPair thread[IPC_MAX_THREADS]; /* slot 0 = main thread */
-} IpcBlock; /* 16 + 32*160 = 5136 bytes */
+    /* MemoryMapper window (reference memory_mapper.rs:84-110): the shim
+     * remaps [heap_start, heap_cur) onto a shared tmpfs file
+     * (SHADOW_SHM_PATH + ".heap") that the simulator maps too; both sides
+     * then touch managed heap memory by plain memcpy instead of
+     * process_vm_readv/writev (two kernel crossings per buffer).
+     * heap_start == 0 means no window (fork children privatize and turn
+     * it off; brk growth stays shim-local either way). */
+    uint64_t heap_start;
+    uint64_t heap_cur;
+} IpcBlock; /* 16 + 32*160 + 16 = 5152 bytes */
 
 #define IPC_FLAGS_OFF 12
 
@@ -88,5 +97,8 @@ typedef struct {
 #define IPC_THREADS_OFF 16
 #define IPC_CHANPAIR_SIZE 160
 #define IPC_TO_SHIM_OFF 80 /* within a pair */
+#define IPC_HEAP_START_OFF (IPC_THREADS_OFF + IPC_MAX_THREADS * IPC_CHANPAIR_SIZE)
+#define IPC_HEAP_CUR_OFF (IPC_HEAP_START_OFF + 8)
+#define SHADOW_HEAP_MAX (256l << 20) /* window file size (sparse tmpfs) */
 
 #endif

@@ -249,6 +249,67 @@ enum {
     B_RDI, B_RSI, B_RBP, B_RBX, B_RDX, B_RCX, B_RSP, B_RIP,
 };
 
+/* ------------------------------------------------- MemoryMapper window
+ * Reference memory_mapper.rs:84-110: remap the program-break heap onto a
+ * shared tmpfs file so the simulator reads/writes managed buffers with a
+ * local memcpy instead of process_vm_readv/writev. brk(2) is handled
+ * SHIM-LOCALLY from then on: growth maps further pages of the file
+ * (MAP_SHARED) — or anonymous pages after a fork privatized the heap. */
+static long g_heap_fd = -1;
+static uintptr_t g_heap_start = 0;  /* first heap byte */
+static uintptr_t g_heap_cur = 0;    /* current program break */
+static uintptr_t g_heap_mapped = 0; /* page-aligned end of the mapping */
+static uint32_t g_heap_lock = 0;    /* brk is rare; tiny spinlock */
+
+static void heap_lock(void) {
+    while (__atomic_exchange_n(&g_heap_lock, 1, __ATOMIC_ACQUIRE))
+        ;
+}
+static void heap_unlock(void) {
+    __atomic_store_n(&g_heap_lock, 0, __ATOMIC_RELEASE);
+}
+
+static long forward_syscall(long num, const long args[6]);
+
+static long do_brk(long addr_l) {
+    uintptr_t addr = (uintptr_t)addr_l;
+    if (!g_heap_start) { /* window setup failed: plain passthrough */
+        long a[6] = {addr_l, 0, 0, 0, 0, 0};
+        return forward_syscall(SYS_brk, a);
+    }
+    heap_lock();
+    uintptr_t cur = g_heap_cur;
+    if (addr == 0 || addr < g_heap_start ||
+        addr > g_heap_start + SHADOW_HEAP_MAX) {
+        heap_unlock();
+        return (long)cur; /* query or out-of-range: report current break */
+    }
+    if (addr > g_heap_mapped) {
+        uintptr_t page_end = (addr + 4095) & ~(uintptr_t)4095;
+        long rc;
+        if (g_heap_fd >= 0)
+            rc = g_raw(SYS_mmap, (long)g_heap_mapped,
+                       (long)(page_end - g_heap_mapped),
+                       PROT_READ | PROT_WRITE, MAP_SHARED | MAP_FIXED,
+                       g_heap_fd, (long)(g_heap_mapped - g_heap_start));
+        else
+            rc = g_raw(SYS_mmap, (long)g_heap_mapped,
+                       (long)(page_end - g_heap_mapped),
+                       PROT_READ | PROT_WRITE,
+                       MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
+        if ((unsigned long)rc >= (unsigned long)-4095) {
+            heap_unlock();
+            return (long)cur; /* growth failed: break unchanged */
+        }
+        g_heap_mapped = page_end;
+    }
+    g_heap_cur = addr; /* shrink keeps pages mapped (harmless divergence) */
+    if (g_ipc && g_heap_fd >= 0)
+        __atomic_store_n(&g_ipc->heap_cur, (uint64_t)addr, __ATOMIC_RELEASE);
+    heap_unlock();
+    return (long)addr;
+}
+
 static CloneBoot *g_pending_boot = nullptr; /* one clone in flight at a time
                                              * (the simulator defers the
                                              * parent's clone return until
@@ -417,6 +478,29 @@ static long do_fork(long num, const long args[6]) {
         /* child: fresh block, main slot, check in as a new process */
         g_ipc = nb;
         t_slot = 0;
+        if (g_heap_fd >= 0) {
+            /* PRIVATIZE the heap: a MAP_SHARED heap would couple parent
+             * and child memory, breaking fork's COW contract. Copy out,
+             * remap anonymous, copy back; brk growth continues shim-local
+             * via anonymous pages; the simulator window stays OFF for
+             * this child (nb->heap_start is zero). */
+            size_t hlen = g_heap_mapped - g_heap_start;
+            if (hlen) {
+                long tmp = g_raw(SYS_mmap, 0, (long)hlen,
+                                 PROT_READ | PROT_WRITE,
+                                 MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+                if ((unsigned long)tmp < (unsigned long)-4095) {
+                    memcpy((void *)tmp, (void *)g_heap_start, hlen);
+                    g_raw(SYS_mmap, (long)g_heap_start, (long)hlen,
+                          PROT_READ | PROT_WRITE,
+                          MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED, -1, 0);
+                    memcpy((void *)g_heap_start, (void *)tmp, hlen);
+                    g_raw(SYS_munmap, tmp, (long)hlen, 0, 0, 0, 0);
+                }
+            }
+            g_raw(SYS_close, g_heap_fd, 0, 0, 0, 0, 0);
+            g_heap_fd = -1;
+        }
         ShimMsg m, resp;
         memset(&m, 0, sizeof m);
         m.kind = MSG_START;
@@ -469,6 +553,9 @@ extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
     case SYS_clone3:
         /* glibc falls back to clone(2) on ENOSYS; one trap path to handle */
         ret = -ENOSYS;
+        break;
+    case SYS_brk:
+        ret = do_brk(args[0]);
         break;
     case SYS_clone:
         if ((args[0] & CLONE_VM) && !(args[0] & CLONE_VFORK)) {
@@ -1193,6 +1280,74 @@ static int install_seccomp(void) {
 
 /* ------------------------------------------------------------------ init */
 
+/* Runs pre-seccomp in the constructor (plain syscalls OK). Finds the
+ * [heap] segment, copies its live contents into the shared tmpfs file,
+ * and maps the file over it MAP_FIXED — addresses and bytes unchanged,
+ * but now the simulator can map the same file. tmpfs shared pages ARE
+ * the page cache, so glibc's MADV_DONTNEED on freed chunks stays safe. */
+static void setup_heap_window(void) {
+    int mfd = open("/proc/self/maps", O_RDONLY | O_CLOEXEC);
+    if (mfd < 0)
+        return;
+    static char mbuf[65536];
+    ssize_t n = 0, got;
+    while ((got = read(mfd, mbuf + n, sizeof(mbuf) - 1 - n)) > 0)
+        n += got;
+    close(mfd);
+    if (n < 0)
+        return;
+    mbuf[n] = 0;
+    uintptr_t start = 0, end = 0;
+    char *h = strstr(mbuf, "[heap]");
+    if (h) {
+        while (h > mbuf && h[-1] != '\n')
+            h--;
+        if (sscanf(h, "%lx-%lx", &start, &end) != 2)
+            start = end = 0;
+    }
+    if (!start) { /* no heap segment yet: window begins at current break */
+        start = end = (uintptr_t)syscall(SYS_brk, 0);
+        if (!start || (start & 4095))
+            return;
+    }
+    char hpath[300];
+    size_t bl = strlen(g_shm_base);
+    if (bl + 6 >= sizeof hpath)
+        return;
+    memcpy(hpath, g_shm_base, bl);
+    memcpy(hpath + bl, ".heap", 6);
+    int fd = open(hpath, O_RDWR | O_CREAT | O_CLOEXEC, 0600);
+    if (fd < 0)
+        return;
+    if (ftruncate(fd, SHADOW_HEAP_MAX) != 0) {
+        close(fd);
+        return;
+    }
+    uintptr_t len = (end - start + 4095) & ~(uintptr_t)4095;
+    if (len) {
+        size_t off = 0;
+        while (off < len) {
+            ssize_t w = pwrite(fd, (char *)start + off, len - off, off);
+            if (w <= 0) {
+                close(fd);
+                return;
+            }
+            off += (size_t)w;
+        }
+        if (mmap((void *)start, len, PROT_READ | PROT_WRITE,
+                 MAP_SHARED | MAP_FIXED, fd, 0) == MAP_FAILED) {
+            close(fd);
+            return;
+        }
+    }
+    g_heap_fd = fd;
+    g_heap_start = start;
+    g_heap_cur = end;
+    g_heap_mapped = start + len;
+    g_ipc->heap_start = start;
+    g_ipc->heap_cur = end;
+}
+
 __attribute__((constructor)) static void shadow_shim_init(void) {
     const char *path = getenv("SHADOW_SHM_PATH");
     if (!path)
@@ -1277,6 +1432,8 @@ __attribute__((constructor)) static void shadow_shim_init(void) {
 #endif
     if (patch_vdso() == 0)
         prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
+
+    setup_heap_window(); /* best-effort: failure leaves brk passthrough */
 
     /* StartReq/StartRes handshake (managed_thread.rs:135-243) */
     ShimMsg start, resp;

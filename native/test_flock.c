@@ -1,0 +1,54 @@
+/* flock contention in SIMULATED time: the holder takes LOCK_EX and sleeps;
+ * the waiter's blocking flock must park in sim time (not wedge the
+ * scheduler) and acquire exactly when the holder releases. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <time.h>
+#include <unistd.h>
+
+#define CHECK(c) do { if (!(c)) { \
+    fprintf(stderr, "FAIL %s:%d %s errno=%d\n", __FILE__, __LINE__, #c, \
+            errno); return 1; } \
+} while (0)
+
+static long now_ms(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+int main(int argc, char **argv) {
+    CHECK(argc >= 3);
+    const char *mode = argv[2];
+    int fd = open(argv[1], O_RDWR | O_CREAT, 0600);
+    CHECK(fd >= 0);
+    if (!strcmp(mode, "hold")) {
+        long hold_ms = argc > 3 ? atol(argv[3]) : 300;
+        CHECK(flock(fd, LOCK_EX) == 0);
+        printf("held at %ld\n", now_ms());
+        struct timespec ts = { hold_ms / 1000, (hold_ms % 1000) * 1000000 };
+        nanosleep(&ts, NULL);
+        CHECK(flock(fd, LOCK_UN) == 0);
+        printf("released at %ld\n", now_ms());
+    } else if (!strcmp(mode, "wait")) {
+        /* LOCK_NB must say EWOULDBLOCK while held */
+        if (flock(fd, LOCK_EX | LOCK_NB) == 0) {
+            printf("nb acquired at %ld\n", now_ms());
+            CHECK(flock(fd, LOCK_UN) == 0);
+        } else {
+            CHECK(errno == EWOULDBLOCK);
+            printf("nb busy at %ld\n", now_ms());
+        }
+        long t0 = now_ms();
+        CHECK(flock(fd, LOCK_EX) == 0); /* blocks in sim time */
+        printf("acquired at %ld after %ld\n", now_ms(), now_ms() - t0);
+        CHECK(flock(fd, LOCK_UN) == 0);
+    }
+    close(fd);
+    return 0;
+}

@@ -49,7 +49,9 @@ DOORBELL_OFF = 8
 THREADS_OFF = 16
 CHANPAIR_SIZE = 160
 PAIR_TO_SHIM_OFF = 80
-IPC_SIZE = THREADS_OFF + IPC_MAX_THREADS * CHANPAIR_SIZE
+HEAP_START_OFF = THREADS_OFF + IPC_MAX_THREADS * CHANPAIR_SIZE
+IPC_SIZE = HEAP_START_OFF + 16  # + heap_start/heap_cur (MemoryMapper)
+HEAP_MAX = 256 << 20  # SHADOW_HEAP_MAX in ipc.h
 
 _libc = ctypes.CDLL(None, use_errno=True)
 SYS_futex = 202
@@ -76,9 +78,33 @@ class _Iovec(ctypes.Structure):
     _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
 
 
+# MemoryMapper windows (reference memory_mapper.rs:84-110): child pid ->
+# (ipc mmap, heap mmap). The shim remapped the child's heap onto a shared
+# tmpfs file; accesses fully inside [heap_start, heap_cur) are served by a
+# local memcpy on that mapping — zero kernel crossings — and everything
+# else falls back to process_vm_readv/writev. Bounds are re-read from the
+# IPC block on every access because the shim moves heap_cur on brk.
+_HEAP_WINDOWS: dict[int, tuple[mmap.mmap, mmap.mmap]] = {}
+
+
+def _heap_loc(pid: int, addr: int, n: int):
+    w = _HEAP_WINDOWS.get(pid)
+    if w is None:
+        return None
+    ipc_mm, heap_mm = w
+    start, cur = struct.unpack_from("<QQ", ipc_mm, HEAP_START_OFF)
+    if start and addr >= start and addr + n <= cur:
+        return heap_mm, addr - start
+    return None
+
+
 def _vm_read(pid: int, addr: int, n: int) -> bytes:
     if n <= 0 or addr == 0:
         return b""
+    loc = _heap_loc(pid, addr, n)
+    if loc is not None:
+        mm, off = loc
+        return bytes(mm[off:off + n])
     buf = ctypes.create_string_buffer(n)
     local = _Iovec(ctypes.cast(buf, ctypes.c_void_p), n)
     remote = _Iovec(ctypes.c_void_p(addr), n)
@@ -104,6 +130,11 @@ def _vm_read_multi(pid: int, chunks: list[tuple[int, int]]) -> bytes:
         return b""
     if len(chunks) == 1:
         return _vm_read(pid, chunks[0][0], chunks[0][1])
+    locs = [_heap_loc(pid, a, n) for a, n in chunks]
+    if all(l is not None for l in locs):  # whole gather inside the window
+        return b"".join(
+            bytes(l[0][l[1]:l[1] + n]) for l, (_, n) in zip(locs, chunks)
+        )
     total = sum(n for _, n in chunks)
     buf = ctypes.create_string_buffer(total)
     local = _Iovec(ctypes.cast(buf, ctypes.c_void_p), total)
@@ -129,6 +160,16 @@ def _vm_write_multi(pid: int, chunks: list[tuple[int, int]], data: bytes) -> int
         return 0
     if len(chunks) == 1:
         return _vm_write(pid, chunks[0][0], data[: chunks[0][1]])
+    locs = [_heap_loc(pid, a, n) for a, n in chunks]
+    if all(l is not None for l in locs):  # whole scatter inside the window
+        pos = 0
+        for l, (_, nn) in zip(locs, chunks):
+            take = min(nn, total - pos)
+            if take <= 0:
+                break
+            l[0][l[1]:l[1] + take] = bytes(data[pos:pos + take])
+            pos += take
+        return pos
     buf = ctypes.create_string_buffer(bytes(data[:total]), total)
     local = _Iovec(ctypes.cast(buf, ctypes.c_void_p), total)
     remote_list = []
@@ -151,6 +192,11 @@ def _vm_write_multi(pid: int, chunks: list[tuple[int, int]], data: bytes) -> int
 def _vm_write(pid: int, addr: int, data: bytes) -> int:
     if not data or addr == 0:
         return 0
+    loc = _heap_loc(pid, addr, len(data))
+    if loc is not None:
+        mm, off = loc
+        mm[off:off + len(data)] = bytes(data)
+        return len(data)
     buf = ctypes.create_string_buffer(bytes(data), len(data))
     local = _Iovec(ctypes.cast(buf, ctypes.c_void_p), len(data))
     remote = _Iovec(ctypes.c_void_p(addr), len(data))
@@ -263,6 +309,10 @@ class IpcBlock:
             pass
         try:
             os.unlink(self.path)
+        except OSError:
+            pass
+        try:  # the shim's MemoryMapper heap file rides on the same name
+            os.unlink(self.path + ".heap")
         except OSError:
             pass
 
@@ -602,14 +652,19 @@ _FS_PATH_SYSCALLS = {
     )
 }
 
-# fd-based filesystem mutations: vfd-guarded passthrough
+# fd-based filesystem mutations: vfd-guarded passthrough (flock is NOT
+# here: a native flock could block the child invisibly in the kernel and
+# deadlock the one-runner-at-a-time scheduler — same reason futex is
+# emulated — so it gets a simulator-side lock table)
 _FS_FD_SYSCALLS = {
     SYS[n]
     for n in (
-        "ftruncate", "fsync", "fdatasync", "flock", "fchmod", "fchown",
+        "ftruncate", "fsync", "fdatasync", "fchmod", "fchown",
         "fallocate", "fstatfs", "fgetxattr", "flistxattr", "fsetxattr",
     )
 }
+
+LOCK_SH, LOCK_EX, LOCK_NB, LOCK_UN = 1, 2, 4, 8
 
 AT_FDCWD = -100
 AT_REMOVEDIR = 0x200
@@ -955,8 +1010,37 @@ class NativeProcess:
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return
+        self._register_heap()  # MemoryMapper window (set up pre-handshake)
         self.ipc.reply_slot(0, MSG_START_OK)
         self._service_loop()
+
+    def _register_heap(self):
+        """Map the shim's shared heap file so _vm_* serve heap accesses by
+        local memcpy (MemoryMapper window; no-op if the shim didn't set
+        one up — fork children, setup failure)."""
+        try:
+            fd = os.open(self.ipc.path + ".heap", os.O_RDWR)
+        except OSError:
+            return
+        try:
+            mm = mmap.mmap(fd, HEAP_MAX)
+        except (OSError, ValueError):
+            os.close(fd)
+            return
+        os.close(fd)
+        self._heap_mm = mm
+        _HEAP_WINDOWS[self._child.pid] = (self.ipc._mm, mm)
+
+    def _unregister_heap(self):
+        mm = getattr(self, "_heap_mm", None)
+        if mm is None:
+            return
+        _HEAP_WINDOWS.pop(self._child.pid, None)
+        self._heap_mm = None
+        try:
+            mm.close()
+        except (BufferError, ValueError):
+            pass
 
     @staticmethod
     def _drop_vfd(sock):
@@ -971,6 +1055,8 @@ class NativeProcess:
     def _die(self, code: int):
         self.state = "zombie"
         self.exit_code = code
+        self._unregister_heap()
+        self._flock_release()
         self._clear_wake()
         for sock in self._vfds.values():  # peers see HUP/RST, not silence
             self._drop_vfd(sock)
@@ -1599,6 +1685,7 @@ class NativeProcess:
                 self._drop_vfd(sock)
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             else:
+                self._flock_release(args[0])  # close drops flock locks
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
         if num == SYS["dup"]:
@@ -1841,6 +1928,8 @@ class NativeProcess:
             return self._handle_fs_path(num, args)
         if num in _FS_FD_SYSCALLS:
             return self._handle_fs_fd(num, args)
+        if num == SYS["flock"]:
+            return self._handle_flock(args)
         if num in (SYS["signalfd"], SYS["signalfd4"]):
             return self._handle_signalfd(num, args)
         if num in (SYS["inotify_init"], SYS["inotify_init1"],
@@ -2190,6 +2279,8 @@ class NativeProcess:
         if num in (SYS["exit_group"], SYS["exit"]):
             self.state = "zombie"
             self.exit_code = args[0] & 0xFF
+            self._unregister_heap()
+            self._flock_release()
             self._clear_wake()
             for sock in self._vfds.values():
                 self._drop_vfd(sock)
@@ -2463,10 +2554,14 @@ class NativeProcess:
                 new = self._child_path(args[2], args[3])
             if not (old and exists(old)):
                 return  # the rename will fail with ENOENT
-            self._fs_cookie = getattr(self, "_fs_cookie", 0) + 1
+            # cookies pair MOVED_FROM/TO across the HOST (watches are
+            # host-scoped, so two processes renaming concurrently must not
+            # collide on a per-process counter)
+            cookie = self.host.__dict__.get("_fs_cookie", 0) + 1
+            self.host.__dict__["_fs_cookie"] = cookie
             isdir = IN_ISDIR if os.path.isdir(old) else 0
-            self._fs_note(old, IN_MOVED_FROM | isdir, self._fs_cookie)
-            self._fs_note(new, IN_MOVED_TO | isdir, self._fs_cookie)
+            self._fs_note(old, IN_MOVED_FROM | isdir, cookie)
+            self._fs_note(new, IN_MOVED_TO | isdir, cookie)
             return
         if num in (S["link"], S["symlink"], S["symlinkat"], S["linkat"],
                    S["mknod"], S["mknodat"], S["creat"]):
@@ -2539,6 +2634,97 @@ class NativeProcess:
                 self._fs_note(path, mask)
         self.ipc.reply(MSG_SYSCALL_NATIVE)
         return False
+
+    def _handle_flock(self, args: list[int]) -> bool:
+        """flock(2) emulated against a HOST-scoped lock table keyed by
+        (st_dev, st_ino) — a native flock could block the child invisibly
+        in the kernel, deadlocking the one-runner-at-a-time scheduler
+        (exactly the futex rationale; reference emulates file locks in its
+        handler layer too). Blocked lockers park in SIM time and re-run on
+        release. Divergence: lock ownership is tracked per (pid, fd), not
+        per open-file-description, so dup'd fds count as separate owners."""
+        fd, op = args[0], args[1]
+        if fd in self._vfds or fd in self._stdio_dups:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+        try:
+            st = os.stat(f"/proc/{self._child.pid}/fd/{fd}")
+        except OSError:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)
+            return False
+        table = self.host.__dict__.setdefault("_flocks", {})
+        key = (st.st_dev, st.st_ino)
+        ent = table.setdefault(key, {"ex": None, "sh": set(), "waiters": []})
+        me = (self.pid, fd)
+        base = op & ~LOCK_NB
+        if base == LOCK_UN:
+            released = ent["ex"] == me or me in ent["sh"]
+            if ent["ex"] == me:
+                ent["ex"] = None
+            ent["sh"].discard(me)
+            if released:
+                self._flock_schedule_wake(key)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if base not in (LOCK_SH, LOCK_EX):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+        others_ex = ent["ex"] is not None and ent["ex"] != me
+        others_sh = bool(ent["sh"] - {me})
+        if base == LOCK_SH and not others_ex:
+            downgraded = ent["ex"] == me
+            if downgraded:
+                ent["ex"] = None
+            ent["sh"].add(me)
+            if downgraded:
+                self._flock_schedule_wake(key)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if base == LOCK_EX and not others_ex and not others_sh:
+            ent["sh"].discard(me)
+            ent["ex"] = me
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if op & LOCK_NB:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EWOULDBLOCK)
+            return False
+        thr = self._cur
+        thr.state = "blocked"
+        thr.blocked_num = SYS["flock"]
+        thr.blocked_args = list(args)
+        ent["waiters"].append((self, thr))
+        return True  # parked until a release re-runs us
+
+    def _flock_schedule_wake(self, key):
+        """Defer waiter retries to the host event loop (the releaser's
+        service loop is live; re-entering another process's loop from here
+        would nest schedulers)."""
+        host = self.host
+        host.schedule(host.now(), lambda: _flock_wake(host, key))
+
+    def _flock_release(self, fd: int | None = None):
+        """Release locks on close (kernel contract) or on process death;
+        fd=None drops everything this pid holds or waits for."""
+        table = self.host.__dict__.get("_flocks")
+        if not table:
+            return
+        for key, ent in list(table.items()):
+            def mine(m):
+                return m[0] == self.pid and (fd is None or m[1] == fd)
+
+            changed = False
+            if ent["ex"] is not None and mine(ent["ex"]):
+                ent["ex"] = None
+                changed = True
+            n0 = len(ent["sh"])
+            ent["sh"] = {m for m in ent["sh"] if not mine(m)}
+            changed |= len(ent["sh"]) != n0
+            if fd is None:
+                ent["waiters"] = [
+                    (p, t) for p, t in ent["waiters"] if p is not self
+                ]
+            if changed:
+                self._flock_schedule_wake(key)
 
     def _handle_signalfd(self, num: int, args: list[int]) -> bool:
         fd = args[0] & 0xFFFFFFFF
@@ -2747,10 +2933,11 @@ class NativeProcess:
         return objs
 
     def _emit_rights(self, cpid: int, mptr: int, ctrl: int, ctrl_len: int,
-                     objs: list):
+                     objs: list) -> bool:
         """Install received fds into this process's vfd table and write the
         SCM_RIGHTS cmsg + msg_controllen back into child memory. Rights
-        that don't fit the control buffer are dropped (kernel: MSG_CTRUNC)."""
+        that don't fit the control buffer are dropped; returns True when
+        that happened so the caller can set MSG_CTRUNC in msg_flags."""
         space = (min(ctrl_len, 1024) - 16) // 4 if ctrl else 0
         take, spill = objs[: max(space, 0)], objs[max(space, 0):]
         for obj in spill:
@@ -2774,6 +2961,7 @@ class NativeProcess:
                 _vm_write(cpid, mptr + 40, struct.pack("<Q", new_len))
             except OSError:
                 pass
+        return bool(spill)
 
     def _do_send(self, sock, data: bytes, addr):
         """Returns bytes sent or None = would-block; raises OSError."""
@@ -2980,12 +3168,15 @@ class NativeProcess:
                             sa = _build_sockaddr_in(addr[0], addr[1])
                         _vm_write(cpid, name, sa[: min(namelen, len(sa))])
                         _vm_write(cpid, mptr + 8, struct.pack("<I", len(sa)))
+                    msg_flags = 0
                     if robjs:
-                        self._emit_rights(cpid, mptr, ctrl, ctrl_len, robjs)
+                        if self._emit_rights(cpid, mptr, ctrl, ctrl_len,
+                                             robjs):
+                            msg_flags |= 0x8  # MSG_CTRUNC: fds were lost
                         robjs = None
                     else:
                         _vm_write(cpid, mptr + 40, struct.pack("<Q", 0))
-                    _vm_write(cpid, mptr + 48, struct.pack("<i", 0))
+                    _vm_write(cpid, mptr + 48, struct.pack("<i", msg_flags))
                 except OSError:
                     if robjs:
                         for o in robjs:
@@ -3278,6 +3469,7 @@ class NativeProcess:
             return False
         # point of no return: tear down the old native process (threads die
         # with it, per exec) and swap the new image in
+        self._unregister_heap()
         self._clear_wake()
         self.ipc.close()
         old = self._child
@@ -3300,6 +3492,7 @@ class NativeProcess:
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return True
+        self._register_heap()  # the new image set up its own window
         self.ipc.reply_slot(0, MSG_START_OK)
         return False  # service loop continues with the new image
 
@@ -4103,6 +4296,30 @@ class NativeProcess:
         return _vm_read_multi(
             cpid, [(b, min(ln, 1 << 20)) for b, ln in chunks]
         )
+
+
+def _flock_wake(host, key):
+    """Retry every waiter parked on `key` (host event context: no service
+    loop is live, so re-entering a waiter's loop is safe — same pattern as
+    the wait4 retry in _child_exited)."""
+    table = host.__dict__.get("_flocks", {})
+    ent = table.get(key)
+    if ent is None:
+        return
+    waiters, ent["waiters"] = ent["waiters"], []
+    for proc, thr in waiters:
+        if proc.state != "running" or thr.state != "blocked":
+            continue
+        proc.ipc.set_time(host.now())
+        proc.ipc.cur_slot = thr.slot
+        proc._cur = thr
+        thr.state = "running"
+        parked = proc._handle_flock(thr.blocked_args)
+        if not parked and thr.state == "running":
+            proc._runner = thr
+            proc._kick_runner()
+    if ent["ex"] is None and not ent["sh"] and not ent["waiters"]:
+        table.pop(key, None)
 
 
 def spawn_native(host, argv: list[str], name: str | None = None,

@@ -279,3 +279,33 @@ def test_vm_multi_null_iovec_is_efault():
     assert e.value.errno == errno.EFAULT
     # zero-length NULL iovec stays legal (kernel ignores it)
     assert _vm_read_multi(pid, [(addr, 5), (0, 0)]) == b"hello"
+
+
+def test_memory_mapper_window():
+    """r4 MemoryMapper (reference memory_mapper.rs): the shim remaps the
+    child's heap onto a shared tmpfs file; the simulator serves heap reads
+    from its own mapping. The window must register and byte-match the
+    process_vm path over the same range."""
+    import struct
+
+    from shadow_tpu import native_plane as nplane
+
+    h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=4, host_id=0))
+    p = spawn_native(h, [TEST_APP, "1000"])
+    h.execute(1)  # boot; child parks in its first nanosleep
+    cpid = p._child.pid
+    w = nplane._HEAP_WINDOWS.get(cpid)
+    assert w is not None, "heap window did not register"
+    start, cur = struct.unpack_from("<QQ", w[0], nplane.HEAP_START_OFF)
+    assert cur > start > 0
+    n = min(cur - start, 32768)
+    assert nplane._heap_loc(cpid, start, n) is not None
+    via_window = nplane._vm_read(cpid, start, n)
+    saved = nplane._HEAP_WINDOWS.pop(cpid)  # force the kernel path
+    try:
+        via_kernel = nplane._vm_read(cpid, start, n)
+    finally:
+        nplane._HEAP_WINDOWS[cpid] = saved
+    assert via_window == via_kernel
+    assert len(via_window) == n
+    p.kill()

@@ -181,3 +181,23 @@ def test_scm_rights_and_signalfd():
     assert "signalfd ok" in out  # incl. ssi_pid sender attribution
     # addressed dgram sendmsg + peek-does-not-consume + msg_name writeback
     assert "dgram rights ok" in out
+
+
+def test_flock_contention_in_sim_time(tmp_path):
+    """flock is emulated against a host-scoped lock table (a native flock
+    would block the child invisibly in the kernel and wedge the scheduler,
+    the futex rationale): LOCK_NB sees EWOULDBLOCK while held; a blocking
+    LOCK_EX parks in SIM time and acquires exactly at release."""
+    lock = str(tmp_path / "lockfile")
+    binpath = os.path.join(REPO, "native", "build", "test_flock")
+    h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=4, host_id=0))
+    from shadow_tpu.native_plane import spawn_native as _sp
+
+    holder = _sp(h, [binpath, lock, "hold", "300"])
+    waiter = _sp(h, [binpath, lock, "wait"], start_time=50 * MS)
+    h.execute(5 * SEC)
+    assert holder.exit_code == 0, b"".join(holder.stderr)
+    assert waiter.exit_code == 0, b"".join(waiter.stderr)
+    wout = b"".join(waiter.stdout).decode()
+    assert "nb busy at 50" in wout
+    assert "acquired at 300" in wout  # exactly the holder's release time

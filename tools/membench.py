@@ -8,10 +8,12 @@ Measures the syscall-crossing cost of reading a managed process's memory:
   batched   : ONE process_vm_readv call carrying all remote iovecs (what
               native_plane._gather_write / _handle_msg do now)
 
-The reference's MemoryMapper (memory_mapper.rs:84-110) removes the syscall
-entirely via shared-memory remapping; batching is the measured middle
-ground this plane ships. Run: python tools/membench.py [iovs] [size] [reps]
-Prints one JSON line with both rates and the speedup.
+  mapped    : the MemoryMapper window (r4) — the shim remapped the managed
+              heap onto a shared tmpfs file; reads are a local memcpy with
+              ZERO kernel crossings (reference memory_mapper.rs:84-110).
+
+Run: python tools/membench.py [iovs] [size] [reps]
+Prints one JSON line with all three rates and the speedups.
 """
 
 from __future__ import annotations
@@ -61,6 +63,8 @@ def main() -> int:
             _vm_read_multi(child.pid, chunks)
         batched_s = time.perf_counter() - t0
 
+        mapped_s = measure_mapped(iovs, size, reps)
+
         total_mb = reps * iovs * size / 1e6
         print(
             json.dumps(
@@ -73,8 +77,19 @@ def main() -> int:
                         per_iovec_s / reps * 1e6, 2
                     ),
                     "batched_us_per_gather": round(batched_s / reps * 1e6, 2),
-                    "speedup": round(per_iovec_s / max(batched_s, 1e-12), 2),
+                    "mapped_us_per_gather": (
+                        round(mapped_s / reps * 1e6, 2) if mapped_s else None
+                    ),
+                    "speedup_batched": round(
+                        per_iovec_s / max(batched_s, 1e-12), 2
+                    ),
+                    "speedup_mapped_vs_batched": (
+                        round(batched_s / mapped_s, 2) if mapped_s else None
+                    ),
                     "batched_MBps": round(total_mb / batched_s, 1),
+                    "mapped_MBps": (
+                        round(total_mb / mapped_s, 1) if mapped_s else None
+                    ),
                 }
             )
         )
@@ -82,6 +97,49 @@ def main() -> int:
         child.kill()
         child.wait()
     return 0
+
+
+def measure_mapped(iovs: int, size: int, reps: int) -> float | None:
+    """Time the same gather against a shim-managed child whose heap rides
+    the MemoryMapper window. The child mallocs a big heap buffer and
+    sleeps; the window serves every read with no kernel crossing."""
+    from shadow_tpu.host import CpuHost, HostConfig
+    from shadow_tpu.native_plane import (
+        _HEAP_WINDOWS,
+        HEAP_START_OFF,
+        _heap_loc,
+        ensure_built,
+        spawn_native,
+    )
+
+    if not ensure_built():
+        return None
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = CpuHost(HostConfig(name="m1", ip="10.0.0.1", seed=1, host_id=0))
+    # test_app parks in nanosleep; its glibc heap is window-backed
+    p = spawn_native(h, [os.path.join(repo, "native", "build", "test_app"),
+                         "1000"])
+    h.execute(1)  # boot the process (it parks in nanosleep)
+    cpid = p._child.pid
+    w = _HEAP_WINDOWS.get(cpid)
+    if w is None:
+        p.kill()
+        return None
+    import struct as _struct
+
+    start, cur = _struct.unpack_from("<QQ", w[0], HEAP_START_OFF)
+    need = iovs * size
+    if cur - start < need:  # window too small for the gather: grow check
+        p.kill()
+        return None
+    chunks = [(start + i * size, size) for i in range(iovs)]
+    assert all(_heap_loc(cpid, a, n) is not None for a, n in chunks)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _vm_read_multi(cpid, chunks)
+    dt = time.perf_counter() - t0
+    p.kill()
+    return dt
 
 
 if __name__ == "__main__":
