@@ -1,0 +1,17 @@
+# 4-node backbone ring (tor-minimal-scale example): cross-node traffic
+# takes 10-20 ms edges; shortest-path routing composes multi-hop paths.
+graph [
+  directed 0
+  node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 2 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 3 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  edge [ source 0 target 0 latency "1 ms" ]
+  edge [ source 1 target 1 latency "1 ms" ]
+  edge [ source 2 target 2 latency "1 ms" ]
+  edge [ source 3 target 3 latency "1 ms" ]
+  edge [ source 0 target 1 latency "10 ms" ]
+  edge [ source 1 target 2 latency "15 ms" ]
+  edge [ source 2 target 3 latency "10 ms" ]
+  edge [ source 3 target 0 latency "20 ms" ]
+]

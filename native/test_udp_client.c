@@ -2,6 +2,7 @@
  * network and verifies the echoed replies + the simulated RTT. */
 #define _GNU_SOURCE
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -20,11 +21,23 @@ int main(int argc, char **argv) {
     const char *ip = argc > 1 ? argv[1] : "127.0.0.1";
     int port = argc > 2 ? atoi(argv[2]) : 9000;
     int count = argc > 3 ? atoi(argv[3]) : 3;
+    long interval_ms = argc > 4 ? atol(argv[4]) : 100;
     int fd = socket(AF_INET, SOCK_DGRAM, 0);
     struct sockaddr_in dst = {0};
     dst.sin_family = AF_INET;
     dst.sin_port = htons(port);
-    if (inet_pton(AF_INET, ip, &dst.sin_addr) != 1) { perror("inet_pton"); return 1; }
+    if (inet_pton(AF_INET, ip, &dst.sin_addr) != 1) {
+        /* not a dotted quad: resolve through the simulator's DNS */
+        struct addrinfo hints = {0}, *res;
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_DGRAM;
+        if (getaddrinfo(ip, NULL, &hints, &res) != 0 || !res) {
+            perror("getaddrinfo");
+            return 1;
+        }
+        dst.sin_addr = ((struct sockaddr_in *)res->ai_addr)->sin_addr;
+        freeaddrinfo(res);
+    }
     if (connect(fd, (struct sockaddr *)&dst, sizeof dst)) { perror("connect"); return 1; }
     char buf[512];
     for (int i = 0; i < count; i++) {
@@ -38,7 +51,8 @@ int main(int argc, char **argv) {
         buf[got] = 0;
         printf("reply %d: %s rtt_ns=%ld\n", i, buf, rtt);
         fflush(stdout);
-        struct timespec d = {0, 100 * 1000 * 1000};
+        struct timespec d = {interval_ms / 1000,
+                             (interval_ms % 1000) * 1000000};
         nanosleep(&d, NULL);
     }
     printf("client done\n");
