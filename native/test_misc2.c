@@ -1,0 +1,95 @@
+/* The r4 last-stretch dispatch arms: legacy open/stat/pipe, utimes,
+ * pwrite, credential setters (emulated no-ops — a NATIVE setuid would
+ * strip the simulator's process_vm access), capget/capset,
+ * sched_setaffinity, close_range, and waitid. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/capability.h>
+#include <sched.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <utime.h>
+
+#define CHECK(c) do { if (!(c)) { \
+    fprintf(stderr, "FAIL %s:%d %s errno=%d\n", __FILE__, __LINE__, #c, \
+            errno); return 1; } \
+} while (0)
+
+int main(int argc, char **argv) {
+    CHECK(argc == 2);
+    char path[600];
+    snprintf(path, sizeof path, "%s/legacy.txt", argv[1]);
+
+    /* legacy open(2) + pwrite + fstat via stat(2)/lstat(2) */
+    int fd = syscall(SYS_open, path, O_CREAT | O_RDWR, 0644);
+    CHECK(fd >= 0);
+    CHECK(pwrite(fd, "abcdef", 6, 0) == 6);
+    CHECK(pwrite(fd, "XY", 2, 2) == 2);
+    char buf[8] = {0};
+    CHECK(pread(fd, buf, 6, 0) == 6 && !memcmp(buf, "abXYef", 6));
+    CHECK(close(fd) == 0);
+    struct stat st;
+    CHECK(syscall(SYS_stat, path, &st) == 0 && st.st_size == 6);
+    CHECK(syscall(SYS_lstat, path, &st) == 0);
+
+    /* utimes: set a deterministic mtime */
+    struct timeval tv[2] = {{1000, 0}, {2000, 0}};
+    CHECK(utimes(path, tv) == 0);
+    CHECK(stat(path, &st) == 0 && st.st_mtime == 2000);
+    CHECK(unlink(path) == 0);
+
+    /* pipe(2) (legacy) */
+    int pfd[2];
+    CHECK(syscall(SYS_pipe, pfd) == 0);
+    CHECK(write(pfd[1], "pp", 2) == 2);
+    CHECK(read(pfd[0], buf, 2) == 2 && !memcmp(buf, "pp", 2));
+    close(pfd[0]);
+    close(pfd[1]);
+
+    /* credential setters: emulated success, identity unchanged */
+    CHECK(syscall(SYS_setuid, 12345) == 0);
+    CHECK(getuid() == geteuid());  /* still whoever we started as */
+    CHECK(syscall(SYS_setresgid, 1, 2, 3) == 0);
+
+    /* capget reports empty caps; capset accepted */
+    struct __user_cap_header_struct hdr = {_LINUX_CAPABILITY_VERSION_3, 0};
+    struct __user_cap_data_struct data[2];
+    memset(data, 0xff, sizeof data);
+    CHECK(syscall(SYS_capget, &hdr, data) == 0);
+    CHECK(data[0].effective == 0 && data[0].permitted == 0);
+    CHECK(syscall(SYS_capset, &hdr, data) == 0);
+
+    /* sched_setaffinity accepted on the one-cpu simulated host */
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    CPU_SET(0, &set);
+    CHECK(sched_setaffinity(0, sizeof set, &set) == 0);
+
+    /* waitid: fork a child, reap via the siginfo-shaped wait */
+    pid_t pid = fork();
+    CHECK(pid >= 0);
+    if (pid == 0)
+        _exit(7);
+    siginfo_t si;
+    memset(&si, 0, sizeof si);
+    CHECK(waitid(P_PID, pid, &si, WEXITED) == 0);
+    CHECK(si.si_pid == pid);
+    CHECK(si.si_code == CLD_EXITED && si.si_status == 7);
+
+    /* close_range over a span holding an emulated socket vfd */
+    int s1 = socket(2 /*AF_INET*/, 2 /*SOCK_DGRAM*/, 0);
+    CHECK(s1 >= 0);
+    CHECK(syscall(SYS_close_range, (unsigned)s1, (unsigned)s1 + 10, 0) == 0);
+    CHECK(write(s1, "x", 1) == -1);  /* really closed */
+
+    printf("misc2 ok\n");
+    return 0;
+}

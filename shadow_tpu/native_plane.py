@@ -434,6 +434,18 @@ SYS = {
     "signalfd": 282, "signalfd4": 289,
     "inotify_init": 253, "inotify_add_watch": 254, "inotify_rm_watch": 255,
     "inotify_init1": 294,
+    # the last stretch of the reference's 193-arm dispatch surface (r4):
+    # legacy path syscalls, credential setters, caps, waitid, execveat
+    "open": 2, "stat": 4, "lstat": 6, "pipe": 22, "pwrite64": 18,
+    "utime": 132, "utimes": 235, "futimesat": 261, "readahead": 187,
+    "sync_file_range": 277, "syncfs": 306, "close_range": 436,
+    "epoll_pwait2": 441, "execveat": 322, "fchmodat2": 452,
+    "fremovexattr": 199, "lremovexattr": 198, "get_robust_list": 274,
+    "sched_setaffinity": 203, "getgroups": 115, "setgroups": 116,
+    "getresuid": 118, "getresgid": 120, "setuid": 105, "setgid": 106,
+    "setreuid": 113, "setregid": 114, "setresuid": 117, "setresgid": 119,
+    "setfsuid": 122, "setfsgid": 123, "capget": 125, "capset": 126,
+    "prctl": 157, "setrlimit": 160, "waitid": 247,
 }
 _N2NAME = {v: k for k, v in SYS.items()}
 
@@ -446,8 +458,11 @@ _NATIVE_OK = {
         "sigaltstack", "arch_prctl", "set_tid_address", "set_robust_list",
         "rseq", "prlimit64", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
-        "getdents64", "getuid", "getgid", "geteuid",
-        "getegid", "pipe2", "umask", "chdir", "fchdir",
+        "getdents64", "pipe2", "umask", "chdir", "fchdir",
+        # NOTE: the uid/gid GETTERS are NOT native — they report the
+        # per-process EMULATED identity (set by the emulated setters; the
+        # real host uid would leak machine state into simulated output,
+        # the uname-nodename argument)
         # r4: read-only / child-local additions for real application
         # binaries (python3 et al) — none touch shared mutable state the
         # simulator governs
@@ -456,6 +471,11 @@ _NATIVE_OK = {
         "getxattr", "lgetxattr", "listxattr", "llistxattr",
         # memfd is an anonymous child-local file: determinism-neutral
         "memfd_create",
+        # r4 last-stretch additions: legacy/reads and child-local limits.
+        # prctl is process-local (PR_SET_NAME etc.); the shim's SIGSYS
+        # disposition is guarded separately, and seccomp-on-seccomp only
+        # tightens. pipe is a real kernel pipe like pipe2.
+        "stat", "lstat", "pipe", "get_robust_list", "prctl", "setrlimit",
     )
 }
 # NOTE: uname is NOT native — its nodename field would leak the real
@@ -648,7 +668,8 @@ _FS_PATH_SYSCALLS = {
         "rmdir", "creat", "link", "linkat", "unlink", "unlinkat", "symlink",
         "symlinkat", "chmod", "chown", "lchown", "fchmodat", "fchownat",
         "mknod", "mknodat", "utimensat", "setxattr", "lsetxattr",
-        "removexattr",
+        "removexattr", "utime", "utimes", "futimesat", "fchmodat2",
+        "lremovexattr",
     )
 }
 
@@ -661,6 +682,7 @@ _FS_FD_SYSCALLS = {
     for n in (
         "ftruncate", "fsync", "fdatasync", "fchmod", "fchown",
         "fallocate", "fstatfs", "fgetxattr", "flistxattr", "fsetxattr",
+        "fremovexattr", "sync_file_range", "syncfs", "readahead",
     )
 }
 
@@ -685,6 +707,9 @@ _FS_EVENT = {
     SYS["fchmodat"]: IN_ATTRIB, SYS["fchownat"]: IN_ATTRIB,
     SYS["utimensat"]: IN_ATTRIB, SYS["setxattr"]: IN_ATTRIB,
     SYS["lsetxattr"]: IN_ATTRIB, SYS["removexattr"]: IN_ATTRIB,
+    SYS["utime"]: IN_ATTRIB, SYS["utimes"]: IN_ATTRIB,
+    SYS["futimesat"]: IN_ATTRIB, SYS["fchmodat2"]: IN_ATTRIB,
+    SYS["lremovexattr"]: IN_ATTRIB,
 }
 
 
@@ -967,6 +992,10 @@ class NativeProcess:
         self._sig_pending: list[tuple[int, int | None]] = []  # (sig, slot|None)
         self._itimer_token = None
         self._itimer_interval_ns = 0
+        # emulated identity (deterministic: the real host uid must never
+        # leak into simulated output; setters update, getters report)
+        self._uid = 0
+        self._gid = 0
         # fork bookkeeping
         self.parent: NativeProcess | None = None
         self.children: list[NativeProcess] = []
@@ -1400,6 +1429,7 @@ class NativeProcess:
         child._stdio_dups = dict(self._stdio_dups)
         child._next_vfd = self._next_vfd
         child._reserved_fds = set(self._reserved_fds)
+        child._uid, child._gid = self._uid, self._gid
         for sock in child._vfds.values():
             sock._nrefs = getattr(sock, "_nrefs", 1) + 1
         self._pending_forks[fork_id] = child
@@ -1473,9 +1503,64 @@ class NativeProcess:
         self._wait_waiters.append(thr)
         return True
 
+    def _handle_waitid(self, args: list[int]) -> bool:
+        """waitid(2): the siginfo-shaped wait (reference handler parity).
+        P_ALL/P_PID with WEXITED; WNOHANG honored (si_pid stays 0)."""
+        P_ALL, P_PID = 0, 1
+        WNOHANG = 1
+        WEXITED = 4
+        WNOWAIT = 0x01000000
+        idtype, wid, infop, options = args[0], args[1], args[2], args[3]
+        if idtype not in (P_ALL, P_PID) or not options & WEXITED:
+            # only exit events exist in this plane (no job control)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
+            return False
+
+        def match(c):
+            return idtype == P_ALL or wid == c.pid
+
+        def write_info(c):
+            if not infop:
+                return
+            CLD_EXITED, CLD_KILLED = 1, 2
+            buf = bytearray(128)
+            struct.pack_into("<iii", buf, 0, SIGCHLD, 0,
+                             CLD_KILLED if c.term_signal else CLD_EXITED)
+            struct.pack_into("<iIi", buf, 16, c.pid, 0,
+                             c.term_signal or (c.exit_code or 0))
+            try:
+                _vm_write(self._child.pid, infop, bytes(buf))
+            except OSError:
+                pass
+
+        for c in list(self.children):
+            if c.state == "zombie" and match(c):
+                if not options & WNOWAIT:  # WNOWAIT peeks, leaves waitable
+                    self.children.remove(c)
+                write_info(c)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+                return False
+        if not any(match(c) for c in self.children):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ECHILD)
+            return False
+        if options & WNOHANG:
+            if infop:  # kernel zeroes si_pid to signal "nothing yet"
+                try:
+                    _vm_write(self._child.pid, infop, b"\0" * 128)
+                except OSError:
+                    pass
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        thr = self._cur
+        thr.state = "blocked"
+        thr.blocked_num = SYS["waitid"]
+        thr.blocked_args = list(args)
+        self._wait_waiters.append(thr)
+        return True
+
     def _child_exited(self, child: NativeProcess):
-        """A fork child died: retry any parked wait4 (deterministically at
-        the current sim time)."""
+        """A fork child died: retry any parked wait4/waitid
+        (deterministically at the current sim time)."""
         waiters, self._wait_waiters = self._wait_waiters, []
         for thr in waiters:
             if thr.state != "blocked":
@@ -1484,7 +1569,10 @@ class NativeProcess:
             self.ipc.set_time(self.host.now())
             self.ipc.cur_slot = thr.slot
             self._cur = thr
-            self._handle_wait4(thr.blocked_args)
+            if thr.blocked_num == SYS["waitid"]:
+                self._handle_waitid(thr.blocked_args)
+            else:
+                self._handle_wait4(thr.blocked_args)
             if thr.state == "running":
                 self._runner = thr
                 self._kick_runner()
@@ -1930,6 +2018,148 @@ class NativeProcess:
             return self._handle_fs_fd(num, args)
         if num == SYS["flock"]:
             return self._handle_flock(args)
+        if num == SYS["open"]:
+            # legacy open(2): same policy as openat — virtualize the
+            # entropy devices, note O_CREAT for inotify, else passthrough
+            return self._handle(SYS["openat"],
+                                [AT_FDCWD & 0xFFFFFFFF, args[0], args[1],
+                                 args[2], 0, 0])
+        if num == SYS["pwrite64"]:
+            if args[0] in self._vfds:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ESPIPE)
+            else:
+                self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if num in (SYS["setuid"], SYS["setgid"], SYS["setreuid"],
+                   SYS["setregid"], SYS["setresuid"], SYS["setresgid"],
+                   SYS["setfsuid"], SYS["setfsgid"], SYS["setgroups"]):
+            # EMULATED identity: record the requested id so the getters
+            # agree (privilege-drop daemons verify with getuid after
+            # setuid), WITHOUT the native drop — a real setuid would strip
+            # the simulator's process_vm access to the child
+            def _take(v):  # -1 = keep (setre*/setres* convention)
+                v = ctypes.c_int32(v & 0xFFFFFFFF).value
+                return None if v == -1 else v & 0xFFFFFFFF
+
+            is_uid = num in (SYS["setuid"], SYS["setreuid"],
+                             SYS["setresuid"], SYS["setfsuid"])
+            attr = "_uid" if is_uid else "_gid"
+            if num in (SYS["setuid"], SYS["setgid"], SYS["setfsuid"],
+                       SYS["setfsgid"]):
+                setattr(self, attr, args[0] & 0xFFFFFFFF)
+            elif num in (SYS["setreuid"], SYS["setregid"]):
+                eff = _take(args[1])
+                if eff is None:
+                    eff = _take(args[0])
+                if eff is not None:
+                    setattr(self, attr, eff)
+            elif num in (SYS["setresuid"], SYS["setresgid"]):
+                eff = _take(args[1])
+                if eff is not None:
+                    setattr(self, attr, eff)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num in (SYS["getuid"], SYS["geteuid"]):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self._uid)
+            return False
+        if num in (SYS["getgid"], SYS["getegid"]):
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, self._gid)
+            return False
+        if num in (SYS["getresuid"], SYS["getresgid"]):
+            val = self._uid if num == SYS["getresuid"] else self._gid
+            try:
+                for ptr in args[:3]:
+                    if ptr:
+                        _vm_write(cpid, ptr, struct.pack("<I", val))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SYS["getgroups"]:
+            # one supplementary group: the emulated gid (size 0 queries
+            # the count, like the kernel)
+            if args[0] >= 1 and args[1]:
+                try:
+                    _vm_write(cpid, args[1], struct.pack("<I", self._gid))
+                except OSError:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 1)
+            return False
+        if num in (SYS["capget"], SYS["capset"]):
+            # no capability model in the simulation: report none, accept
+            # any set (handler parity; callers treat caps as best-effort)
+            if num == SYS["capget"] and args[1]:
+                try:
+                    _vm_write(cpid, args[1], b"\0" * 24)
+                except OSError:
+                    pass
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num == SYS["sched_setaffinity"]:
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # one-cpu host model
+            return False
+        if num == SYS["close_range"]:
+            CLOSE_RANGE_CLOEXEC = 0x4
+            first, last = args[0], min(args[1], 1 << 20)
+            if not (args[2] & CLOSE_RANGE_CLOEXEC):
+                # close every vfd in [first, last] (the implicit-close
+                # contract dup2 also honors) and release any flock locks
+                # real fds in the span held, then let the kernel close the
+                # real fds. CLOEXEC-marking only is a no-op for vfds
+                # (emulated descriptors deliberately survive exec).
+                for fd in [f for f in self._vfds if first <= f <= last]:
+                    self._close_virtual(fd)
+                for fd in [
+                    f for f in self._stdio_dups if first <= f <= last
+                ]:
+                    self._stdio_dups.pop(fd, None)
+                self._flock_release(span=(first, last))
+            self.ipc.reply(MSG_SYSCALL_NATIVE)
+            return False
+        if num == SYS["epoll_pwait2"]:
+            # timespec timeout -> ms, then the common epoll_wait path
+            timeout_ms = -1
+            if args[3]:
+                try:
+                    raw = _vm_read(cpid, args[3], 16)
+                    if len(raw) == 16:
+                        s, ns = struct.unpack("<qq", raw)
+                        timeout_ms = (s * NS_PER_SEC + ns) // 1_000_000
+                except OSError:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                    return False
+            return self._handle_epoll(
+                SYS["epoll_wait"], [args[0], args[1], args[2], timeout_ms]
+            )
+        if num == SYS["waitid"]:
+            return self._handle_waitid(args)
+        if num == SYS["execveat"]:
+            # resolve dirfd-relative (incl. AT_EMPTY_PATH/fexecve) here;
+            # the execve handler takes the override
+            AT_EMPTY_PATH = 0x1000
+            try:
+                rel = self._read_cstr(cpid, args[1]).decode(
+                    "utf-8", "surrogateescape"
+                )
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            if not rel and args[4] & AT_EMPTY_PATH:
+                try:
+                    path = os.readlink(f"/proc/{cpid}/fd/{args[0]}")
+                except OSError:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -EBADF)
+                    return False
+            else:
+                path = self._child_path(args[0], args[1])
+                if path is None:
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOENT)
+                    return False
+            return self._handle_execve(
+                [args[1], args[2], args[3]], path_override=path
+            )
         if num in (SYS["signalfd"], SYS["signalfd4"]):
             return self._handle_signalfd(num, args)
         if num in (SYS["inotify_init"], SYS["inotify_init1"],
@@ -2597,7 +2827,8 @@ class NativeProcess:
                 self._fs_note(p, mask)
             return
         # attrib/modify family: target must exist for the syscall to work
-        if num in (S["fchmodat"], S["fchownat"], S["utimensat"]):
+        if num in (S["fchmodat"], S["fchownat"], S["utimensat"],
+                   S["futimesat"], S["fchmodat2"]):
             p = self._child_path(args[0], args[1])
         else:
             p = self._child_path(AT_FDCWD, args[0])
@@ -2702,15 +2933,23 @@ class NativeProcess:
         host = self.host
         host.schedule(host.now(), lambda: _flock_wake(host, key))
 
-    def _flock_release(self, fd: int | None = None):
-        """Release locks on close (kernel contract) or on process death;
-        fd=None drops everything this pid holds or waits for."""
+    def _flock_release(self, fd: int | None = None,
+                       span: tuple[int, int] | None = None):
+        """Release locks on close/close_range (kernel contract) or on
+        process death; fd=None and span=None drops everything this pid
+        holds or waits for."""
         table = self.host.__dict__.get("_flocks")
         if not table:
             return
         for key, ent in list(table.items()):
             def mine(m):
-                return m[0] == self.pid and (fd is None or m[1] == fd)
+                if m[0] != self.pid:
+                    return False
+                if fd is not None:
+                    return m[1] == fd
+                if span is not None:
+                    return span[0] <= m[1] <= span[1]
+                return True
 
             changed = False
             if ent["ex"] is not None and mine(ent["ex"]):
@@ -2719,7 +2958,7 @@ class NativeProcess:
             n0 = len(ent["sh"])
             ent["sh"] = {m for m in ent["sh"] if not mine(m)}
             changed |= len(ent["sh"]) != n0
-            if fd is None:
+            if fd is None and span is None:  # process death: drop waiters
                 ent["waiters"] = [
                     (p, t) for p, t in ent["waiters"] if p is not self
                 ]
@@ -3398,7 +3637,8 @@ class NativeProcess:
             )
         return out
 
-    def _handle_execve(self, args: list[int]) -> bool:
+    def _handle_execve(self, args: list[int],
+                       path_override: str | None = None) -> bool:
         """execve: replace the native child with a freshly spawned process
         image, exactly like the reference — which SIGKILLs the old native
         process and posix_spawns the target under a new ManagedThread
@@ -3414,8 +3654,12 @@ class NativeProcess:
         passthrough files live in the dead process's fd table)."""
         cpid = self._child.pid
         try:
-            path = self._read_cstr(cpid, args[0]).decode(
-                "utf-8", "surrogateescape"
+            path = (
+                path_override
+                if path_override is not None
+                else self._read_cstr(cpid, args[0]).decode(
+                    "utf-8", "surrogateescape"
+                )
             )
             argv = self._read_cstr_array(cpid, args[1]) if args[1] else []
             envp = self._read_cstr_array(cpid, args[2]) if args[2] else []
@@ -3423,7 +3667,8 @@ class NativeProcess:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
             return False
         # resolve relative paths against the CALLER'S cwd (chdir is native,
-        # so the child's cwd can differ from the simulator's)
+        # so the child's cwd can differ from the simulator's); execveat
+        # passes an already-resolved override
         try:
             child_cwd = os.readlink(f"/proc/{cpid}/cwd")
         except OSError:

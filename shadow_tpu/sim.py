@@ -359,7 +359,7 @@ class Simulation:
         # (empty queues never activate; the digest ignores them)
         self._num_real = len(self.hosts)
         num_hosts = -(-self._num_real // world) * world
-        qcap = ex.event_queue_capacity
+        qcap, send_budget, rpc = ex.resolve_shapes(num_hosts)
         self.engine_cfg = EngineConfig(
             num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
@@ -370,9 +370,9 @@ class Simulation:
             use_dynamic_runahead=ex.use_dynamic_runahead,
             use_codel=ex.use_codel,
             queue_capacity=qcap,
-            sends_per_host_round=ex.sends_per_host_round,
+            sends_per_host_round=send_budget,
             max_round_inserts=ex.max_round_inserts or qcap,
-            rounds_per_chunk=ex.rounds_per_chunk,
+            rounds_per_chunk=rpc,
             microstep_limit=ex.microstep_limit,
             world=world,
             # exact elision: with no bandwidth limits anywhere, token buckets

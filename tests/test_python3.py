@@ -201,3 +201,20 @@ def test_flock_contention_in_sim_time(tmp_path):
     wout = b"".join(waiter.stdout).decode()
     assert "nb busy at 50" in wout
     assert "acquired at 300" in wout  # exactly the holder's release time
+
+
+def test_last_stretch_dispatch_arms(tmp_path):
+    """r4 closes the reference's dispatch surface: legacy open/stat/pipe,
+    pwrite, utimes, emulated credential setters (a NATIVE setuid would
+    strip the simulator's process_vm access), capget/capset,
+    sched_setaffinity, waitid (siginfo-shaped reap), close_range across
+    emulated vfds."""
+    h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=4, host_id=0))
+    p = spawn_native(
+        h, [os.path.join(REPO, "native", "build", "test_misc2"),
+            str(tmp_path)]
+    )
+    h.execute(5 * SEC)
+    out = b"".join(p.stdout).decode()
+    assert p.exit_code == 0, out + b"".join(p.stderr).decode()
+    assert "misc2 ok" in out
