@@ -89,7 +89,13 @@ typedef struct {
      * it off; brk growth stays shim-local either way). */
     uint64_t heap_start;
     uint64_t heap_cur;
-} IpcBlock; /* 16 + 32*160 + 16 = 5152 bytes */
+    /* fork barrier: the child stores 1 + FUTEX_WAKEs once its heap is
+     * privatized; the parent FUTEX_WAITs before resuming. Without it the
+     * two processes share the MAP_SHARED heap for a moment and parent
+     * mallocs tear the child's copy (observed: glibc fastbin aborts). */
+    uint32_t fork_sync;
+    uint32_t _pad2;
+} IpcBlock; /* 16 + 32*160 + 16 + 8 = 5160 bytes */
 
 #define IPC_FLAGS_OFF 12
 

@@ -303,7 +303,17 @@ static long do_brk(long addr_l) {
         }
         g_heap_mapped = page_end;
     }
-    g_heap_cur = addr; /* shrink keeps pages mapped (harmless divergence) */
+    if (addr < cur) {
+        /* kernel brk SHRINK frees whole pages, so a later regrowth sees
+         * zeros — glibc's sysmalloc asserts on that (top-chunk invariant
+         * blew up in fork children when stale bytes reappeared). Keep the
+         * pages mapped but zero them like the kernel would. */
+        uintptr_t lo = (addr + 4095) & ~(uintptr_t)4095;
+        uintptr_t hi = (cur + 4095) & ~(uintptr_t)4095;
+        if (hi > lo && hi <= g_heap_mapped)
+            memset((void *)lo, 0, hi - lo);
+    }
+    g_heap_cur = addr;
     if (g_ipc && g_heap_fd >= 0)
         __atomic_store_n(&g_ipc->heap_cur, (uint64_t)addr, __ATOMIC_RELEASE);
     heap_unlock();
@@ -501,6 +511,9 @@ static long do_fork(long num, const long args[6]) {
             g_raw(SYS_close, g_heap_fd, 0, 0, 0, 0, 0);
             g_heap_fd = -1;
         }
+        /* release the parent: our heap is private now (see ipc.h) */
+        __atomic_store_n(&nb->fork_sync, 1u, __ATOMIC_RELEASE);
+        g_raw(SYS_futex, (long)&nb->fork_sync, 1 /*FUTEX_WAKE*/, 1, 0, 0, 0);
         ShimMsg m, resp;
         memset(&m, 0, sizeof m);
         m.kind = MSG_START;
@@ -517,7 +530,18 @@ static long do_fork(long num, const long args[6]) {
         memcpy(g_shm_base, path, strlen(path) + 1);
         return 0;
     }
-    /* parent: drop the child's mapping, report the real pid */
+    /* parent: WAIT for the child's heap privatization before touching the
+     * (momentarily shared) heap again — bounded so a child that dies
+     * pre-handshake cannot wedge us (see ipc.h fork_sync) */
+    if (rc > 0 && g_heap_start) {
+        struct timespec ts = {1, 0};
+        for (int i = 0;
+             i < 10 && !__atomic_load_n(&nb->fork_sync, __ATOMIC_ACQUIRE);
+             i++)
+            g_raw(SYS_futex, (long)&nb->fork_sync, 0 /*FUTEX_WAIT*/, 0,
+                  (long)&ts, 0, 0);
+    }
+    /* drop the child's mapping, report the real pid */
     g_raw(SYS_munmap, mem, sizeof(IpcBlock), 0, 0, 0, 0);
     long done_args[6] = {rc, fork_id, 1, 0, 0, 0};
     return forward_msg(MSG_CLONE_DONE, num, done_args);
@@ -1280,6 +1304,32 @@ static int install_seccomp(void) {
 
 /* ------------------------------------------------------------------ init */
 
+/* execve fd-table preservation: the simulator-side respawn grabbed the
+ * old image's fds (pidfd_getfd) and passed them to this process parked
+ * at numbers >= 3000; SHADOW_FD_MAP ("tgt:src,...") says where each one
+ * belongs. Applied before ANYTHING else touches fds. */
+static void apply_fd_map(void) {
+    const char *map = getenv("SHADOW_FD_MAP");
+    if (!map || !*map)
+        return;
+    const char *p = map;
+    while (*p) {
+        char *end = nullptr;
+        long tgt = strtol(p, &end, 10);
+        p = end;
+        if (*p == ':')
+            p++;
+        long src = strtol(p, &end, 10);
+        p = end;
+        if (*p == ',')
+            p++;
+        if (src >= 0 && tgt >= 0 && src != tgt) {
+            dup2((int)src, (int)tgt);
+            close((int)src);
+        }
+    }
+}
+
 /* Runs pre-seccomp in the constructor (plain syscalls OK). Finds the
  * [heap] segment, copies its live contents into the shared tmpfs file,
  * and maps the file over it MAP_FIXED — addresses and bytes unchanged,
@@ -1433,6 +1483,7 @@ __attribute__((constructor)) static void shadow_shim_init(void) {
     if (patch_vdso() == 0)
         prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
 
+    apply_fd_map(); /* execve-preserved fds back to their numbers */
     setup_heap_window(); /* best-effort: failure leaves brk passthrough */
 
     /* StartReq/StartRes handshake (managed_thread.rs:135-243) */

@@ -137,6 +137,8 @@ class StreamEnd(File):
 class PipeEnd(StreamEnd):
     _err_on_peer_close = True  # EPIPE surfaces as ERROR on the write end
 
+    PIPE_BUF = 4096  # pipe(7): writes <= PIPE_BUF are atomic
+
     def __init__(self, buf: _SharedBuf, writable: bool):
         super().__init__()
         self.is_writer = writable
@@ -147,6 +149,19 @@ class PipeEnd(StreamEnd):
         else:
             self._rx = buf
             buf.readers += 1
+
+    def write(self, data: bytes) -> int | None:
+        if (
+            self._tx is not None
+            and self._tx.readers != 0
+            and len(data) <= min(self.PIPE_BUF, self._tx.capacity)
+            and self._tx.space() < len(data)
+        ):
+            # atomicity: a small write must land whole or not at all —
+            # the kernel never tears records <= PIPE_BUF across
+            # interleaved writers (O_NONBLOCK gets EAGAIN, blockers wait)
+            return None
+        return super().write(data)
 
 
 Pipe = PipeEnd  # exported name

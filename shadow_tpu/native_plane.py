@@ -21,6 +21,7 @@ from __future__ import annotations
 import ctypes
 import ctypes.util
 import errno
+import fcntl as fcntl_mod
 import mmap
 import os
 import struct
@@ -50,7 +51,8 @@ THREADS_OFF = 16
 CHANPAIR_SIZE = 160
 PAIR_TO_SHIM_OFF = 80
 HEAP_START_OFF = THREADS_OFF + IPC_MAX_THREADS * CHANPAIR_SIZE
-IPC_SIZE = HEAP_START_OFF + 16  # + heap_start/heap_cur (MemoryMapper)
+# + heap_start/heap_cur (MemoryMapper) + fork_sync barrier + pad
+IPC_SIZE = HEAP_START_OFF + 16 + 8
 HEAP_MAX = 256 << 20  # SHADOW_HEAP_MAX in ipc.h
 
 _libc = ctypes.CDLL(None, use_errno=True)
@@ -76,6 +78,53 @@ def _futex(addr, op, val, timeout_s: float | None = None) -> int:
 
 class _Iovec(ctypes.Structure):
     _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
+
+
+SYS_pidfd_getfd = 438
+
+
+def _vfd_mode(obj) -> int:
+    """st_mode for an emulated descriptor: sockets are S_IFSOCK, stream
+    ends (pipes) and everything buffer-shaped are S_IFIFO, captured stdio
+    (obj None) is a FIFO to the simulator — NEVER the real placeholder
+    fd's identity."""
+    if obj is not None and hasattr(obj, "PROTO"):
+        return 0o140000 | 0o600  # S_IFSOCK
+    from shadow_tpu.host.unix import UnixDgramSocket, UnixStreamSocket
+
+    if isinstance(obj, (UnixStreamSocket, UnixDgramSocket)):
+        return 0o140000 | 0o600
+    return 0o010000 | 0o600  # S_IFIFO
+
+
+def _synth_stat(obj) -> bytes:
+    """x86-64 struct stat (144 bytes) for an emulated descriptor."""
+    ino = (id(obj) if obj is not None else 3) & ((1 << 48) - 1)
+    buf = bytearray(144)
+    struct.pack_into("<QQQ", buf, 0, 0x11, ino, 1)  # dev, ino, nlink
+    struct.pack_into("<III", buf, 24, _vfd_mode(obj), 0, 0)  # mode,uid,gid
+    struct.pack_into("<q", buf, 40, 0)  # rdev: NOT a device
+    struct.pack_into("<qqq", buf, 48, 0, 4096, 0)  # size, blksize, blocks
+    return bytes(buf)
+
+
+def _synth_statx(obj) -> bytes:
+    """struct statx (256 bytes) for an emulated descriptor."""
+    ino = (id(obj) if obj is not None else 3) & ((1 << 48) - 1)
+    buf = bytearray(256)
+    STATX_BASIC_STATS = 0x7FF
+    struct.pack_into("<II", buf, 0, STATX_BASIC_STATS, 4096)
+    struct.pack_into("<IIIH", buf, 16, 1, 0, 0, _vfd_mode(obj))
+    struct.pack_into("<QQQ", buf, 32, ino, 0, 0)  # ino, size, blocks
+    return bytes(buf)
+
+
+def _pidfd_getfd(pidfd: int, target_fd: int) -> int:
+    """Grab a COPY of another process's fd (execve fd-table preservation)."""
+    fd = _libc.syscall(SYS_pidfd_getfd, pidfd, target_fd, 0)
+    if fd < 0:
+        raise OSError(ctypes.get_errno(), "pidfd_getfd")
+    return fd
 
 
 # MemoryMapper windows (reference memory_mapper.rs:84-110): child pid ->
@@ -458,7 +507,7 @@ _NATIVE_OK = {
         "sigaltstack", "arch_prctl", "set_tid_address", "set_robust_list",
         "rseq", "prlimit64", "openat", "fstat", "newfstatat",
         "statx", "lseek", "pread64", "access", "readlink", "getcwd",
-        "getdents64", "pipe2", "umask", "chdir", "fchdir",
+        "getdents64", "umask", "chdir", "fchdir",
         # NOTE: the uid/gid GETTERS are NOT native — they report the
         # per-process EMULATED identity (set by the emulated setters; the
         # real host uid would leak machine state into simulated output,
@@ -475,7 +524,12 @@ _NATIVE_OK = {
         # prctl is process-local (PR_SET_NAME etc.); the shim's SIGSYS
         # disposition is guarded separately, and seccomp-on-seccomp only
         # tightens. pipe is a real kernel pipe like pipe2.
-        "stat", "lstat", "pipe", "get_robust_list", "prctl", "setrlimit",
+        "stat", "lstat", "get_robust_list", "prctl", "setrlimit",
+        # NOTE: pipe/pipe2 are NOT native (r4): a real pipe lets one
+        # managed process block INSIDE the kernel waiting on another
+        # (bash's command substitution deadlocked the one-runner
+        # scheduler exactly there) — pipes are emulated vfds so blocking
+        # happens in simulated time (reference descriptor/pipe.rs)
     )
 }
 # NOTE: uname is NOT native — its nodename field would leak the real
@@ -968,6 +1022,9 @@ class NativeProcess:
         self._vfds: dict[int, object] = {}
         self._vfd_flags: dict[int, int] = {}  # O_NONBLOCK etc.
         self._stdio_dups: dict[int, int] = {}  # vfd -> 1|2 (dup'd stdio)
+        # stdio numbers a native dup2 re-pointed at a REAL kernel object
+        # (pipeline plumbing): excluded from capture until closed
+        self._stdio_overridden: set[int] = set()
         self._next_vfd = VFD_BASE
         # fd numbers the child owns as REAL kernel fds in the vfd range
         # (native dup2(realfd, N>=VFD_BASE)): the allocator must never hand
@@ -1429,6 +1486,7 @@ class NativeProcess:
         child._stdio_dups = dict(self._stdio_dups)
         child._next_vfd = self._next_vfd
         child._reserved_fds = set(self._reserved_fds)
+        child._stdio_overridden = set(self._stdio_overridden)
         child._uid, child._gid = self._uid, self._gid
         for sock in child._vfds.values():
             sock._nrefs = getattr(sock, "_nrefs", 1) + 1
@@ -1765,15 +1823,18 @@ class NativeProcess:
         if num == SYS["close"]:
             if args[0] in self._stdio_dups:
                 del self._stdio_dups[args[0]]
+                self._stdio_overridden.discard(args[0])
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
                 return False
             if args[0] in self._vfds:
                 sock = self._vfds.pop(args[0])
                 self._vfd_flags.pop(args[0], None)
                 self._drop_vfd(sock)
+                self._stdio_overridden.discard(args[0])
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             else:
                 self._flock_release(args[0])  # close drops flock locks
+                self._stdio_overridden.discard(args[0])
                 self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
         if num == SYS["dup"]:
@@ -2018,6 +2079,68 @@ class NativeProcess:
             return self._handle_fs_fd(num, args)
         if num == SYS["flock"]:
             return self._handle_flock(args)
+        if num in (SYS["fstat"], SYS["newfstatat"], SYS["statx"]) and (
+            args[0] in self._vfds
+            or self._stdio_target(args[0]) is not None
+        ):
+            # stat on an emulated descriptor (or captured stdio) must NOT
+            # reach the kernel: the real fd behind the number is the
+            # DEVNULL placeholder, and tools act on what stat says — GNU
+            # grep silently suppresses ALL output when st_rdev says its
+            # stdout is /dev/null (that one cost an afternoon). glibc >=
+            # 2.33 implements fstat() as newfstatat(fd, "", AT_EMPTY_PATH),
+            # so all three forms are covered here.
+            if num == SYS["fstat"]:
+                buf_ptr = args[1]
+            else:
+                flag_arg = args[3] if num == SYS["newfstatat"] else args[2]
+                try:
+                    pth = self._read_cstr(cpid, args[1], 8)
+                except OSError:
+                    pth = b"?"
+                if pth != b"" or not flag_arg & 0x1000:  # AT_EMPTY_PATH
+                    if pth.startswith(b"/"):
+                        # absolute path: dirfd is ignored by the kernel
+                        self.ipc.reply(MSG_SYSCALL_NATIVE)
+                        return False
+                    # path-relative with a virtual fd as dirfd: the number
+                    # is no directory (and has no real kernel fd behind it)
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOTDIR)
+                    return False
+                buf_ptr = args[2] if num == SYS["newfstatat"] else args[4]
+            obj = self._vfds.get(args[0])
+            try:
+                if num == SYS["statx"]:
+                    _vm_write(cpid, buf_ptr, _synth_statx(obj))
+                else:
+                    _vm_write(cpid, buf_ptr, _synth_stat(obj))
+            except OSError:
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
+        if num in (SYS["pipe"], SYS["pipe2"]):
+            # emulated pipe (reference descriptor/pipe.rs): see the
+            # _NATIVE_OK note — cross-process pipe blocking must park in
+            # SIM time, not in the kernel
+            from shadow_tpu.host.pipe import create_pipe
+
+            r, w = create_pipe()
+            rfd, wfd = self._alloc_vfd(), self._alloc_vfd()
+            self._vfds[rfd] = r
+            self._vfds[wfd] = w
+            if num == SYS["pipe2"] and args[1] & O_NONBLOCK:
+                self._vfd_flags[rfd] = O_NONBLOCK
+                self._vfd_flags[wfd] = O_NONBLOCK
+            try:
+                _vm_write(cpid, args[0], struct.pack("<ii", rfd, wfd))
+            except OSError:
+                self._close_virtual(rfd)
+                self._close_virtual(wfd)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
+                return False
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            return False
         if num == SYS["open"]:
             # legacy open(2): same policy as openat — virtualize the
             # entropy devices, note O_CREAT for inotify, else passthrough
@@ -2104,6 +2227,9 @@ class NativeProcess:
             CLOSE_RANGE_CLOEXEC = 0x4
             first, last = args[0], min(args[1], 1 << 20)
             if not (args[2] & CLOSE_RANGE_CLOEXEC):
+                self._stdio_overridden -= {
+                    f for f in self._stdio_overridden if first <= f <= last
+                }
                 # close every vfd in [first, last] (the implicit-close
                 # contract dup2 also honors) and release any flock locks
                 # real fds in the span held, then let the kernel close the
@@ -2198,7 +2324,7 @@ class NativeProcess:
             return True  # parked
 
         if num in (SYS["write"], SYS["writev"]) and args[0] not in self._vfds and (
-            args[0] in (1, 2) or args[0] in self._stdio_dups
+            self._stdio_target(args[0]) is not None
         ):
             # (a vfd dup2()d over fd 1/2 shadows the captured stdio)
             if num == SYS["writev"] and args[2] > IOV_MAX:
@@ -2216,18 +2342,35 @@ class NativeProcess:
 
         if num == SYS["write"] and args[0] in self._vfds:
             f = self._vfds[args[0]]
-            if not hasattr(f, "PROTO"):  # eventfd counters etc.
+            if not hasattr(f, "PROTO"):  # eventfd/timerfd/PIPE ends
+                from shadow_tpu.host.filestate import FileState
+
                 try:
-                    data = _vm_read(cpid, args[1], min(args[2], 16))
+                    data = _vm_read(cpid, args[1], min(args[2], 1 << 20))
                     n = f.write(data)
+                except BrokenPipeError:
+                    # kernel contract: EPIPE comes WITH SIGPIPE (default
+                    # action kills — `seq | head -1` relies on it)
+                    self._post_signal(13)
+                    if self.state != "running":
+                        return True
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EPIPE)
+                    return False
                 except (OSError, AttributeError) as e:
                     code = _errno_of(e) if isinstance(e, OSError) else -EINVAL
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, code)
                     return False
                 if n is None:
-                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
-                else:
-                    self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
+                    if self._nonblock(args[0]):
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                        return False
+                    self._block_on(
+                        [(f, FileState.WRITABLE | FileState.ERROR
+                          | FileState.CLOSED)],
+                        num, args,
+                    )
+                    return True
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
                 return False
             return self._handle_socket(SYS["sendto"], [args[0], args[1], args[2], 0, 0, 0])
         if num == SYS["writev"] and args[0] in self._vfds:
@@ -2241,16 +2384,32 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EFAULT)
                 return False
             if not hasattr(sock, "PROTO"):
-                # eventfd/timerfd: same semantics as write(2) on the vfd
+                # eventfd/timerfd/pipes: same semantics as write(2)
+                from shadow_tpu.host.filestate import FileState
+
                 try:
-                    n = sock.write(data[:16])
+                    n = sock.write(data)
+                except BrokenPipeError:
+                    self._post_signal(13)  # SIGPIPE (kernel contract)
+                    if self.state != "running":
+                        return True
+                    self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EPIPE)
+                    return False
                 except (OSError, AttributeError) as e:
                     code = _errno_of(e) if isinstance(e, OSError) else -EINVAL
                     self.ipc.reply(MSG_SYSCALL_COMPLETE, code)
                     return False
-                self.ipc.reply(
-                    MSG_SYSCALL_COMPLETE, -EAGAIN if n is None else n
-                )
+                if n is None:
+                    if self._nonblock(args[0]):
+                        self.ipc.reply(MSG_SYSCALL_COMPLETE, -EAGAIN)
+                        return False
+                    self._block_on(
+                        [(sock, FileState.WRITABLE | FileState.ERROR
+                          | FileState.CLOSED)],
+                        num, args,
+                    )
+                    return True
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, n)
                 return False
             from shadow_tpu.host.sockets import UdpSocket
 
@@ -2305,7 +2464,7 @@ class NativeProcess:
             return self._handle_socket(SYS["recvfrom"], [args[0], args[1], args[2], 0, 0, 0])
 
         if num == SYS["read"]:
-            if args[0] == 0:
+            if args[0] == 0 and 0 not in self._stdio_overridden:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # stdin: EOF
             else:
                 # real-file fds were opened natively; read them natively too
@@ -2319,7 +2478,9 @@ class NativeProcess:
             self.ipc.reply(MSG_SYSCALL_NATIVE)
             return False
 
-        if num == SYS["ioctl"] and args[0] in (0, 1, 2):
+        if num == SYS["ioctl"] and args[0] in (0, 1, 2) and (
+            args[0] not in self._stdio_overridden
+        ):
             self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.ENOTTY)
             return False
 
@@ -2654,7 +2815,12 @@ class NativeProcess:
     def _stdio_target(self, fd: int) -> int | None:
         """Resolve a fd to its captured-stdio target (1|2) or None. The dup
         table wins over the well-known numbers so `dup2(1, 2)` (2>&1) really
-        redirects fd 2's writes into the stdout buffer."""
+        redirects fd 2's writes into the stdout buffer. A REAL kernel fd
+        dup2()d onto 0/1/2 (a shell wiring a pipeline stage's stdout into
+        a pipe) takes the number OUT of capture: its I/O must reach the
+        real object."""
+        if fd in self._stdio_overridden:
+            return None
         tgt = self._stdio_dups.get(fd)
         if tgt is not None:
             return tgt
@@ -2684,12 +2850,15 @@ class NativeProcess:
 
     def _close_virtual(self, fd: int):
         """Silently drop whatever virtual thing occupies `fd` (dup2 target
-        semantics: the previous descriptor is implicitly closed)."""
+        semantics: the previous descriptor is implicitly closed). Re-
+        pointing a previously REAL-overridden stdio number at a virtual
+        object also restores its capture semantics."""
         if fd in self._vfds:
             sock = self._vfds.pop(fd)
             self._vfd_flags.pop(fd, None)
             self._drop_vfd(sock)
         self._stdio_dups.pop(fd, None)
+        self._stdio_overridden.discard(fd)
 
     def _handle_dup2(self, num: int, args: list[int]) -> bool:
         old, new = args[0], args[1]
@@ -2720,6 +2889,10 @@ class NativeProcess:
             # the child now owns a REAL kernel fd at this number; the vfd
             # allocator must never hand it out (it would shadow the live fd)
             self._reserved_fds.add(new)
+        if new in (0, 1, 2):
+            # the shell wired a real object (a pipe) onto a stdio number:
+            # that number leaves capture until closed
+            self._stdio_overridden.add(new)
         self.ipc.reply(MSG_SYSCALL_NATIVE)
         return False
 
@@ -3687,6 +3860,48 @@ class NativeProcess:
         if self.strace is not None:
             self.strace(self.host.now(), self.pid, "execve",
                         (path, len(argv), len(envp)), None)
+        # preserve the old image's REAL fd table (exec semantics: every
+        # non-CLOEXEC fd survives — a shell pipeline stage's stdin/stdout
+        # pipes most of all). pidfd_getfd pulls each fd into the
+        # simulator; the fds ride to the new image via pass_fds and the
+        # shim remaps them to their original numbers from SHADOW_FD_MAP
+        # before anything else runs.
+        fd_map: list[tuple[int, int]] = []  # (target number, our dup)
+        try:
+            pidfd = os.pidfd_open(cpid)
+        except OSError:
+            pidfd = -1
+        if pidfd >= 0:
+            try:
+                child_fds = sorted(
+                    int(nm) for nm in os.listdir(f"/proc/{cpid}/fd")
+                )
+                # park ABOVE every target number so apply_fd_map's
+                # dup2(src, tgt); close(src) sequence can never clobber a
+                # src another entry still needs
+                park_base = max([3000] + [f + 1 for f in child_fds])
+                for tgt in child_fds:
+                    if tgt in (0, 1, 2) and tgt not in self._stdio_overridden:
+                        continue  # captured stdio: fresh DEVNULLs
+                    if tgt in self._vfds or tgt in self._stdio_dups:
+                        continue  # emulated objects survive via the tables
+                    try:
+                        with open(f"/proc/{cpid}/fdinfo/{tgt}") as f:
+                            flags = int(
+                                f.read().split("flags:")[1].split()[0], 8
+                            )
+                        if flags & 0o2000000:  # O_CLOEXEC: dies at exec
+                            continue
+                        g = _pidfd_getfd(pidfd, tgt)
+                        hi = fcntl_mod.fcntl(g, fcntl_mod.F_DUPFD, park_base)
+                        os.close(g)
+                        os.set_inheritable(hi, True)
+                    except OSError:
+                        continue
+                    fd_map.append((tgt, hi))
+            finally:
+                os.close(pidfd)
+
         # spawn the new image FIRST (fresh IPC block, the CALLER'S envp plus
         # the simulator plumbing): a spawn failure — e.g. ENOEXEC for a bad
         # binary format the preflight can't see — must error in the OLD
@@ -3698,6 +3913,7 @@ class NativeProcess:
             env[k] = v
         env["LD_PRELOAD"] = shim_path()
         env["SHADOW_SHM_PATH"] = new_ipc.path
+        env["SHADOW_FD_MAP"] = ",".join(f"{t}:{h}" for t, h in fd_map)
         new_ipc.set_time(self.host.now())
         hcfg = self.host.cfg
         if hcfg.model_unblocked_latency:
@@ -3707,11 +3923,16 @@ class NativeProcess:
                 argv or [path], executable=path, env=env, cwd=child_cwd,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                 stdin=subprocess.DEVNULL,
+                pass_fds=[h for _, h in fd_map],
             )
         except OSError as e:
             new_ipc.close()
+            for _, h in fd_map:
+                os.close(h)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, -(e.errno or errno.ENOEXEC))
             return False
+        for _, h in fd_map:  # our copies: the child holds its own now
+            os.close(h)
         # point of no return: tear down the old native process (threads die
         # with it, per exec) and swap the new image in
         self._unregister_heap()

@@ -218,3 +218,44 @@ def test_last_stretch_dispatch_arms(tmp_path):
     out = b"".join(p.stdout).decode()
     assert p.exit_code == 0, out + b"".join(p.stderr).decode()
     assert "misc2 ok" in out
+
+
+BASH_SCRIPT = (
+    "echo start; seq 1 20 | grep -v 7 | sort -rn | head -3 | tr '\\n' ' '; "
+    "echo; for i in 1 2 3; do echo loop $i; done | wc -l; "
+    "x=$(date +%s); echo epoch=$x; sleep 0.3; echo done; exit 0"
+)
+
+
+@pytest.mark.skipif(not os.path.exists("/bin/bash"), reason="no bash")
+def test_bash_pipelines_and_command_substitution():
+    """An unmodified bash runs a compound script under the shim: 5-stage
+    coreutils pipelines (fork/execve/dup2 over EMULATED pipes — blocking
+    parks in sim time instead of wedging the scheduler in the kernel),
+    command substitution, and date reading the SIMULATED clock. Two runs
+    are byte-identical across the whole process tree."""
+
+    def once():
+        h = CpuHost(HostConfig(name="n1", ip="10.0.0.1", seed=4, host_id=0))
+        p = spawn_native(h, ["/bin/bash", "-c", BASH_SCRIPT])
+        h.execute(8 * SEC)
+        tree = {
+            q.pid: (
+                tuple(getattr(q, "argv", ())), q.exit_code,
+                b"".join(q.stdout),
+            )
+            for q in h.processes.values()
+        }
+        return p.exit_code, b"".join(p.stdout), tree
+
+    code, out, tree = once()
+    assert code == 0, tree
+    assert b"start\n" in out
+    assert b"epoch=0\n" in out  # date(1) reads the SIMULATED clock
+    assert b"done\n" in out
+    # the pipeline tail stages carried the right bytes
+    flat = b"".join(v[2] for v in tree.values())
+    assert b"20 19 18 " in flat  # seq|grep -v 7|sort -rn|head -3|tr
+    assert b"3\n" in flat  # for-loop | wc -l
+    assert all(v[1] == 0 for v in tree.values()), tree
+    assert once() == (code, out, tree)  # deterministic process tree
