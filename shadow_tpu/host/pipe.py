@@ -63,12 +63,18 @@ class StreamEnd(File):
                 if self._err_on_peer_close:
                     on |= FileState.ERROR
                 off |= FileState.WRITABLE
-            elif self._tx.space() > 0:
+            elif self._tx.space() >= self._writable_min():
                 on |= FileState.WRITABLE
             else:
                 off |= FileState.WRITABLE
         # `on` wins over `off` (EOF marks an empty buffer readable)
         self._set_state(on=on, off=off & ~on)
+
+    def _writable_min(self) -> int:
+        """Free space needed before WRITABLE is raised. Streams: any byte.
+        Pipes override to PIPE_BUF — pipe(7)'s POLLOUT contract — which is
+        also what re-wakes a writer parked on an atomic small write."""
+        return 1
 
     def _sync_both(self):
         self._sync()
@@ -149,6 +155,11 @@ class PipeEnd(StreamEnd):
         else:
             self._rx = buf
             buf.readers += 1
+
+    def _writable_min(self) -> int:
+        if self._tx is None:
+            return 1
+        return min(self.PIPE_BUF, self._tx.capacity)
 
     def write(self, data: bytes) -> int | None:
         if (

@@ -83,6 +83,18 @@ class _Iovec(ctypes.Structure):
 SYS_pidfd_getfd = 438
 
 
+def _vfd_access_mode(obj) -> int:
+    """O_ACCMODE bits for F_GETFL: a pipe end is O_WRONLY/O_RDONLY by
+    direction; everything else (sockets, event/timer/signal/inotify fds)
+    is O_RDWR. glibc's fdopen/freopen validate this against the stream
+    mode."""
+    from shadow_tpu.host.pipe import PipeEnd
+
+    if isinstance(obj, PipeEnd):
+        return 1 if obj.is_writer else 0  # O_WRONLY / O_RDONLY
+    return 2  # O_RDWR
+
+
 def _vfd_mode(obj) -> int:
     """st_mode for an emulated descriptor: sockets are S_IFSOCK, stream
     ends (pipes) and everything buffer-shaped are S_IFIFO, captured stdio
@@ -746,6 +758,7 @@ AT_FDCWD = -100
 AT_REMOVEDIR = 0x200
 O_CREAT = 0x40
 O_NONBLOCK = 0x800
+O_CLOEXEC = 0o2000000  # == SOCK_CLOEXEC == EFD/TFD/SFD/EPOLL_CLOEXEC
 SOCKFS_MAGIC = 0x534F434B
 
 # inotify event selection per mutation syscall: (mask, extra-for-dirs)
@@ -1025,6 +1038,10 @@ class NativeProcess:
         # stdio numbers a native dup2 re-pointed at a REAL kernel object
         # (pipeline plumbing): excluded from capture until closed
         self._stdio_overridden: set[int] = set()
+        # close-on-exec vfds: dropped by the execve respawn (git's
+        # child_process protocol deadlocks on pipe EOF without this —
+        # a spawned pack-objects must NOT inherit its own pipe's write end)
+        self._vfd_cloexec: set[int] = set()
         self._next_vfd = VFD_BASE
         # fd numbers the child owns as REAL kernel fds in the vfd range
         # (native dup2(realfd, N>=VFD_BASE)): the allocator must never hand
@@ -1487,6 +1504,7 @@ class NativeProcess:
         child._next_vfd = self._next_vfd
         child._reserved_fds = set(self._reserved_fds)
         child._stdio_overridden = set(self._stdio_overridden)
+        child._vfd_cloexec = set(self._vfd_cloexec)
         child._uid, child._gid = self._uid, self._gid
         for sock in child._vfds.values():
             sock._nrefs = getattr(sock, "_nrefs", 1) + 1
@@ -1831,6 +1849,7 @@ class NativeProcess:
                 self._vfd_flags.pop(args[0], None)
                 self._drop_vfd(sock)
                 self._stdio_overridden.discard(args[0])
+                self._vfd_cloexec.discard(args[0])
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             else:
                 self._flock_release(args[0])  # close drops flock locks
@@ -1864,14 +1883,27 @@ class NativeProcess:
             # stderr/stdout (DEVNULL) and silently swallow output
             nfd = self._alloc_vfd()
             self._stdio_dups[nfd] = self._stdio_target(args[0])
+            if args[1] == F_DUPFD_CLOEXEC:
+                self._vfd_cloexec.add(nfd)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
             return False
         if num == SYS["fcntl"] and args[0] in self._stdio_dups:
             if args[1] == F_GETFL:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, O_WRONLY)
-            elif args[1] in (F_GETFD, F_SETFD, F_SETFL):
-                # CLOEXEC bookkeeping is meaningless on a virtual fd; accept
-                # (glibc fdopen(..., "we") sets FD_CLOEXEC right after dup)
+            elif args[1] == F_GETFD:
+                self.ipc.reply(
+                    MSG_SYSCALL_COMPLETE,
+                    1 if args[0] in self._vfd_cloexec else 0,
+                )
+            elif args[1] == F_SETFD:
+                # honored at exec (glibc fdopen(..., "we") sets FD_CLOEXEC
+                # right after dup)
+                if args[2] & 1:
+                    self._vfd_cloexec.add(args[0])
+                else:
+                    self._vfd_cloexec.discard(args[0])
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
+            elif args[1] == F_SETFL:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             else:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)
@@ -1884,11 +1916,31 @@ class NativeProcess:
                 self._vfd_flags[args[0]] = args[2]
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             elif args[1] == F_GETFL:
-                self.ipc.reply(MSG_SYSCALL_COMPLETE, self._vfd_flags.get(args[0], 0))
+                # status flags PLUS the access mode: glibc's fdopen(fd, "w")
+                # validates F_GETFL against the stream mode and fails
+                # EINVAL on a mismatch (git upload-pack died exactly there
+                # when every vfd reported O_RDONLY)
+                self.ipc.reply(
+                    MSG_SYSCALL_COMPLETE,
+                    self._vfd_flags.get(args[0], 0)
+                    | _vfd_access_mode(self._vfds[args[0]]),
+                )
             elif args[1] in (F_DUPFD, F_DUPFD_CLOEXEC):
-                self.ipc.reply(MSG_SYSCALL_COMPLETE, self._dup_vfd(args[0]))
-            elif args[1] in (F_GETFD, F_SETFD):
-                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)  # CLOEXEC bookkeeping
+                nfd = self._dup_vfd(args[0])
+                if args[1] == F_DUPFD_CLOEXEC:
+                    self._vfd_cloexec.add(nfd)
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, nfd)
+            elif args[1] == F_GETFD:
+                self.ipc.reply(
+                    MSG_SYSCALL_COMPLETE,
+                    1 if args[0] in self._vfd_cloexec else 0,
+                )
+            elif args[1] == F_SETFD:
+                if args[2] & 1:  # FD_CLOEXEC
+                    self._vfd_cloexec.add(args[0])
+                else:
+                    self._vfd_cloexec.discard(args[0])
+                self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             else:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, -EINVAL)  # loud
             return False
@@ -2132,6 +2184,9 @@ class NativeProcess:
             if num == SYS["pipe2"] and args[1] & O_NONBLOCK:
                 self._vfd_flags[rfd] = O_NONBLOCK
                 self._vfd_flags[wfd] = O_NONBLOCK
+            if num == SYS["pipe2"] and args[1] & O_CLOEXEC:
+                self._vfd_cloexec.add(rfd)
+                self._vfd_cloexec.add(wfd)
             try:
                 _vm_write(cpid, args[0], struct.pack("<ii", rfd, wfd))
             except OSError:
@@ -2226,6 +2281,12 @@ class NativeProcess:
         if num == SYS["close_range"]:
             CLOSE_RANGE_CLOEXEC = 0x4
             first, last = args[0], min(args[1], 1 << 20)
+            if args[2] & CLOSE_RANGE_CLOEXEC:
+                # CLOEXEC-mark (not close) every emulated fd in range: the
+                # exec drop honors it (systemd/runc-style pre-exec hygiene)
+                for fd in list(self._vfds) + list(self._stdio_dups):
+                    if first <= fd <= last:
+                        self._vfd_cloexec.add(fd)
             if not (args[2] & CLOSE_RANGE_CLOEXEC):
                 self._stdio_overridden -= {
                     f for f in self._stdio_overridden if first <= f <= last
@@ -2859,6 +2920,7 @@ class NativeProcess:
             self._drop_vfd(sock)
         self._stdio_dups.pop(fd, None)
         self._stdio_overridden.discard(fd)
+        self._vfd_cloexec.discard(fd)
 
     def _handle_dup2(self, num: int, args: list[int]) -> bool:
         old, new = args[0], args[1]
@@ -2870,7 +2932,10 @@ class NativeProcess:
                 self.ipc.reply(MSG_SYSCALL_COMPLETE, new)
                 return False
             self._close_virtual(new)
-            self.ipc.reply(MSG_SYSCALL_COMPLETE, self._share_vfd(old, new))
+            self._share_vfd(old, new)
+            if num == SYS["dup3"] and args[2] & O_CLOEXEC:
+                self._vfd_cloexec.add(new)
+            self.ipc.reply(MSG_SYSCALL_COMPLETE, new)
             return False
         tgt = self._stdio_target(old)
         if tgt is not None:
@@ -2879,6 +2944,8 @@ class NativeProcess:
                 return False
             self._close_virtual(new)
             self._stdio_dups[new] = tgt
+            if num == SYS["dup3"] and args[2] & O_CLOEXEC:
+                self._vfd_cloexec.add(new)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, new)
             return False
         # real-file dup2: pass through — but dup2 implicitly closes the
@@ -3153,6 +3220,8 @@ class NativeProcess:
             self._vfds[vfd] = SignalFd(mask)
             if num == SYS["signalfd4"] and args[3] & 0x800:  # SFD_NONBLOCK
                 self._vfd_flags[vfd] = O_NONBLOCK
+            if num == SYS["signalfd4"] and args[3] & O_CLOEXEC:
+                self._vfd_cloexec.add(vfd)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
             return False
         sfd = self._vfds.get(fd)
@@ -3170,6 +3239,8 @@ class NativeProcess:
             self._vfds[vfd] = InotifyFd(self.host)
             if num == S["inotify_init1"] and args[0] & 0x800:  # IN_NONBLOCK
                 self._vfd_flags[vfd] = O_NONBLOCK
+            if num == S["inotify_init1"] and args[0] & O_CLOEXEC:
+                self._vfd_cloexec.add(vfd)
             self.ipc.reply(MSG_SYSCALL_COMPLETE, vfd)
             return False
         ifd = self._vfds.get(args[0])
@@ -3890,7 +3961,7 @@ class NativeProcess:
                             flags = int(
                                 f.read().split("flags:")[1].split()[0], 8
                             )
-                        if flags & 0o2000000:  # O_CLOEXEC: dies at exec
+                        if flags & O_CLOEXEC:  # dies at exec
                             continue
                         g = _pidfd_getfd(pidfd, tgt)
                         hi = fcntl_mod.fcntl(g, fcntl_mod.F_DUPFD, park_base)
@@ -3934,7 +4005,19 @@ class NativeProcess:
         for _, h in fd_map:  # our copies: the child holds its own now
             os.close(h)
         # point of no return: tear down the old native process (threads die
-        # with it, per exec) and swap the new image in
+        # with it, per exec) and swap the new image in. Close-on-exec
+        # EMULATED descriptors drop here (kernel contract; git's
+        # child_process protocol relies on a spawned pack-objects NOT
+        # holding its own pipe's write end — the EOF would never arrive
+        # and both sides deadlock). Only now: a FAILED exec must leave
+        # the old image's fd table untouched.
+        for cfd in sorted(self._vfd_cloexec):
+            if cfd in self._vfds:
+                s = self._vfds.pop(cfd)
+                self._vfd_flags.pop(cfd, None)
+                self._drop_vfd(s)
+            self._stdio_dups.pop(cfd, None)
+        self._vfd_cloexec.clear()
         self._unregister_heap()
         self._clear_wake()
         self.ipc.close()
@@ -3982,12 +4065,17 @@ class NativeProcess:
 
         O_NONBLOCK = 0x800  # == TFD_NONBLOCK == EFD_NONBLOCK
         if num in (S["epoll_create"], S["epoll_create1"]):
-            reply(MSG_SYSCALL_COMPLETE, new_vfd(Epoll()))
+            fd = new_vfd(Epoll())
+            if num == S["epoll_create1"] and args[0] & O_CLOEXEC:
+                self._vfd_cloexec.add(fd)
+            reply(MSG_SYSCALL_COMPLETE, fd)
             return False
         if num == S["timerfd_create"]:
             fd = new_vfd(TimerFd(self.host))
             if args[1] & O_NONBLOCK:
                 self._vfd_flags[fd] = O_NONBLOCK
+            if args[1] & O_CLOEXEC:  # TFD_CLOEXEC
+                self._vfd_cloexec.add(fd)
             reply(MSG_SYSCALL_COMPLETE, fd)
             return False
         if num in (S["eventfd"], S["eventfd2"]):
@@ -3996,6 +4084,8 @@ class NativeProcess:
             fd = new_vfd(EventFd(args[0], bool(flags & EFD_SEMAPHORE)))
             if flags & O_NONBLOCK:
                 self._vfd_flags[fd] = O_NONBLOCK
+            if flags & O_CLOEXEC:  # EFD_CLOEXEC
+                self._vfd_cloexec.add(fd)
             reply(MSG_SYSCALL_COMPLETE, fd)
             return False
 
@@ -4167,6 +4257,8 @@ class NativeProcess:
             self._vfds[fd] = sock
             if typ & SOCK_NONBLOCK:
                 self._vfd_flags[fd] = 0x800
+            if typ & O_CLOEXEC:  # SOCK_CLOEXEC
+                self._vfd_cloexec.add(fd)
             reply(MSG_SYSCALL_COMPLETE, fd)
             return False
 
@@ -4232,6 +4324,8 @@ class NativeProcess:
                 return True
             nfd = self._alloc_vfd()
             self._vfds[nfd] = child
+            if num == S["accept4"] and args[3] & O_CLOEXEC:  # SOCK_CLOEXEC
+                self._vfd_cloexec.add(nfd)
             if num == S["accept4"] and args[3] & SOCK_NONBLOCK:
                 self._vfd_flags[nfd] = 0x800
             _write_sockaddr(
@@ -4480,6 +4574,8 @@ class NativeProcess:
                 return True
             nfd = self._alloc_vfd()
             self._vfds[nfd] = child
+            if num == S["accept4"] and args[3] & O_CLOEXEC:  # SOCK_CLOEXEC
+                self._vfd_cloexec.add(nfd)
             if num == S["accept4"] and args[3] & SOCK_NONBLOCK:
                 self._vfd_flags[nfd] = 0x800
             # unnamed peer address (the kernel reports an empty sun_path)

@@ -53,6 +53,9 @@ def test_pipe_fills_and_blocks():
     assert w.write(b"y") is None  # full
     assert not (w.state & FileState.WRITABLE)
     r.read(4)
+    # pipe(7): POLLOUT requires min(PIPE_BUF, capacity) free, not any byte
+    assert not (w.state & FileState.WRITABLE)
+    r.read(16)  # drained: full capacity free again
     assert w.state & FileState.WRITABLE
 
 

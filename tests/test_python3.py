@@ -259,3 +259,56 @@ def test_bash_pipelines_and_command_substitution():
     assert b"3\n" in flat  # for-loop | wc -l
     assert all(v[1] == 0 for v in tree.values()), tree
     assert once() == (code, out, tree)  # deterministic process tree
+
+
+GIT = "/usr/bin/git"
+
+
+@pytest.mark.skipif(not os.path.exists(GIT), reason="no git in image")
+def test_git_clone_over_simulated_network(tmp_path):
+    """Stock git: `git daemon` serves a repo on one simulated host and
+    `git clone git://...` fetches it on another — by simulated hostname.
+    This exercises the deepest process machinery in one shot: the
+    daemon's double fork, upload-pack spawning pack-objects over
+    CLOEXEC pipes (exec must drop them or the pack stream never sees
+    EOF), fdopen validating F_GETFL access modes, and the pkt-line/
+    sideband protocol over the emulated TCP stack."""
+    import subprocess as sp
+
+    base = tmp_path / "srv"
+    bare = base / "repo.git"
+    bare.mkdir(parents=True)
+    env = {**os.environ, "GIT_AUTHOR_DATE": "2000-01-01T00:00:00",
+           "GIT_COMMITTER_DATE": "2000-01-01T00:00:00"}
+    sp.run([GIT, "init", "-q", "--bare", str(bare)], check=True)
+    work = base / "w"
+    sp.run([GIT, "clone", "-q", str(bare), str(work)], check=True,
+           stderr=sp.DEVNULL)
+    (work / "f.txt").write_text("hello simulated world\n")
+    for cmd in (["config", "user.email", "t@t"], ["config", "user.name", "t"],
+                ["add", "f.txt"], ["commit", "-qm", "init"]):
+        sp.run([GIT, "-C", str(work)] + cmd, check=True, env=env)
+    sp.run([GIT, "-C", str(work), "push", "-q", "origin", "HEAD"],
+           check=True, stderr=sp.DEVNULL)
+
+    def once(i):
+        dst = str(tmp_path / f"clone{i}")
+        hosts, net = two_hosts(seed=13)
+        srv = spawn_native(
+            hosts[0],
+            [GIT, "daemon", "--reuseaddr", "--export-all",
+             f"--base-path={base}", "--port=9418"],
+        )
+        cli = spawn_native(
+            hosts[1], [GIT, "clone", "git://h0/repo.git", dst],
+            start_time=500 * MS,
+        )
+        net.run(20 * SEC)
+        assert cli.exit_code == 0, b"".join(cli.stderr)[-500:]
+        with open(os.path.join(dst, "f.txt")) as f:
+            assert f.read() == "hello simulated world\n"
+        return tuple(h.counters["syscalls"] for h in hosts), tuple(
+            h.counters["pkts_recv"] for h in hosts
+        )
+
+    assert once(0) == once(1)  # byte-deterministic across reruns
