@@ -90,6 +90,47 @@ int main(int argc, char **argv) {
     CHECK(syscall(SYS_close_range, (unsigned)s1, (unsigned)s1 + 10, 0) == 0);
     CHECK(write(s1, "x", 1) == -1);  /* really closed */
 
+    /* FD_CLOEXEC bookkeeping on emulated descriptors: creation flags,
+     * F_SETFD/F_GETFD round trip, dup3(O_CLOEXEC), dup2 clearing it,
+     * close_range(CLOSE_RANGE_CLOEXEC) marking without closing */
+    int pcl[2];
+    CHECK(syscall(SYS_pipe2, pcl, O_CLOEXEC) == 0);
+    CHECK(fcntl(pcl[0], F_GETFD) == FD_CLOEXEC);
+    CHECK(fcntl(pcl[0], F_SETFD, 0) == 0);
+    CHECK(fcntl(pcl[0], F_GETFD) == 0);
+    int d3 = syscall(SYS_dup3, pcl[1], pcl[1] + 7, O_CLOEXEC);
+    CHECK(d3 == pcl[1] + 7 && fcntl(d3, F_GETFD) == FD_CLOEXEC);
+    int d2 = dup(pcl[1]);  /* plain dup: no CLOEXEC */
+    CHECK(fcntl(d2, F_GETFD) == 0);
+    /* dup2 onto a CLOEXEC'd number CLEARS the flag on the target */
+    CHECK(fcntl(d3, F_SETFD, FD_CLOEXEC) == 0);
+    CHECK(dup2(pcl[1], d3) == d3);
+    CHECK(fcntl(d3, F_GETFD) == 0);
+    CHECK(syscall(SYS_close_range, (unsigned)d2, (unsigned)d2,
+                  0x4 /*CLOSE_RANGE_CLOEXEC*/) == 0);
+    CHECK(fcntl(d2, F_GETFD) == FD_CLOEXEC);
+    CHECK(write(d2, "z", 1) == 1);  /* marked, NOT closed */
+    char zb[2];
+    CHECK(read(pcl[0], zb, 1) == 1 && zb[0] == 'z');
+    close(pcl[0]);
+    close(pcl[1]);
+    close(d3);
+    close(d2);
+    /* F_GETFL access modes: glibc fdopen validates them (git's fdopen
+     * died EINVAL when every emulated fd claimed O_RDONLY) */
+    int pm[2];
+    CHECK(syscall(SYS_pipe2, pm, 0) == 0);
+    CHECK((fcntl(pm[0], F_GETFL) & O_ACCMODE) == O_RDONLY);
+    CHECK((fcntl(pm[1], F_GETFL) & O_ACCMODE) == O_WRONLY);
+    FILE *fw = fdopen(pm[1], "w");
+    CHECK(fw != NULL);
+    fputs("via-stdio\n", fw);
+    fflush(fw);
+    char lb[16];
+    CHECK(read(pm[0], lb, 10) == 10 && !memcmp(lb, "via-stdio\n", 10));
+    fclose(fw);
+    close(pm[0]);
+
     printf("misc2 ok\n");
     return 0;
 }
