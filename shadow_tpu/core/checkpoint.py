@@ -17,7 +17,6 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
-import pickle
 
 import jax
 import jax.numpy as jnp
@@ -118,6 +117,64 @@ def load_checkpoint(path: str, sim) -> None:
 
 from shadow_tpu.simtime import TIME_MAX  # noqa: E402
 
+_SEG_FIELDS = ("flags", "seq", "ack", "wnd", "mss", "wscale",
+               "src_port", "dst_port")
+
+
+def _pack_byte_stores(stores) -> tuple[bytes, bytes]:
+    """Flatten `HybridSimulation._bytes` (per-gid {key: (window, NetPacket)})
+    into (JSON index, concatenated payload buffer). NetPacket/Segment are
+    flat int/str/bytes dataclasses, so no object serialization is needed —
+    and none is wanted: pickle here would hand code execution to whoever
+    can write the checkpoint file (the sha256 guard is data, not auth)."""
+    recs, chunks, off = [], [], 0
+
+    def put(b: bytes) -> tuple[int, int]:
+        nonlocal off
+        chunks.append(b)
+        start = off
+        off += len(b)
+        return start, len(b)
+
+    for gid, store in enumerate(stores):
+        for key, (widx, pkt) in store.items():
+            rec = {
+                "gid": gid, "key": key, "w": widx,
+                "sip": pkt.src_ip, "sp": pkt.src_port,
+                "dip": pkt.dst_ip, "dp": pkt.dst_port,
+                "pr": pkt.proto, "pl": put(pkt.payload),
+            }
+            if pkt.seg is not None:
+                rec["seg"] = {f: getattr(pkt.seg, f) for f in _SEG_FIELDS}
+                # pkt.payload mirrors seg.payload for TCP (sockets.py:29-30):
+                # store the bytes once and share the slice on restore
+                rec["segpl"] = (rec["pl"] if pkt.seg.payload == pkt.payload
+                                else put(pkt.seg.payload))
+            recs.append(rec)
+    return json.dumps(recs).encode(), b"".join(chunks)
+
+
+def _unpack_byte_stores(idx_json: bytes, buf: bytes, n_hosts: int):
+    from shadow_tpu.host.sockets import NetPacket
+    from shadow_tpu.tcp.segment import Segment
+
+    stores: list[dict] = [{} for _ in range(n_hosts)]
+    for rec in json.loads(idx_json.decode()):
+        start, length = rec["pl"]
+        payload = buf[start:start + length]
+        seg = None
+        if "seg" in rec:
+            s0, sl = rec["segpl"]
+            segpl = payload if [s0, sl] == rec["pl"] else buf[s0:s0 + sl]
+            seg = Segment(payload=segpl, **rec["seg"])
+        pkt = NetPacket(
+            src_ip=rec["sip"], src_port=rec["sp"],
+            dst_ip=rec["dip"], dst_port=rec["dp"],
+            proto=rec["pr"], payload=payload, seg=seg,
+        )
+        stores[rec["gid"]][rec["key"]] = (rec["w"], pkt)
+    return stores
+
 
 def _hybrid_fingerprint(hsim, treedef) -> str:
     cfgd = dataclasses.asdict(hsim.engine_cfg)
@@ -205,10 +262,12 @@ def save_checkpoint_hybrid(path: str, hsim) -> str:
     )
     # payload byte stores: packets already injected into the device plane
     # carry only (src, key); the bytes must survive the resume or their
-    # eventual capture degrades (echo reconstruction, delivery counters)
-    arrays["__bytes__"] = np.frombuffer(
-        pickle.dumps(hsim._bytes), dtype=np.uint8
-    )
+    # eventual capture degrades (echo reconstruction, delivery counters).
+    # Serialized WITHOUT pickle (a tampered checkpoint must not be able to
+    # execute code on load): flat JSON records + one payload byte buffer.
+    recs_json, payload_buf = _pack_byte_stores(hsim._bytes)
+    arrays["__bytes_idx__"] = np.frombuffer(recs_json, dtype=np.uint8)
+    arrays["__bytes_buf__"] = np.frombuffer(payload_buf, dtype=np.uint8)
     arrays["__send_seq__"] = np.asarray(hsim._send_seq)
     if not path.endswith(".npz"):
         path += ".npz"
@@ -222,6 +281,11 @@ def load_checkpoint_hybrid(path: str, hsim) -> None:
     from shadow_tpu.host.process import ProcState
 
     data = np.load(path, allow_pickle=False)
+    if "__bytes_idx__" not in data.files:
+        raise CheckpointError(
+            "checkpoint uses an older byte-store format; re-create it with "
+            "this version (loading would leave the simulation half-restored)"
+        )
     _, treedef = jax.tree_util.tree_flatten(hsim.state)
     want = _hybrid_fingerprint(hsim, treedef)
     got = bytes(data["__guard__"]).decode()
@@ -239,7 +303,11 @@ def load_checkpoint_hybrid(path: str, hsim) -> None:
     hsim._unreach = bridge["unreach"]
     hsim._model_pkts_unrouted = bridge.get("model_pkts_unrouted", 0)
     hsim._send_seq = np.asarray(data["__send_seq__"]).copy()
-    hsim._bytes = pickle.loads(bytes(data["__bytes__"]))
+    hsim._bytes = _unpack_byte_stores(
+        bytes(data["__bytes_idx__"]),
+        bytes(data["__bytes_buf__"]),
+        len(hsim._bytes),
+    )
     by_name = {h["name"]: h for h in bridge["hosts"]}
     for h in hsim.hosts:
         rec = by_name.get(h.name)

@@ -875,7 +875,16 @@ class InotifyFd:
     watches registered by any process on the same host. write(2) to real
     fds is not hooked (it is pure passthrough); IN_MODIFY therefore fires
     on truncate paths, not on plain writes — documented minimal support
-    (reference has full coverage via its virtual fs layer)."""
+    (reference has full coverage via its virtual fs layer).
+
+    Divergences:
+    - Events are emitted at DISPATCH time, before the native syscall runs,
+      gated only on an existence probe. Operations that fail for reasons
+      the probe cannot see (EACCES, cross-device rename EXDEV, rmdir on a
+      non-empty dir ENOTEMPTY/EBUSY) deliver phantom IN_DELETE/IN_MOVED/
+      IN_CREATE events that real inotify would not; emitting post-success
+      would need a completion hook the one-way dispatch does not have.
+    - write(2)-driven IN_MODIFY is absent, as above."""
 
     def __init__(self, host):
         from shadow_tpu.host.descriptor import File
@@ -3079,8 +3088,12 @@ class NativeProcess:
         fd = args[0]
         if fd in self._vfds or fd in self._stdio_dups:
             if num == SYS["fstatfs"]:
-                # minimal sockfs-shaped statfs for emulated descriptors
-                buf = struct.pack("<16q", SOCKFS_MAGIC, 4096, *([0] * 14))
+                # minimal sockfs-shaped statfs for emulated descriptors.
+                # struct statfs on x86-64 is EXACTLY 120 bytes (15 longs:
+                # f_type f_bsize f_blocks f_bfree f_bavail f_files f_ffree
+                # f_fsid[8B] f_namelen f_frsize f_flags f_spare[4]); packing
+                # 16 would overflow the guest's buffer by 8 bytes.
+                buf = struct.pack("<15q", SOCKFS_MAGIC, 4096, *([0] * 13))
                 try:
                     _vm_write(self._child.pid, args[1], buf)
                 except OSError:
@@ -3156,6 +3169,17 @@ class NativeProcess:
             ent["ex"] = me
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
+        # Conversion semantics per flock(2): converting an existing lock is
+        # NOT atomic — the old lock is removed first, then the new one is
+        # requested, so a failed LOCK_NB conversion LOSES the old lock and
+        # a blocking conversion parks lock-free (which also prevents two SH
+        # holders upgrading concurrently from deadlocking on each other).
+        dropped = ent["ex"] == me or me in ent["sh"]
+        if ent["ex"] == me:
+            ent["ex"] = None
+        ent["sh"].discard(me)
+        if dropped:
+            self._flock_schedule_wake(key)
         if op & LOCK_NB:
             self.ipc.reply(MSG_SYSCALL_COMPLETE, -errno.EWOULDBLOCK)
             return False

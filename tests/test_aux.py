@@ -318,6 +318,37 @@ def test_hybrid_checkpoint_kill_and_resume(tmp_path):
     assert rc["process_failures"] == 0
 
 
+def test_byte_store_serialization_is_pickle_free():
+    """ADVICE r4 medium: the payload byte store must round-trip without
+    pickle (a tampered checkpoint file must never execute code on load).
+    Covers both the plain-UDP and TCP-segment packet shapes."""
+    from shadow_tpu.core.checkpoint import (
+        _pack_byte_stores,
+        _unpack_byte_stores,
+    )
+    from shadow_tpu.host.sockets import NetPacket
+    from shadow_tpu.tcp.segment import ACK, PSH, Segment
+
+    seg = Segment(flags=ACK | PSH, seq=1000, ack=77, wnd=65535,
+                  payload=b"tcp-bytes", mss=1460, wscale=7,
+                  src_port=4000, dst_port=80)
+    stores = [
+        {3: (0, NetPacket("11.0.0.1", 9000, "11.0.0.2", 9001, 17,
+                          b"udp-payload"))},
+        {},
+        {9: (2, NetPacket("11.0.0.2", 4000, "11.0.0.1", 80, 6,
+                          b"tcp-bytes", seg=seg))},
+    ]
+    idx, buf = _pack_byte_stores(stores)
+    assert b"pickle" not in idx  # plain JSON index
+    out = _unpack_byte_stores(idx, buf, 3)
+    assert out[1] == {}
+    w, pkt = out[0][3]
+    assert (w, pkt.payload, pkt.dst_ip) == (0, b"udp-payload", "11.0.0.2")
+    w, pkt = out[2][9]
+    assert w == 2 and pkt.seg == seg and pkt.payload == b"tcp-bytes"
+
+
 def test_hybrid_checkpoint_refuses_live_processes(tmp_path):
     """A hybrid sim with a still-running process refuses to snapshot
     (live coroutine/OS state cannot be serialized) — loud, not silent."""
