@@ -25,6 +25,7 @@ from shadow_tpu.models import phold as _phold  # noqa: F401
 from shadow_tpu.models import echo as _echo  # noqa: F401
 from shadow_tpu.models import gossip as _gossip  # noqa: F401
 from shadow_tpu.models import circuit as _circuit  # noqa: F401
+from shadow_tpu.models import tgen as _tgen  # noqa: F401
 
 __all__ = [
     "HandlerCtx",

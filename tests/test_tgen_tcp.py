@@ -1,0 +1,136 @@
+"""Device-side TCP lanes (tgen_tcp model): handshake, Reno dynamics,
+loss recovery, determinism, and cross-checks against the golden oracle
+(VERDICT r4 missing #1; reference src/test/tgen + src/lib/tcp)."""
+
+from __future__ import annotations
+
+import pytest
+
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+GML = """
+graph [ directed 0
+  node [ id 0 host_bandwidth_down "1 Gbit" host_bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss %s ]
+]"""
+
+
+def _cfg(n=4, stop="30 s", seed=7, loss="0.0", sched="tpu", flows=1,
+         flow_segs=40, extra_args=None, capacity=64, budget=24):
+    args = {"flow_segs": flow_segs, "flows": flows, "cwnd_cap": 8,
+            "rto_min": "100 ms"}
+    if extra_args:
+        args.update(extra_args)
+    return ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": stop, "seed": seed},
+            "network": {"graph": {"type": "gml", "inline": GML % loss}},
+            "experimental": {
+                "scheduler": sched,
+                "event_queue_capacity": capacity,
+                "sends_per_host_round": budget,
+            },
+            "hosts": {
+                "peer": {
+                    "count": n,
+                    "network_node_id": 0,
+                    "processes": [{"model": "tgen_tcp", "model_args": args}],
+                }
+            },
+        }
+    )
+
+
+def test_lossless_transfer_no_retransmits():
+    """Clean network: every flow completes, exactly flow_segs first
+    transmissions per flow, zero retransmits/timeouts (the analogue of the
+    reference tgen fixed_size test's byte-count assertion)."""
+    sim = Simulation(_cfg(), world=1)
+    r = sim.run(progress=False)
+    m = r["model_report"]
+    assert m["flows_completed"] == m["flows_expected"] == 4
+    assert m["data_segments_sent"] == 4 * 40
+    assert m["retransmits"] == 0
+    assert m["timeouts"] == 0
+    assert m["payload_bytes_received"] == 4 * 40 * 1460
+    # closed-form Reno cross-check (the scalar-analysis analogue of diffing
+    # against the CPU-plane machine): SYN+SYNACK = 1 RTT, then slow start
+    # from cwnd_init=2 capped at cwnd_cap=8 sends 2,4,8,8,8,8,2 segments
+    # = 40 over 7 RTTs (the last window's ACK completes in the 6th), FIN +
+    # FINACK = 1 more; total = 9 RTT = 180 ms at 20 ms RTT, zero queueing
+    assert m["mean_fct_ms"] == pytest.approx(180.0, abs=2.0)
+
+
+def test_lossy_transfer_recovers_and_completes():
+    """5% loss: flows still complete; recovery happens via retransmits
+    (fast retransmit and/or RTO), and the receiver saw every segment."""
+    sim = Simulation(_cfg(loss="0.05", stop="120 s", seed=3), world=1)
+    r = sim.run(progress=False)
+    m = r["model_report"]
+    assert m["flows_completed"] == m["flows_expected"] == 4
+    assert m["retransmits"] > 0
+    assert m["payload_bytes_received"] == 4 * 40 * 1460
+    assert r["packets_lost"] > 0
+
+
+def test_fast_retransmit_under_light_loss():
+    """At light loss with a wide-enough window, some recoveries must be
+    dup-ACK-driven (fast retransmit), not all timeouts."""
+    sim = Simulation(
+        _cfg(loss="0.02", stop="240 s", seed=11, n=6, flow_segs=200,
+             extra_args={"cwnd_cap": 16}, budget=40),
+        world=1,
+    )
+    r = sim.run(progress=False)
+    m = r["model_report"]
+    assert m["flows_completed"] == 6
+    assert m["fast_retransmits"] > 0
+
+
+def test_matches_golden_oracle():
+    dev = Simulation(_cfg(seed=5, loss="0.03", stop="60 s"), world=1).run(
+        progress=False
+    )
+    gold = Simulation(
+        _cfg(seed=5, loss="0.03", stop="60 s", sched="cpu-reference"),
+        world=1,
+    ).run(progress=False)
+    assert dev["determinism_digest"] == gold["determinism_digest"]
+    assert dev["model_report"] == gold["model_report"]
+
+
+def test_mesh_invariant_under_loss():
+    a = Simulation(_cfg(n=8, seed=9, loss="0.03", stop="60 s"), world=1).run(
+        progress=False
+    )
+    b = Simulation(_cfg(n=8, seed=9, loss="0.03", stop="60 s"), world=8).run(
+        progress=False
+    )
+    assert a["determinism_digest"] == b["determinism_digest"]
+    assert a["model_report"] == b["model_report"]
+
+
+def test_all_to_all_phases():
+    """flows = n-1 gives the full all-to-all: every host both sends to and
+    serves every other host exactly once."""
+    n = 4
+    sim = Simulation(
+        _cfg(n=n, flows=n - 1, flow_segs=12, stop="120 s"), world=1
+    )
+    r = sim.run(progress=False)
+    m = r["model_report"]
+    assert m["flows_completed"] == n * (n - 1)
+    assert m["data_segments_sent"] == n * (n - 1) * 12
+    assert m["payload_bytes_received"] == n * (n - 1) * 12 * 1460
+
+
+def test_reruns_bit_identical():
+    a = Simulation(_cfg(seed=2, loss="0.04"), world=1).run(progress=False)
+    b = Simulation(_cfg(seed=2, loss="0.04"), world=1).run(progress=False)
+    assert a["determinism_digest"] == b["determinism_digest"]
+
+
+def test_needs_two_hosts():
+    with pytest.raises(Exception, match="at least 2"):
+        Simulation(_cfg(n=1), world=1)
