@@ -278,7 +278,11 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
                     "model_args": {"flows": 8 if small else 64,
                                    "flow_segs": 20 if small else 100,
                                    "cwnd_cap": 16, "mss": 1460,
-                                   "flow_gap": "50 ms"},
+                                   "flow_gap": "50 ms",
+                                   # scanned 1/2/3/4/8 on v5e: 2 is the
+                                   # sweet spot between TX-event count and
+                                   # per-segment engine work
+                                   "tx_batch": 2},
                 }],
             }
             for i in range(side * side)

@@ -72,7 +72,7 @@ from shadow_tpu.ops import (
     next_time,
     pack_order,
     pop_min,
-    push_one,
+    push_many,
 )
 from shadow_tpu.ops.events import unpack_order_src
 from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
@@ -365,6 +365,49 @@ def _outbox_append(ob: Outbox, mask, col, dst, t, order, kind, payload):
     return new, n_lost
 
 
+def _outbox_append_multi(ob: Outbox, entries):
+    """Apply ALL of a microstep's outbox appends in one slab pass.
+
+    `entries` is a list of (mask, col, dst, t, order, kind, payload) with
+    per-host [H] arrays; columns are cursor-assigned upstream so at most one
+    entry targets any (host, col). Applying them as a chained one-hot write
+    (no reductions interleaved between the [H, B] selects) lets XLA fuse the
+    whole append into a single read+write of the outbox — the per-port
+    `_outbox_append` chain materialized the full slab once per port, which
+    was the measured cost of multi-port TCP bursts. Overflow (`col >= B`) is
+    counted, never silent, exactly as in `_outbox_append`."""
+    b = ob.t.shape[1]
+    h = ob.t.shape[0]
+    cols = jnp.arange(b, dtype=jnp.int32)[None, :]
+    dst_n, t_n, order_n = ob.dst, ob.t, ob.order
+    kind_n, payload_n = ob.kind, ob.payload
+    # reductions are accumulated ELEMENTWISE in the loop and summed once at
+    # the end: a jnp.sum between the one-hot selects is a fusion fence that
+    # re-materializes the whole [H, B] slab per entry (measured: 8-entry
+    # bursts ran ~25% slower with in-loop sums)
+    lost_acc = jnp.zeros((h,), jnp.int64)
+    total_acc = jnp.zeros((h,), jnp.int32)
+    for mask, col, dst, t, order, kind, payload in entries:
+        oh = mask[:, None] & (cols == col[:, None])
+        dst_n = jnp.where(oh, dst.astype(jnp.int32)[:, None], dst_n)
+        t_n = jnp.where(oh, t[:, None], t_n)
+        order_n = jnp.where(oh, order[:, None], order_n)
+        kind_n = jnp.where(oh, kind.astype(jnp.int32)[:, None], kind_n)
+        payload_n = jnp.where(
+            oh[:, :, None], jnp.asarray(payload, jnp.int32)[:, None, :],
+            payload_n,
+        )
+        lost_acc = lost_acc + (mask & (col >= b))
+        total_acc = total_acc + mask
+    return (
+        Outbox(
+            dst=dst_n, t=t_n, order=order_n, kind=kind_n, payload=payload_n,
+            count=ob.count + jnp.sum(total_acc, dtype=jnp.int32)[None],
+        ),
+        jnp.sum(lost_acc, dtype=jnp.int64),
+    )
+
+
 class Engine:
     """Builds and runs the jitted round loop for a fixed (config, model).
 
@@ -499,7 +542,11 @@ class Engine:
         # rows cost H x N x 20 bytes of HBM and the reduction reads them
         # per send: cap the product (beyond it the 2-D gather path is the
         # lesser evil — e.g. 100k hosts on a 2k-node graph)
-        rows_ok = cfg.num_hosts * n_nodes <= 32 << 20
+        import os as _os  # experiment gate, see BASELINE.md routing notes
+
+        rows_ok = cfg.num_hosts * n_nodes <= 32 << 20 and not _os.environ.get(
+            "SHADOW_TPU_FORCE_GATHER_ROUTING"
+        )
         if params.lat_ns.shape != (1, 1) and rows_ok and params.lat_rows is None:
             # materialize the per-host routing rows (see EngineParams)
             with host_build_context():
@@ -761,24 +808,22 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             needs_ingress & ~codel_drop,
         )
         delay = needs_ingress & ~codel_drop & (depart > ev.t)
-        # the requeue only fires when a downlink bucket is actually exhausted
-        # (rare at sane rates); cond-skip the full-queue pass. The predicate
-        # is shard-local and the branch has no collectives, so this is safe
-        # under shard_map.
-        queue = lax.cond(
-            jnp.any(delay),
-            lambda q: push_one(
-                q, delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE, ev.payload
-            ),
-            lambda q: q,
-            queue,
-        )
+        # the requeue (bucket-delayed packet goes back in the queue past
+        # shaping) is deferred into the fused push pass below. It used to
+        # be a lax.cond-guarded push_one "optimization" — the profiler
+        # showed the conditional itself costing ~40% of the microstep at
+        # 10k hosts x capacity 64: an XLA cond is a fusion barrier that
+        # copies the full queue slab at the branch boundary every
+        # microstep, far more than the one-hot write it was skipping.
+        requeue = (delay, depart, ev.order, ev.kind | KIND_INGRESS_DONE,
+                   ev.payload)
         stats = stats._replace(
             pkts_codel_dropped=stats.pkts_codel_dropped + codel_drop
         )
         dispatch = active & ~(needs_ingress & (codel_drop | delay))
     else:
         codel, tb_in = st.codel, st.tb_ingress
+        requeue = None
         dispatch = active
 
     # ---- model dispatch (Host::execute -> TaskRef::execute / packet receive)
@@ -804,7 +849,13 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     outbox = st.outbox
     ob_lost = jnp.zeros((), jnp.int64)
 
-    # ---- local pushes (schedule_task_* analogue)
+    # ---- local pushes (schedule_task_* analogue). All ports are applied
+    # in ONE slab pass (push_many): sequential push_one calls each pay a
+    # full [H, C] read+write because the free-slot reduction between them
+    # fences XLA fusion — measured as a dominant per-microstep cost.
+    # the ingress requeue goes FIRST so slot-assignment order matches the
+    # golden oracle (its qpush runs during ingress, before model pushes)
+    push_list = [requeue] if requeue is not None else []
     for p in out.pushes:
         mask = p.mask & dispatch
         t_req = jnp.asarray(p.t, jnp.int64)
@@ -814,33 +865,35 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         t_push = jnp.maximum(t_req, ev.t)
         order = pack_order(1, host_gid, seq)
         seq = seq + mask
-        queue = push_one(
-            queue, mask, t_push, order, jnp.asarray(p.kind, jnp.int32) & KIND_MASK,
-            p.payload,
-        )
+        push_list.append((
+            mask, t_push, order,
+            jnp.asarray(p.kind, jnp.int32) & KIND_MASK, p.payload,
+        ))
+    if push_list:
+        queue = push_many(queue, push_list)
 
-    # ---- sends: egress pipeline (worker.rs:330-425 send_packet)
+    # ---- sends: egress pipeline (worker.rs:330-425 send_packet). Each
+    # port may carry a BURST (PacketSend.count/count_max): up to count_max
+    # packets to one destination, sharing the routing lookup (the H x N
+    # table reduction is the per-port cost that made one-packet-per-port
+    # TCP windows unaffordable) while each segment keeps its own loss
+    # draw, bandwidth charge, order key, and budget slot. Outbox writes
+    # are deferred and applied in one slab pass after the loop.
+    entries = []  # (send_ok, col, dst, arrive, order, kind, payload)
+    used_lats = []
     for s in out.sends:
-        mask = s.mask & dispatch
-        sz = jnp.asarray(s.size_bytes, jnp.int32)
-        # per-host round budget: the drop decision is a function of this
-        # host's own sends only, so it cannot vary with mesh shape. Decided
-        # BEFORE the bandwidth charge: a budget-dropped packet must be
-        # side-effect-free (no debited bits, no borrowed refill intervals).
-        over_budget = sent_round >= cfg.sends_per_host_round
-        if cfg.shaping:
-            tb_eg, eg_depart = tb_conforming_remove(
-                tb_eg,
-                params.eg_tb,
-                cfg.tb_interval_ns,
-                ev.t,
-                sz.astype(jnp.int64) * 8,
-                mask & ~over_budget,
-            )
+        cmax = int(getattr(s, "count_max", 1) or 1)
+        mask0 = s.mask & dispatch
+        # gate on count is None (the documented contract, mirrored by the
+        # golden oracle) — NOT on count_max: count=None with count_max>1 is
+        # legal, and an explicit count of 0 must suppress the send
+        if getattr(s, "count", None) is None:
+            counts = mask0.astype(jnp.int32)
         else:
-            eg_depart = ev.t  # unlimited uplink: no serialization delay
+            counts = jnp.where(mask0, jnp.asarray(s.count, jnp.int32), 0)
+        sz = jnp.asarray(s.size_bytes, jnp.int32)
         dst_raw = jnp.asarray(s.dst, jnp.int64)
-        bad_dst = mask & ((dst_raw < 0) | (dst_raw >= cfg.num_hosts))
+        bad_dst = mask0 & ((dst_raw < 0) | (dst_raw >= cfg.num_hosts))
         dst = jnp.clip(dst_raw, 0, cfg.num_hosts - 1)  # safe gather only
         if params.lat_ns.shape == (1, 1):
             # single graph node (e.g. the 1-gbit-switch topology): the path
@@ -868,52 +921,78 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             lat = params.lat_ns[src_node, dst_node]
             lossp = params.loss[src_node, dst_node]
             jit = params.jitter_ns[src_node, dst_node]
-        lat_bound = lat  # pre-jitter: the conservative lookahead quantity
+        lat_bound0 = lat  # pre-jitter: the conservative lookahead quantity
         if cfg.use_jitter:
-            # uniform in [lat - j, lat + j] (deterministic per-host lane
-            # draw); the lookahead bound uses lat - j
-            rng, uj = rng_uniform(rng, mask)
-            lat = lat + ((uj * 2.0 - 1.0) * jit.astype(jnp.float32)).astype(
-                jnp.int64
+            lat_bound0 = lat_bound0 - jit
+        port_kind = jnp.asarray(s.kind, jnp.int32) | KIND_PKT
+        for j in range(cmax):
+            mask = mask0 & (counts > j)
+            # per-host round budget: the drop decision is a function of
+            # this host's own sends only, so it cannot vary with mesh
+            # shape. Decided BEFORE the bandwidth charge: a budget-dropped
+            # packet must be side-effect-free (no debited bits, no
+            # borrowed refill intervals).
+            over_budget = sent_round >= cfg.sends_per_host_round
+            if cfg.shaping:
+                tb_eg, eg_depart = tb_conforming_remove(
+                    tb_eg,
+                    params.eg_tb,
+                    cfg.tb_interval_ns,
+                    ev.t,
+                    sz.astype(jnp.int64) * 8,
+                    mask & ~over_budget,
+                )
+            else:
+                eg_depart = ev.t  # unlimited uplink: no serialization delay
+            lat_j = lat
+            if cfg.use_jitter:
+                # uniform in [lat - j, lat + j] (deterministic per-host
+                # lane draw, one per segment); the lookahead bound uses
+                # lat - j
+                rng, uj = rng_uniform(rng, mask)
+                lat_j = lat + (
+                    (uj * 2.0 - 1.0) * jit.astype(jnp.float32)
+                ).astype(jnp.int64)
+            # a model emitting an out-of-range dst is a bug: surface it as
+            # unreachable rather than silently delivering to a clamped
+            # host. Uses the PRE-jitter bound so the predicate is
+            # independent of the jitter draw (float32 jitter math could
+            # otherwise flip the sign for amplitudes >= 2^24 ns, diverging
+            # from golden which tests lat_bound)
+            unreachable = mask & ((lat_bound0 < 0) | bad_dst)
+            rng, u = rng_uniform(rng, mask)
+            lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
+            send_ok = mask & ~lost & ~unreachable & ~over_budget
+            budget_dropped = mask & ~lost & ~unreachable & over_budget
+            ob_col = sent_round  # lane column (cursor pre-increment)
+            sent_round = sent_round + send_ok.astype(jnp.int32)
+            # conservative-PDES clamp (worker.rs:411-414): never before
+            # round end
+            arrive = jnp.maximum(eg_depart + jnp.maximum(lat_j, 0), window_end)
+            order = pack_order(0, host_gid, seq)
+            seq = seq + mask
+            payload = s.payload
+            if j > 0 and s.payload_inc is not None:
+                payload = payload + j * jnp.asarray(s.payload_inc, jnp.int32)
+            payload = payload.at[:, PAYLOAD_SIZE_WORD].set(sz)
+            entries.append(
+                (send_ok, ob_col, dst, arrive, order, port_kind, payload)
             )
-            lat_bound = lat_bound - jit
-        # a model emitting an out-of-range dst is a bug: surface it as
-        # unreachable rather than silently delivering to a clamped host.
-        # Uses the PRE-jitter bound so the predicate is independent of the
-        # jitter draw (float32 jitter math could otherwise flip the sign for
-        # amplitudes >= 2^24 ns, diverging from golden which tests lat_bound)
-        unreachable = mask & ((lat_bound < 0) | bad_dst)
-        rng, u = rng_uniform(rng, mask)
-        lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
-        send_ok = mask & ~lost & ~unreachable & ~over_budget
-        budget_dropped = mask & ~lost & ~unreachable & over_budget
-        ob_col = sent_round  # lane column for this send (cursor pre-increment)
-        sent_round = sent_round + send_ok.astype(jnp.int32)
-        # conservative-PDES clamp (worker.rs:411-414): never before round end
-        arrive = jnp.maximum(eg_depart + jnp.maximum(lat, 0), window_end)
-        order = pack_order(0, host_gid, seq)
-        seq = seq + mask
-        payload = s.payload.at[:, PAYLOAD_SIZE_WORD].set(sz)
-        outbox, n_lost = _outbox_append(
-            outbox,
-            send_ok,
-            ob_col,
-            dst,
-            arrive,
-            order,
-            jnp.asarray(s.kind, jnp.int32) | KIND_PKT,
-            payload,
-        )
+            used_lats.append(jnp.where(send_ok, lat_bound0, TIME_MAX))
+            stats = stats._replace(
+                pkts_sent=stats.pkts_sent + mask,
+                pkts_lost=stats.pkts_lost + lost,
+                pkts_unreachable=stats.pkts_unreachable + unreachable,
+                pkts_budget_dropped=stats.pkts_budget_dropped + budget_dropped,
+            )
+    if entries:
+        outbox, n_lost = _outbox_append_multi(outbox, entries)
         ob_lost = ob_lost + n_lost
-        used_lat = jnp.where(send_ok, lat_bound, TIME_MAX)
         st = st._replace(
-            min_used_lat=jnp.minimum(st.min_used_lat, jnp.min(used_lat))
-        )
-        stats = stats._replace(
-            pkts_sent=stats.pkts_sent + mask,
-            pkts_lost=stats.pkts_lost + lost,
-            pkts_unreachable=stats.pkts_unreachable + unreachable,
-            pkts_budget_dropped=stats.pkts_budget_dropped + budget_dropped,
+            min_used_lat=jnp.minimum(
+                st.min_used_lat,
+                jnp.min(jnp.stack([jnp.min(u) for u in used_lats])),
+            )
         )
 
     stats = stats._replace(ob_dropped=stats.ob_dropped + ob_lost[None])
@@ -945,26 +1024,62 @@ def _exchange(cfg, axis, st: SimState):
         lax.axis_index(axis).astype(jnp.int32) * h_local if axis else jnp.int32(0)
     )
 
-    def do_merge(queue):
-        # flatten the [H, B] lanes host-major: entry order (and therefore
-        # cheap-shed overflow selection) is identical for every mesh shape
-        dst_f = g.dst.reshape(-1)
-        t_f = g.t.reshape(-1)
-        local = dst_f - shard_start
-        valid = (t_f != TIME_MAX) & (local >= 0) & (local < h_local)
-        return merge_flat_events(
-            queue, local, t_f, g.order.reshape(-1), g.kind.reshape(-1),
-            g.payload.reshape(-1, g.payload.shape[-1]), valid,
-            cfg.max_round_inserts, shed_urgency=not cfg.cheap_shed,
-        )
-
+    # flatten the [H, B] lanes host-major: entry order (and therefore
+    # cheap-shed overflow selection) is identical for every mesh shape
+    dst_f = g.dst.reshape(-1)
+    t_f = g.t.reshape(-1)
+    local = dst_f - shard_start
+    valid = (t_f != TIME_MAX) & (local >= 0) & (local < h_local)
+    flat = (
+        local, t_f, g.order.reshape(-1), g.kind.reshape(-1),
+        g.payload.reshape(-1, g.payload.shape[-1]), valid,
+    )
+    has_sends = jnp.sum(g.count) > 0
     # the merge's sort dominates round cost; rounds where NO shard sent
     # anything (timer-heavy workloads, drained phases) skip it entirely.
     # g.count is identical on all shards post-gather, so the branch is
-    # uniform across the mesh.
-    queue = lax.cond(
-        jnp.sum(g.count) > 0, do_merge, lambda queue: queue, st.queue
-    )
+    # uniform across the mesh. The cond wraps only the PLAN (sort +
+    # gathers): branches returning the whole queue forced XLA to copy
+    # every slab at the branch boundary each round — traced at ~55% of
+    # the PHOLD-torus round cost — while the plan is one packed [H, C, W]
+    # block and the apply runs unconditionally as a single where-pass.
+    if jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
+        # Fused merge inside the cond. On CPU the scatter path is faster
+        # and branch copies are cheap. On TPU this wins at SMALL slab
+        # capacities (measured: PHOLD-torus cap 16 ran 40% slower with the
+        # plan split — the [H, C, W] plan materialization costs more than
+        # the small branch-boundary copies it avoids; at cap >= ~48 the
+        # copy volume dominates and the split below wins).
+        queue = lax.cond(
+            has_sends,
+            lambda queue: merge_flat_events(
+                queue, *flat, cfg.max_round_inserts,
+                shed_urgency=not cfg.cheap_shed,
+            ),
+            lambda queue: queue,
+            st.queue,
+        )
+    else:
+        from shadow_tpu.ops.merge import (
+            merge_apply,
+            merge_empty_plan,
+            merge_plan,
+        )
+
+        p_words = g.payload.shape[-1]
+        # the cond consumes ONLY the time plane (free-slot source): feeding
+        # the whole queue through would add a second consumer per slab and
+        # reintroduce the branch-boundary copies this split removes
+        take, gw, dropped_add = lax.cond(
+            has_sends,
+            lambda q_t: merge_plan(
+                q_t, *flat, cfg.max_round_inserts,
+                shed_urgency=not cfg.cheap_shed,
+            ),
+            lambda q_t: merge_empty_plan(q_t, p_words),
+            st.queue.t,
+        )
+        queue = merge_apply(st.queue, take, gw, dropped_add)
     fresh = Outbox(
         dst=jnp.zeros_like(ob.dst),
         t=jnp.full_like(ob.t, TIME_MAX),

@@ -330,55 +330,71 @@ def run_golden(
                     seq[i] += 1
 
             for s in out.sends:
-                mask = np.asarray(s.mask) & dispatch
-                if cfg.use_jitter:
-                    # device draws jitter BEFORE the loss draw: same order
-                    rng, uj_arr = rng_uniform(rng, jnp.asarray(mask))
-                    uj = np.asarray(uj_arr, np.float32)
-                rng, u_arr = rng_uniform(rng, jnp.asarray(mask))
-                u = np.asarray(u_arr)
+                cmax = int(getattr(s, "count_max", 1) or 1)
+                mask0 = np.asarray(s.mask) & dispatch
+                if getattr(s, "count", None) is not None:
+                    counts = np.where(mask0, np.asarray(s.count, np.int32), 0)
+                else:
+                    counts = mask0.astype(np.int32)
+                pinc = (
+                    np.asarray(s.payload_inc, np.int32)
+                    if getattr(s, "payload_inc", None) is not None
+                    else None
+                )
                 dst_arr = np.asarray(s.dst, np.int64)
                 sz_arr = np.asarray(s.size_bytes, np.int32)
                 kind = np.asarray(s.kind, np.int32)
-                payload = np.asarray(s.payload, np.int32)
-                for i in np.nonzero(mask)[0]:
-                    st["pkts_sent"][i] += 1
-                    order = _pack(0, i, seq[i])
-                    seq[i] += 1
-                    over_budget = sent_round[i] >= cfg.sends_per_host_round
-                    t = int(ev_t[i])
-                    size_bits = int(sz_arr[i]) * 8
-                    if not over_budget:
-                        eg_depart = eg[i].charge(t, size_bits)
-                    dst = int(dst_arr[i])
-                    bad = dst < 0 or dst >= h
-                    dn = node_of[min(max(dst, 0), h - 1)]
-                    lat = int(lat_ns[node_of[i], dn])
-                    lossp = float(loss[node_of[i], dn])
-                    lat_bound = lat
+                payload0 = np.asarray(s.payload, np.int32)
+                for seg_j in range(cmax):
+                    mask = mask0 & (counts > seg_j)
+                    payload = payload0 if seg_j == 0 or pinc is None else (
+                        payload0 + seg_j * pinc
+                    )
                     if cfg.use_jitter:
-                        jit = int(jitter_ns[node_of[i], dn])
-                        # identical float math to the device path
-                        lat = lat + int(np.int64(
-                            np.float32(uj[i] * np.float32(2.0) - np.float32(1.0))
-                            * np.float32(jit)
-                        ))
-                        lat_bound = lat_bound - jit
-                    if lat_bound < 0 or bad:
-                        st["pkts_unreachable"][i] += 1
-                        continue
-                    if u[i] < lossp and t >= cfg.bootstrap_end_time:
-                        st["pkts_lost"][i] += 1
-                        continue
-                    if over_budget:
-                        st["pkts_budget_dropped"][i] += 1
-                        continue
-                    sent_round[i] += 1
-                    min_used_lat = min(min_used_lat, lat_bound)
-                    pl = payload[i].copy()
-                    pl[PAYLOAD_SIZE_WORD] = sz_arr[i]
-                    arrive = max(eg_depart + max(lat, 0), window_end)
-                    staged.append((dst, arrive, order, int(kind[i]) | KIND_PKT, pl))
+                        # device draws jitter BEFORE the loss draw per
+                        # segment: same order
+                        rng, uj_arr = rng_uniform(rng, jnp.asarray(mask))
+                        uj = np.asarray(uj_arr, np.float32)
+                    rng, u_arr = rng_uniform(rng, jnp.asarray(mask))
+                    u = np.asarray(u_arr)
+                    for i in np.nonzero(mask)[0]:
+                        st["pkts_sent"][i] += 1
+                        order = _pack(0, i, seq[i])
+                        seq[i] += 1
+                        over_budget = sent_round[i] >= cfg.sends_per_host_round
+                        t = int(ev_t[i])
+                        size_bits = int(sz_arr[i]) * 8
+                        if not over_budget:
+                            eg_depart = eg[i].charge(t, size_bits)
+                        dst = int(dst_arr[i])
+                        bad = dst < 0 or dst >= h
+                        dn = node_of[min(max(dst, 0), h - 1)]
+                        lat = int(lat_ns[node_of[i], dn])
+                        lossp = float(loss[node_of[i], dn])
+                        lat_bound = lat
+                        if cfg.use_jitter:
+                            jit = int(jitter_ns[node_of[i], dn])
+                            # identical float math to the device path
+                            lat = lat + int(np.int64(
+                                np.float32(uj[i] * np.float32(2.0) - np.float32(1.0))
+                                * np.float32(jit)
+                            ))
+                            lat_bound = lat_bound - jit
+                        if lat_bound < 0 or bad:
+                            st["pkts_unreachable"][i] += 1
+                            continue
+                        if u[i] < lossp and t >= cfg.bootstrap_end_time:
+                            st["pkts_lost"][i] += 1
+                            continue
+                        if over_budget:
+                            st["pkts_budget_dropped"][i] += 1
+                            continue
+                        sent_round[i] += 1
+                        min_used_lat = min(min_used_lat, lat_bound)
+                        pl = payload[i].copy()
+                        pl[PAYLOAD_SIZE_WORD] = sz_arr[i]
+                        arrive = max(eg_depart + max(lat, 0), window_end)
+                        staged.append((dst, arrive, order, int(kind[i]) | KIND_PKT, pl))
 
         microsteps += steps
         rounds += 1

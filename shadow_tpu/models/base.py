@@ -69,13 +69,23 @@ class LocalPush(NamedTuple):
 
 class PacketSend(NamedTuple):
     """Send a packet to a (possibly remote) host — enters the egress pipeline:
-    token bucket → latency/loss → round-barrier exchange (worker.rs:330-425)."""
+    token bucket → latency/loss → round-barrier exchange (worker.rs:330-425).
+
+    Burst sends (count_max > 1): one port emits up to `count_max` back-to-back
+    packets to the SAME destination in a single microstep — segment k of the
+    burst (k < count[h]) carries payload + k * payload_inc and its own loss
+    draw, bandwidth charge, and order key. The destination lookup runs once
+    per port instead of once per packet, which is what makes a TCP window
+    burst affordable on device (the routing reduction reads H x N tables)."""
 
     mask: Array  # bool[H]
     dst: Array  # i64[H] global destination host id
     size_bytes: Array  # i32[H]
     kind: Array  # i32[H] model kind dispatched at the destination
     payload: Array  # i32[H, P] (word 0 overwritten with size_bytes)
+    count: Any = None  # i32[H] burst length (None -> mask as 0/1)
+    payload_inc: Any = None  # i32[H, P] per-segment payload increment
+    count_max: int = 1  # static burst width (trace-time)
 
 
 class HandlerOut(NamedTuple):

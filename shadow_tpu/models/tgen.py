@@ -32,9 +32,16 @@ Deliberate divergences from the byte-exact CPU-plane machine
     i32 and the reassembly window is one u32 bitmap; flow sizes round up
     to whole segments. Wire sizes still account mss+40 bytes per DATA
     segment so bandwidth shaping and pcap sizing stay byte-faithful.
-  - no delayed ACK / Nagle on device lanes (every DATA segment is acked
-    immediately); those live in the CPU-plane machine where real-binary
-    interop needs them.
+  - delayed ACK follows RFC 1122's "at least every second full-sized
+    segment" with a lazy timer lane (`delack`, default 40 ms; 0 disables);
+    out-of-order and duplicate segments are always acked immediately so
+    dup-ACK-driven fast retransmit keeps its timing. Nagle is senseless at
+    segment granularity (every send is a full MSS) and lives only in the
+    CPU-plane machine.
+  - a TX continuation transmits up to `tx_batch` segments per microstep
+    (one engine send port each) instead of one: pure event-count economy —
+    the wire result is identical because all of a window's sends depart
+    within the same round anyway.
   - cwnd is capped by `cwnd_cap` (standing in for the peer's advertised
     window); the engine's per-round send budget must exceed
     cwnd_cap + a few control packets or budget drops act as extra loss.
@@ -68,8 +75,9 @@ from shadow_tpu.simtime import TIME_MAX
 
 KIND_TICK = 0  # client: start the next flow
 KIND_SEG = 1  # wire segment (ftype in the meta word)
-KIND_TX = 2  # client: transmit continuation (one DATA per microstep)
+KIND_TX = 2  # client: transmit continuation (up to tx_batch DATA per step)
 KIND_RTO = 3  # client: retransmission timer lane
+KIND_DELACK = 4  # server: delayed-ACK timer lane
 
 # segment types (meta word low byte)
 FT_SYN = 1
@@ -152,8 +160,17 @@ class TgenTcpModel:
             "flow_gap": jnp.asarray(
                 [tns(hh, "flow_gap", "10 ms") for hh in hosts], np.int64
             ),
+            "delack": jnp.asarray(
+                [tns(hh, "delack", "40 ms") for hh in hosts], np.int64
+            ),
             "num_hosts": jnp.full((h,), h, jnp.int32),
         }
+        # static trace-time knob: segments transmitted per TX continuation
+        # (= engine send ports). Event-count economy vs per-microstep port
+        # cost; 4 measured best at the 10k-host bench point.
+        self.tx_batch = max(
+            max(int(arg(hh, "tx_batch", 4)) for hh in hosts), 1
+        )
 
         def zi32():
             return jnp.zeros((h,), jnp.int32)
@@ -187,6 +204,9 @@ class TgenTcpModel:
             "sv_phase": zi32(),
             "rcv_nxt": zi32(),
             "sv_bm": jnp.zeros((h,), jnp.uint32),
+            "da_pend": jnp.zeros((h,), bool),  # delayed ACK held
+            "da_t": jnp.full((h,), TIME_MAX, jnp.int64),
+            "da_alive": jnp.zeros((h,), bool),  # DELACK timer event queued
             # counters
             "d_sent": zi64(),
             "d_rtx": zi64(),
@@ -196,6 +216,7 @@ class TgenTcpModel:
             "fct_sum": zi64(),
             "segs_rcvd": zi64(),
             "dup_segs": zi64(),
+            "bytes_rcvd": zi64(),
             "done_t": zi64(),
         }
         # clients with work kick off at their start time
@@ -218,6 +239,7 @@ class TgenTcpModel:
         seg = ctx.active & ctx.is_packet & (ctx.kind == KIND_SEG)
         tx = ctx.active & ~ctx.is_packet & (ctx.kind == KIND_TX)
         rto_ev = ctx.active & ~ctx.is_packet & (ctx.kind == KIND_RTO)
+        da_ev = ctx.active & ~ctx.is_packet & (ctx.kind == KIND_DELACK)
 
         meta = ctx.payload[:, PW_META]
         ftype = meta & 0xFF
@@ -262,8 +284,6 @@ class TgenTcpModel:
             jnp.where(shift >= 32, jnp.uint32(0), bm_set >> shift),
             bm_set,
         )
-        ack_out = data_ok  # immediate ACK (incl. dup ACKs for ooo/dup segs)
-
         # FIN: accept when the full flow is in order; a re-FIN after the
         # server already closed (our FIN-ACK was lost) answers statelessly.
         fin_acc = (
@@ -272,6 +292,31 @@ class TgenTcpModel:
         )
         fin_stateless = fin_in & listen
         finack_out = fin_acc | fin_stateless
+
+        # ---- delayed ACK (RFC 1122: ack at least every 2nd segment; OOO
+        # and duplicate segments ack immediately so fast-retransmit timing
+        # is unchanged). `delack` 0 disables coalescing entirely.
+        da_dis = p["delack"] == 0
+        # a hole-filling arrival (non-empty pre-insert bitmap) must ack
+        # IMMEDIATELY (RFC 5681: gap-fill acks end recovery without delay;
+        # the CPU-plane machine has the same had_runs carve-out)
+        filling = bm != 0
+        ack_2nd = inorder & (st["da_pend"] | da_dis | filling)
+        hold = inorder & ~st["da_pend"] & ~da_dis & ~filling
+        ack_imm = ooo | dup_seg
+        da_fire = da_ev & st["da_pend"] & (t >= st["da_t"])
+        da_repush = da_ev & st["da_pend"] & (t < st["da_t"])
+        ack_out = ack_2nd | ack_imm | da_fire
+        da_t_new = jnp.where(hold, t + p["delack"], st["da_t"])
+        da_arm = hold & ~st["da_alive"]
+        st["da_pend"] = jnp.where(
+            hold, True,
+            jnp.where(ack_out | fin_acc | new_conn, False, st["da_pend"]),
+        )
+        st["da_t"] = da_t_new
+        st["da_alive"] = jnp.where(
+            da_ev, da_repush, jnp.where(da_arm, True, st["da_alive"])
+        )
 
         st["sv_state"] = jnp.where(
             new_conn, 1, jnp.where(fin_acc, 0, st["sv_state"])
@@ -284,6 +329,13 @@ class TgenTcpModel:
         st["sv_bm"] = jnp.where(new_conn | fin_acc, jnp.uint32(0), bm2)
         st["segs_rcvd"] = st["segs_rcvd"] + inorder + ooo
         st["dup_segs"] = st["dup_segs"] + dup_seg
+        # actual payload bytes from the wire size word (the SENDER's mss
+        # sets segment size; crediting the receiver's own mss would be
+        # wrong under heterogeneous mss args)
+        wire_sz = ctx.payload[:, 0]
+        st["bytes_rcvd"] = st["bytes_rcvd"] + jnp.where(
+            inorder | ooo, (wire_sz - HDR_BYTES).astype(jnp.int64), 0
+        )
 
         # ================= client lane ==================================
         for_me = seg & (src == st["c_peer"]) & (ph == my_phase)
@@ -403,24 +455,26 @@ class TgenTcpModel:
         st["rtt_seq"] = jnp.where(start, -1, st["rtt_seq"])
         st["flow_t0"] = jnp.where(start, t, st["flow_t0"])
 
-        # ---- TX continuation: one DATA segment per microstep
+        # ---- TX continuation: up to tx_batch DATA segments per microstep
+        # (one send port each; same-round departure makes the wire result
+        # identical to one-per-microstep, at a fraction of the event count)
+        txb = self.tx_batch
         cwnd_segs = st["cwnd_x"] >> 10
-        can_tx = (
-            tx
-            & (st["c_state"] == CST_EST)
-            & (st["snd_nxt"] < st["snd_una"] + cwnd_segs)
-            & (st["snd_nxt"] < L)
+        lim_seq = jnp.minimum(st["snd_una"] + cwnd_segs, L)
+        n_can = jnp.where(
+            tx & (st["c_state"] == CST_EST),
+            jnp.clip(lim_seq - st["snd_nxt"], 0, txb),
+            0,
         )
-        tx_seq = st["snd_nxt"]
-        st["snd_nxt"] = jnp.where(can_tx, st["snd_nxt"] + 1, st["snd_nxt"])
-        st["d_sent"] = st["d_sent"] + can_tx
+        can_tx = n_can > 0
+        tx_seq = st["snd_nxt"]  # first segment of this batch
+        st["snd_nxt"] = st["snd_nxt"] + n_can
+        st["d_sent"] = st["d_sent"] + n_can
         # time exactly one segment in flight (Karn-safe: first transmission)
         time_it = can_tx & (st["rtt_seq"] < 0)
         st["rtt_seq"] = jnp.where(time_it, tx_seq, st["rtt_seq"])
         st["rtt_t0"] = jnp.where(time_it, t, st["rtt_t0"])
-        chain_more = can_tx & (
-            (st["snd_nxt"] < st["snd_una"] + cwnd_segs) & (st["snd_nxt"] < L)
-        )
+        chain_more = can_tx & (st["snd_nxt"] < lim_seq)
 
         # ---- RTO timer lane (single lazy timer event per host)
         armed = st["deadline"] != TIME_MAX
@@ -493,9 +547,20 @@ class TgenTcpModel:
             payload=jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32),
         )
 
-        # push port B: timer chain + next-flow tick (mutually exclusive:
-        # timer pushes come from TICK/RTO events, tick pushes from FINACK)
-        arm_timer = start & ~st["timer_alive"]
+        # push port B: timer chain + next-flow tick + delack timer — all
+        # mutually exclusive per host (timer pushes come from TICK/RTO
+        # events, tick pushes from FINACK, delack pushes from DATA/DELACK)
+        # (re)arm whenever THIS event left a live deadline and no chain is
+        # queued — not just at flow start: the chain legitimately dies
+        # whenever it fires during a quiet spell (deadline == TIME_MAX),
+        # and the next rearming event must resurrect it or the client
+        # never hears its RTO again (found as a wedged flow: deadline
+        # armed, timer_alive False, simulation idle forever).
+        arm_timer = (
+            (tick | seg | tx | rto_ev)
+            & (st["deadline"] != TIME_MAX)
+            & ~st["timer_alive"]
+        )
         st["timer_alive"] = jnp.where(arm_timer, True, st["timer_alive"])
         timer_push = arm_timer | resched | expired
         timer_t = jnp.where(
@@ -504,10 +569,17 @@ class TgenTcpModel:
             jnp.where(expired, t + st["rto"], st["deadline"]),
         )
         next_tick = finack_in & more
+        da_push = da_arm | da_repush
         port_b = LocalPush(
-            mask=timer_push | next_tick,
-            t=jnp.where(next_tick, t + p["flow_gap"], timer_t),
-            kind=jnp.where(next_tick, KIND_TICK, KIND_RTO).astype(jnp.int32),
+            mask=timer_push | next_tick | da_push,
+            t=jnp.where(
+                next_tick,
+                t + p["flow_gap"],
+                jnp.where(da_push, st["da_t"], timer_t),
+            ),
+            kind=jnp.where(
+                next_tick, KIND_TICK, jnp.where(da_push, KIND_DELACK, KIND_RTO)
+            ).astype(jnp.int32),
             payload=jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32),
         )
 
@@ -523,9 +595,16 @@ class TgenTcpModel:
         send_data = can_tx | rtx_data
 
         m = send_syn | send_fin | send_data | synack_out | ack_out | finack_out
-        # destination: client-side emissions go to c_peer, server-side to src
-        server_side = synack_out | ack_out | finack_out
-        dst = jnp.where(server_side, src, st["c_peer"]).astype(jnp.int64)
+        # destinations: ACKs address via the stored connection (a delack
+        # timer firing is a LOCAL event whose payload src/phase are
+        # meaningless); SYNACK/FINACK echo the triggering packet (the
+        # stateless re-FIN answer must reach a peer no longer in sv_peer);
+        # client-side emissions go to c_peer.
+        dst = jnp.where(
+            ack_out,
+            st["sv_peer"],
+            jnp.where(synack_out | finack_out, src, st["c_peer"]),
+        ).astype(jnp.int64)
         ft = jnp.where(
             send_syn,
             FT_SYN,
@@ -543,8 +622,13 @@ class TgenTcpModel:
                 ),
             ),
         ).astype(jnp.int32)
-        # phase stamp: server-side emissions echo the packet's phase
-        out_phase = jnp.where(server_side, ph, my_phase)
+        # phase stamp: ACKs carry the stored connection phase; SYNACK/
+        # FINACK echo the packet's phase; client emissions use their own
+        out_phase = jnp.where(
+            ack_out,
+            st["sv_phase"],
+            jnp.where(synack_out | finack_out, ph, my_phase),
+        )
         seq_word = jnp.where(
             send_data,
             jnp.where(rtx_data, rtx_seq, tx_seq),
@@ -558,12 +642,22 @@ class TgenTcpModel:
         size = jnp.where(
             send_data, p["mss"] + HDR_BYTES, jnp.full((h,), HDR_BYTES, jnp.int32)
         ).astype(jnp.int32)
+        # segments 2..tx_batch of a TX batch ride the SAME port as a burst
+        # (engine PacketSend.count): the per-segment payload differs only
+        # by +1 in the seq word, expressed via payload_inc. Non-TX
+        # emissions are count 1.
+        seq_inc = jnp.zeros((h, EVENT_PAYLOAD_WORDS), jnp.int32).at[
+            :, PW_SEQ
+        ].set(1)
         send = PacketSend(
             mask=m,
             dst=dst,
             size_bytes=size,
             kind=jnp.full((h,), KIND_SEG, jnp.int32),
             payload=payload,
+            count=jnp.where(can_tx, n_can, 1).astype(jnp.int32),
+            payload_inc=seq_inc,
+            count_max=txb,
         )
 
         return HandlerOut(
@@ -576,8 +670,6 @@ class TgenTcpModel:
         done = np.asarray(state["flows_done"])
         fct = np.asarray(state["fct_sum"])
         n = int(done.sum())
-        mss = np.asarray([hh["model_args"].get("mss", 1460) for hh in hosts])
-        segs = np.asarray(state["segs_rcvd"])
         return {
             "flows_completed": n,
             "flows_expected": int(
@@ -589,5 +681,7 @@ class TgenTcpModel:
             "timeouts": int(np.asarray(state["timeouts"]).sum()),
             "dup_segments": int(np.asarray(state["dup_segs"]).sum()),
             "mean_fct_ms": (float(fct.sum()) / n / 1e6) if n else None,
-            "payload_bytes_received": int((segs * mss).sum()),
+            "payload_bytes_received": int(
+                np.asarray(state["bytes_rcvd"]).sum()
+            ),
         }

@@ -13,6 +13,7 @@ from shadow_tpu.ops.events import (
     next_time,
     queue_len,
     pop_min,
+    push_many,
     push_one,
     pack_order,
     check_order_limits,
@@ -28,6 +29,7 @@ __all__ = [
     "next_time",
     "queue_len",
     "pop_min",
+    "push_many",
     "push_one",
     "pack_order",
     "check_order_limits",

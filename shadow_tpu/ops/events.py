@@ -174,6 +174,47 @@ def pop_min(q: EventQueue, limit) -> tuple[EventQueue, Event, Array]:
     )
 
 
+def push_many(q: EventQueue, pushes) -> EventQueue:
+    """Push up to len(pushes) events per host in ONE pass over the slab.
+
+    `pushes` is a sequence of (mask, t, order, kind, payload) tuples (arrays
+    as in `push_one`). Semantics are identical to calling `push_one` in
+    sequence — push k lands in the k-th free slot counting only earlier
+    pushes that fired — but the slab is read and written once: sequential
+    `push_one` calls each carry an argmax reduction that fences XLA fusion,
+    so k pushes cost k full [H, C] memory passes; here the free-rank cumsum
+    is computed once and every push is an elementwise one-hot on top of it
+    (measured as the dominant per-microstep cost at 10k hosts x capacity 64).
+    """
+    free = q.t == TIME_MAX  # [H, C]
+    free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1  # [H, C]
+    free_count = jnp.sum(free.astype(jnp.int32), axis=1)  # [H]
+    h = q.t.shape[0]
+    need = jnp.zeros((h,), jnp.int32)  # free slots consumed by earlier pushes
+    new_t, new_order, new_kind, new_payload = q.t, q.order, q.kind, q.payload
+    dropped = q.dropped
+    for mask, t, order, kind, payload in pushes:
+        ok = mask & (need < free_count)
+        oh = ok[:, None] & free & (free_rank == need[:, None])
+        new_t = jnp.where(oh, jnp.asarray(t, jnp.int64)[:, None], new_t)
+        new_order = jnp.where(
+            oh, jnp.asarray(order, jnp.int64)[:, None], new_order
+        )
+        new_kind = jnp.where(
+            oh, jnp.asarray(kind, jnp.int32)[:, None], new_kind
+        )
+        new_payload = jnp.where(
+            oh[:, :, None], jnp.asarray(payload, jnp.int32)[:, None, :],
+            new_payload,
+        )
+        dropped = dropped + jnp.where(mask & ~ok, 1, 0).astype(jnp.int64)
+        need = need + ok.astype(jnp.int32)
+    return EventQueue(
+        t=new_t, order=new_order, kind=new_kind, payload=new_payload,
+        dropped=dropped,
+    )
+
+
 def push_one(q: EventQueue, mask, t, order, kind, payload) -> EventQueue:
     """Push one event per host where `mask` ([H] bool) is set.
 

@@ -97,6 +97,57 @@ def _unpack_words(g, p_words):
     return g_t, g_order, g[..., 4], g[..., 5 : 5 + p_words]
 
 
+def merge_plan(
+    q_t,  # i64[H, C] — the queue's time plane ONLY (free-slot source)
+    dst,
+    t,
+    order,
+    kind,
+    payload,
+    valid,
+    max_inserts: int,
+    shed_urgency: bool = True,
+):
+    """The sort/gather half of the gather-path merge, WITHOUT writing the
+    queue: returns (take bool[H, C], g i32[H, C, W], dropped_add i64[H]).
+
+    Split out so the engine can wrap only THIS half in the empty-round
+    `lax.cond`: a cond whose branches return the whole queue copies every
+    slab at the branch boundary each round (traced at ~55% of the PHOLD
+    round cost on v5e); a cond returning the plan copies one [H, C, W]
+    packed block, and `merge_apply` runs unconditionally as a single cheap
+    where-pass. Takes only the queue's TIME plane: passing the whole queue
+    through the cond made every plane a second consumer and forced XLA to
+    copy the slabs around the branch anyway (measured as a 40% round-cost
+    regression on PHOLD-torus before the narrowing)."""
+    return _merge_gather_plan(
+        q_t, dst, t, order, kind, payload, valid, max_inserts, shed_urgency
+    )
+
+
+def merge_empty_plan(q_t, p_words: int):
+    """A no-op insertion plan (the empty-round cond branch)."""
+    num_hosts, cap = q_t.shape
+    return (
+        jnp.zeros((num_hosts, cap), bool),
+        jnp.zeros((num_hosts, cap, 5 + p_words), jnp.int32),
+        jnp.zeros((num_hosts,), jnp.int64),
+    )
+
+
+def merge_apply(q: EventQueue, take, g, dropped_add) -> EventQueue:
+    """Write a `merge_plan` into the queue (one masked slab pass)."""
+    p_words = q.payload.shape[2]
+    g_t, g_order, g_kind, g_payload = _unpack_words(g, p_words)
+    return EventQueue(
+        t=jnp.where(take, g_t, q.t),
+        order=jnp.where(take, g_order, q.order),
+        kind=jnp.where(take, g_kind, q.kind),
+        payload=jnp.where(take[:, :, None], g_payload, q.payload),
+        dropped=q.dropped + dropped_add,
+    )
+
+
 def merge_flat_events(
     q: EventQueue,
     dst,  # i32[N] local host index of each entry
@@ -142,6 +193,24 @@ def merge_flat_events(
             s_dst = (s_packed >> idx_bits).astype(jnp.int32)
             s_idx = (s_packed & ((1 << idx_bits) - 1)).astype(jnp.int32)
         return _merge_scatter(q, s_dst, s_idx, t, order, kind, payload, r_cap)
+
+    return merge_apply(
+        q,
+        *_merge_gather_plan(
+            q.t, dst, t, order, kind, payload, valid, max_inserts,
+            shed_urgency
+        ),
+    )
+
+
+def _merge_gather_plan(
+    q_t, dst, t, order, kind, payload, valid, max_inserts, shed_urgency
+):
+    num_hosts, cap = q_t.shape
+    n = dst.shape[0]
+    r_cap = min(max_inserts, cap)
+    dst_key = jnp.where(valid, dst.astype(jnp.int32), jnp.int32(num_hosts))
+    iota = jnp.arange(n, dtype=jnp.int32)
 
     # -- 1. sort entries TOGETHER with one query token per host (plus an end
     # sentinel): token h carries (dst=h, t=-1, order=-1) so it sorts to the
@@ -201,26 +270,21 @@ def merge_flat_events(
 
     # -- 3. r-th free slot of host h gathers sorted entry at
     # first[h] + 1 + r (the +1 skips host h's own token)
-    free = q.t == TIME_MAX  # [H, C]
+    free = q_t == TIME_MAX  # [H, C]
     free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1  # [H, C]
     take = free & (free_rank < r_cap) & (free_rank < seg_len[:, None])
     j = jnp.where(take, first[:-1, None] + 1 + free_rank, 0)  # [H, C]
-    p_words = payload.shape[1]
     words = _pack_words(t, order, kind.astype(jnp.int32), payload)
     # row permutation (gather 1); token rows (s_idx == -1) wrap to the last
-    # row — never selected by `take`, and harmless to fetch
+    # row — never selected by `take`, and harmless to fetch. Note (r5): the
+    # composed form `words[s_idx[j]]` — skipping the [M, W] materialization
+    # — was tried and measured ~7% SLOWER at M = 400k: the second gather's
+    # rows are near-sequential in w_sorted (per-host segments) but random
+    # in the original entry order, and locality wins over the saved pass.
     w_sorted = words[s_idx]  # [M, W]
     g = w_sorted[j]  # [H, C, W] row gather — all fields at once (gather 2)
-    g_t, g_order, g_kind, g_payload = _unpack_words(g, p_words)
-
-    new_t = jnp.where(take, g_t, q.t)
-    new_order = jnp.where(take, g_order, q.order)
-    new_kind = jnp.where(take, g_kind, q.kind)
-    new_payload = jnp.where(take[:, :, None], g_payload, q.payload)
 
     # -- overflow accounting (elementwise: order-independent, deterministic)
     inserted = jnp.sum(take.astype(jnp.int32), axis=1)
-    dropped = q.dropped + (seg_len - inserted).astype(jnp.int64)
-    return EventQueue(
-        t=new_t, order=new_order, kind=new_kind, payload=new_payload, dropped=dropped
-    )
+    dropped_add = (seg_len - inserted).astype(jnp.int64)
+    return take, g, dropped_add
