@@ -287,23 +287,58 @@ def _split_args(args: Any) -> list[str]:
 
 @dataclass
 class HostDefaultOptions:
-    """reference: HostDefaultOptions (configuration.rs:550), cascaded per host."""
+    """reference: HostDefaultOptions (configuration.rs:550), cascaded per
+    host — including the TCP socket-buffer sizes and autotuning flags the
+    reference exposes there (socket_send_buffer / socket_recv_buffer +
+    autotune booleans)."""
 
     log_level: str | None = None
     pcap_enabled: bool = False
     pcap_capture_size: int = 65535
+    tcp_send_buffer: int = 256 * 1024  # bytes ("256 KiB" accepted)
+    tcp_recv_buffer: int = 256 * 1024
+    tcp_autotune: bool = True  # grow buffers under pressure up to buf_max
+    tcp_buffer_max: int = 4 * 1024 * 1024
+    tcp_sack: bool = True
+    tcp_delayed_ack: bool = True
+    tcp_nagle: bool = False
 
     @staticmethod
     def from_dict(d: dict[str, Any] | None) -> "HostDefaultOptions":
+        from shadow_tpu.config.units import parse_bytes
+
         d = dict(d or {})
         h = HostDefaultOptions(
             log_level=d.pop("log_level", None),
             pcap_enabled=bool(d.pop("pcap_enabled", False)),
             pcap_capture_size=int(d.pop("pcap_capture_size", 65535)),
+            tcp_send_buffer=parse_bytes(d.pop("tcp_send_buffer", 256 * 1024)),
+            tcp_recv_buffer=parse_bytes(d.pop("tcp_recv_buffer", 256 * 1024)),
+            tcp_autotune=bool(d.pop("tcp_autotune", True)),
+            tcp_buffer_max=parse_bytes(
+                d.pop("tcp_buffer_max", 4 * 1024 * 1024)
+            ),
+            tcp_sack=bool(d.pop("tcp_sack", True)),
+            tcp_delayed_ack=bool(d.pop("tcp_delayed_ack", True)),
+            tcp_nagle=bool(d.pop("tcp_nagle", False)),
         )
         if d:
             raise ConfigError(f"unknown host default options: {sorted(d)}")
         return h
+
+    def tcp_config(self):
+        """Materialize the per-host TcpConfig these options describe."""
+        from shadow_tpu.tcp import TcpConfig
+
+        return TcpConfig(
+            send_buf=self.tcp_send_buffer,
+            recv_buf=self.tcp_recv_buffer,
+            autotune=self.tcp_autotune,
+            buf_max=self.tcp_buffer_max,
+            sack=self.tcp_sack,
+            delayed_ack=self.tcp_delayed_ack,
+            nagle=self.tcp_nagle,
+        )
 
 
 @dataclass
@@ -322,11 +357,15 @@ class HostOptions:
     @staticmethod
     def from_dict(name: str, d: dict[str, Any], defaults: HostDefaultOptions) -> "HostOptions":
         d = dict(d)
-        merged_defaults = copy.deepcopy(defaults)
-        for k, v in (d.pop("host_options", {}) or {}).items():
-            if not hasattr(merged_defaults, k):
+        # per-host overrides go through the same typed parser as the
+        # defaults (raw setattr left unit strings like "128 KiB" unparsed)
+        overrides = d.pop("host_options", {}) or {}
+        for k in overrides:
+            if not hasattr(defaults, k):
                 raise ConfigError(f"unknown host option {k!r}")
-            setattr(merged_defaults, k, v)
+        merged_defaults = HostDefaultOptions.from_dict(
+            {**dataclasses.asdict(defaults), **overrides}
+        )
         bw_down = d.pop("bandwidth_down", None)
         bw_up = d.pop("bandwidth_up", None)
         h = HostOptions(

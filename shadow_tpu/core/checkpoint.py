@@ -118,7 +118,7 @@ def load_checkpoint(path: str, sim) -> None:
 from shadow_tpu.simtime import TIME_MAX  # noqa: E402
 
 _SEG_FIELDS = ("flags", "seq", "ack", "wnd", "mss", "wscale",
-               "src_port", "dst_port")
+               "sack_ok", "sack", "src_port", "dst_port")
 
 
 def _pack_byte_stores(stores) -> tuple[bytes, bytes]:
@@ -166,7 +166,11 @@ def _unpack_byte_stores(idx_json: bytes, buf: bytes, n_hosts: int):
         if "seg" in rec:
             s0, sl = rec["segpl"]
             segpl = payload if [s0, sl] == rec["pl"] else buf[s0:s0 + sl]
-            seg = Segment(payload=segpl, **rec["seg"])
+            kw = rec["seg"]
+            # JSON round-trips tuples as lists; Segment carries SACK blocks
+            # as a tuple of (start, end) pairs
+            kw["sack"] = tuple(tuple(b) for b in kw.get("sack", ()))
+            seg = Segment(payload=segpl, **kw)
         pkt = NetPacket(
             src_ip=rec["sip"], src_port=rec["sp"],
             dst_ip=rec["dip"], dst_port=rec["dp"],

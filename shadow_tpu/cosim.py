@@ -238,6 +238,7 @@ class HybridSimulation:
                     seed=cfg.general.seed,
                     host_id=s.host_id,
                     model_unblocked_latency=cfg.general.model_unblocked_syscall_latency,
+                    tcp=s.tcp_cfg,
                 )
             )
             h.egress = self._stage_send

@@ -18,7 +18,7 @@ from __future__ import annotations
 import heapq
 import random
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Any, Callable
 
 from shadow_tpu.host.filestate import CallbackQueue
 from shadow_tpu.host.netns import NetworkNamespace
@@ -43,6 +43,9 @@ class HostConfig:
     model_unblocked_latency: bool = False
     unblocked_syscall_limit: int = 1024
     unblocked_syscall_latency_ns: int = 1_000
+    # per-host TCP socket defaults (reference HostDefaultOptions socket
+    # buffer/autotune knobs); None = TcpConfig() defaults
+    tcp: Any = None
 
 
 class CpuHost:

@@ -146,7 +146,7 @@ class TcpSocket(_SocketBase):
 
     def __init__(self, netns, tcp: TcpState | None = None, cfg: TcpConfig | None = None):
         super().__init__(netns)
-        self.cfg = cfg or TcpConfig()
+        self.cfg = cfg or getattr(netns.host.cfg, "tcp", None) or TcpConfig()
         self.tcp = tcp or TcpState(self.cfg, iss=netns.host.next_iss())
         self._timer_token = None
         self._sync()
@@ -286,7 +286,7 @@ class TcpListenerSocket(_SocketBase):
 
     def __init__(self, netns, cfg: TcpConfig | None = None, backlog: int = 128):
         super().__init__(netns)
-        self.cfg = cfg or TcpConfig()
+        self.cfg = cfg or getattr(netns.host.cfg, "tcp", None) or TcpConfig()
         self.backlog = backlog
         self.tcp = TcpState(self.cfg, iss=0)
         self.tcp.listen()

@@ -60,6 +60,9 @@ class HostSpec:
     shutdown_time: int | None
     pcap_enabled: bool
     pcap_capture_size: int
+    # per-host TCP defaults for the CPU plane (reference HostDefaultOptions
+    # socket buffer / autotune knobs); None on pure-device hosts
+    tcp_cfg: Any = None
     # managed programs (hybrid/co-sim hosts): [{path, args, start_time, ...}]
     programs: list = dataclasses.field(default_factory=list)
 
@@ -272,6 +275,7 @@ def expand_hosts_hybrid(cfg: ConfigOptions, graph: NetworkGraph) -> list[HostSpe
                 shutdown_time=None,
                 pcap_enabled=h.host_options.pcap_enabled,
                 pcap_capture_size=h.host_options.pcap_capture_size,
+                tcp_cfg=h.host_options.tcp_config(),
                 programs=[
                     {
                         "path": p.path,

@@ -168,6 +168,11 @@ class RecvBuffer:
                     break
         return rcv_nxt
 
+    def ooo_ranges(self) -> list[tuple[int, int]]:
+        """Out-of-order runs as wire-seq [start, end) blocks — the SACK
+        blocks this receiver advertises (RFC 2018)."""
+        return [(s, (s + len(d)) % MOD) for s, d in self._runs if d]
+
     def read(self, n: int) -> bytes:
         out = bytes(self._ready[:n])
         del self._ready[: len(out)]

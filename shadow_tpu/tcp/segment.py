@@ -33,6 +33,11 @@ class Segment:
     # options (present only on SYN segments, like the reference)
     mss: int | None = None
     wscale: int | None = None
+    # SACK (RFC 2018; reference tcp.c:151-177 selectiveACKs): `sack_ok` is
+    # the SYN-time capability option; `sack` carries up to 3 blocks of
+    # received-out-of-order sequence ranges [start, end) on ACKs
+    sack_ok: bool = False
+    sack: tuple = ()
     # addressing for the socket layer (opaque to the state machine)
     src_port: int = 0
     dst_port: int = 0
@@ -54,6 +59,8 @@ class Segment:
             o += f" mss={self.mss}"
         if self.wscale is not None:
             o += f" ws={self.wscale}"
+        if self.sack:
+            o += f" sack={list(self.sack)}"
         return (
             f"<{flags_str(self.flags)} seq={self.seq} ack={self.ack} "
             f"wnd={self.wnd}{p}{o}>"

@@ -88,6 +88,26 @@ class TcpConfig:
     time_wait: int = 60 * NS_PER_SEC  # 2*MSL
     max_retries: int = 12  # consecutive RTO expirations before TIMED_OUT
     initial_window_mss: int = 10
+    # SACK (RFC 2018; reference tcp.c:151-177): negotiated on SYN, blocks on
+    # ACKs; the sender keeps a scoreboard and skips sacked ranges when
+    # retransmitting (selective repeat instead of a full go-back-N resend)
+    sack: bool = True
+    # delayed ACK (RFC 1122 4.2.3.2; reference tcp.c:1254,2014): hold the
+    # ACK for one in-order segment up to `delack_ns`, ack every 2nd
+    # immediately; out-of-order arrivals always ack immediately
+    delayed_ack: bool = True
+    delack_ns: int = 40_000_000  # 40 ms (Linux's default delack ceiling)
+    # Nagle (RFC 896): hold a sub-MSS tail while any data is unacked.
+    # Default off: the reference's sans-I/O machine ships without Nagle and
+    # most corpus binaries would set TCP_NODELAY anyway
+    nagle: bool = False
+    # buffer autotuning (reference HostDefaultOptions autotune flags):
+    # double a buffer under pressure — recv when the advertised window
+    # drops below one MSS, send when the app fills it — up to `buf_max`.
+    # The receive wscale is chosen from buf_max so a grown window stays
+    # advertisable (RFC 7323 fixes the shift at SYN time).
+    autotune: bool = True
+    buf_max: int = 4 * 1024 * 1024
 
 
 def _wscale_for(recv_buf: int) -> int:
@@ -123,7 +143,15 @@ class TcpState:
         self.irs = 0
         self.rcv_nxt = 0
         self.rcv_buf = RecvBuffer(self.cfg.recv_buf)
-        self.rcv_wscale = _wscale_for(self.cfg.recv_buf) if self.cfg.window_scaling else 0
+        self.rcv_wscale = (
+            _wscale_for(
+                max(self.cfg.recv_buf, self.cfg.buf_max)
+                if self.cfg.autotune
+                else self.cfg.recv_buf
+            )
+            if self.cfg.window_scaling
+            else 0
+        )
         self.rcv_fin_seen = False  # FIN consumed (EOF reached)
 
         # congestion + timing
@@ -143,6 +171,13 @@ class TcpState:
         self._fast_rexmit = False
         self._probe_due = False
         self._pending_rst: Segment | None = None
+
+        # SACK: negotiated capability + sender scoreboard of peer-held
+        # ranges as disjoint sorted UNWRAPPED offset pairs [start, end)
+        self.sack_ok = False
+        self._sacked: list[tuple[int, int]] = []
+        # delayed ACK: deadline for a held in-order-data ACK
+        self._delack_deadline: int | None = None
 
         # stats (reference tcp crate keeps similar counters)
         self.segs_sent = 0
@@ -169,7 +204,18 @@ class TcpState:
             raise BrokenPipeError(f"send in state {self.state.value}")
         if self.snd_buf.fin_queued:
             raise BrokenPipeError("send after shutdown")
-        return self.snd_buf.write(data)
+        n = self.snd_buf.write(data)
+        if (
+            n < len(data)
+            and self.cfg.autotune
+            and self.snd_buf.capacity < self.cfg.buf_max
+        ):
+            # sender autotune: the app outpaces the buffer — double it
+            self.snd_buf.capacity = min(
+                self.snd_buf.capacity * 2, self.cfg.buf_max
+            )
+            n += self.snd_buf.write(data[n:])
+        return n
 
     def recv(self, n: int) -> bytes | None:
         """Read up to n bytes. None = would block; b'' = EOF."""
@@ -352,6 +398,8 @@ class TcpState:
             seg.seg_len == 0
             and (seg.wnd << self.snd_wscale) == self.snd_wnd
         )
+        if self.sack_ok and seg.sack:
+            self._absorb_sack(seg.sack)
         self._ack_advance(now, seg.ack, dup_candidate)
         self._update_snd_wnd(seg)
 
@@ -373,8 +421,20 @@ class TcpState:
         ):
             before = self.rcv_nxt
             had_fin_pending = self.rcv_buf.fin_seq is not None
+            had_runs = bool(self.rcv_buf._runs)
             self.rcv_nxt = self.rcv_buf.insert(self.rcv_nxt, seg.seq, seg.payload)
-            self._pending_ack = True
+            if self.rcv_nxt != before and self.cfg.delayed_ack and not had_runs:
+                # in-order data: ack every SECOND segment immediately, hold
+                # a single segment's ACK up to delack_ns (RFC 1122 4.2.3.2;
+                # reference tcp.c:1254,2014). Anything out of order below
+                # acks immediately via the dup-ACK path.
+                if self._delack_deadline is not None:
+                    self._pending_ack = True
+                    self._delack_deadline = None
+                else:
+                    self._delack_deadline = now + self.cfg.delack_ns
+            else:
+                self._pending_ack = True
             if self.rcv_nxt == before and seg.payload:
                 # out-of-order: each such segment owes its own immediate
                 # dup-ACK so the peer's fast-retransmit counter sees every
@@ -384,6 +444,18 @@ class TcpState:
                 # this insert filled the hole before an out-of-order FIN:
                 # the buffer consumed it, so run the FIN transitions now
                 self._on_fin_reached(now)
+            if (
+                self.cfg.autotune
+                and self.rcv_buf.window() < self.mss
+                and self.rcv_buf.capacity < self.cfg.buf_max
+            ):
+                # receiver autotune: the window is about to close on a
+                # sender that is keeping it full — double the buffer (the
+                # wscale chosen at SYN already covers buf_max)
+                self.rcv_buf.capacity = min(
+                    self.rcv_buf.capacity * 2, self.cfg.buf_max
+                )
+                self._pending_ack = True  # advertise the opened window
 
         # --- FIN (a fully-old retransmitted FIN never reaches here: the
         # acceptability check above already rejected it with an ACK)
@@ -466,6 +538,8 @@ class TcpState:
         if d and self.fin_sent and not self.fin_acked:
             self.fin_acked = True
             d -= 1
+        if self._sacked:
+            self._prune_sacked()
         # RTT sample (Karn: only if the timed range wasn't retransmitted)
         if self._timed is not None and self.una_off >= self._timed[0]:
             self.rtt.on_measurement(now - self._timed[1])
@@ -509,21 +583,80 @@ class TcpState:
         else:
             self.snd_wscale = 0
             self.rcv_wscale = 0  # peer didn't offer: RFC 7323 both-or-neither
+        self.sack_ok = bool(seg.sack_ok) and self.cfg.sack
 
     def _bytes_in_flight(self) -> int:
         return self.nxt_off - self.una_off
+
+    # ----------------------------------------------------------------- sack
+
+    def _absorb_sack(self, blocks):
+        """Merge wire-seq SACK blocks into the offset scoreboard. Blocks are
+        anchored at SND.UNA (seq_diff is safe because peers only SACK data
+        within the current send window)."""
+        una_seq = self._snd_una_seq()
+        changed = False
+        for s, e in blocks:
+            start = self.una_off + seq_diff(s, una_seq)
+            end = self.una_off + seq_diff(e, una_seq)
+            start = max(start, self.una_off)
+            end = min(end, self._max_sent_off)
+            if end > start:
+                self._sacked.append((start, end))
+                changed = True
+        if changed:
+            self._sacked.sort()
+            merged: list[tuple[int, int]] = []
+            for s0, e0 in self._sacked:
+                if merged and s0 <= merged[-1][1]:
+                    merged[-1] = (merged[-1][0], max(merged[-1][1], e0))
+                else:
+                    merged.append((s0, e0))
+            self._sacked = merged
+
+    def _prune_sacked(self):
+        self._sacked = [
+            (max(s, self.una_off), e)
+            for s, e in self._sacked
+            if e > self.una_off
+        ]
+
+    def _sack_jump(self, off: int) -> int:
+        """Next offset at/after `off` NOT held by the peer (scoreboard skip);
+        also returns the transmit ceiling imposed by the next sacked block
+        via `_sack_limit`."""
+        for s, e in self._sacked:
+            if s <= off < e:
+                return e
+        return off
+
+    def _sack_limit(self, off: int, limit: int) -> int:
+        """Clamp a transmission starting at `off` so it stops at the next
+        sacked block (no point retransmitting data the peer already holds)."""
+        for s, e in self._sacked:
+            if s > off:
+                return min(limit, s)
+        return limit
 
     # --------------------------------------------------------------- timers
 
     def next_timer(self) -> int | None:
         cands = [
             t
-            for t in (self.rto_deadline, self.probe_deadline, self.tw_deadline)
+            for t in (
+                self.rto_deadline,
+                self.probe_deadline,
+                self.tw_deadline,
+                self._delack_deadline,
+            )
             if t is not None
         ]
         return min(cands) if cands else None
 
     def on_timer(self, now: int):
+        if self._delack_deadline is not None and now >= self._delack_deadline:
+            self._delack_deadline = None
+            self._pending_ack = True
         if self.tw_deadline is not None and now >= self.tw_deadline:
             self.tw_deadline = None
             if self.state == State.TIME_WAIT:
@@ -607,6 +740,13 @@ class TcpState:
                     wnd=min(self.rcv_buf.window(), 0xFFFF),
                     mss=self.cfg.mss,
                     wscale=self.rcv_wscale if self.cfg.window_scaling else None,
+                    # a SYN-ACK echoes the capability only if the peer's SYN
+                    # offered it (negotiation); a plain SYN offers our config
+                    sack_ok=(
+                        self.sack_ok
+                        if self.state == State.SYN_RECEIVED
+                        else self.cfg.sack
+                    ),
                 )
             )
             self.snd_max_seq = wrapping_add(self.iss, 1)
@@ -618,23 +758,52 @@ class TcpState:
             self.segs_sent += len(out)
             return out
 
-        # fast retransmit: one segment from the oldest unacked octet
+        # fast retransmit: one segment from the oldest unacked octet,
+        # bounded by the first SACKed block (only the hole is resent)
         if self._fast_rexmit and self.una_off < self.snd_buf.end_off:
             self._fast_rexmit = False
-            n = min(self.mss, self.snd_buf.end_off - self.una_off)
-            out.append(self._data_segment(self.una_off, n))
-            self.retransmits += 1
-            self._timed = None  # Karn: its ACK would be ambiguous
+            hole_end = self._sack_limit(self.una_off, self.snd_buf.end_off)
+            n = min(self.mss, hole_end - self.una_off)
+            if n > 0:
+                out.append(self._data_segment(self.una_off, n))
+                self.retransmits += 1
+                self._timed = None  # Karn: its ACK would be ambiguous
 
-        # regular data: bounded by peer window + cwnd
+        # regular data: bounded by peer window + cwnd. After an RTO rewind
+        # the SACK scoreboard turns the go-back-N into selective repeat:
+        # ranges the peer already holds are skipped, transmissions stop at
+        # the next held block (tcp.c's selectiveACKs retransmit behavior).
         limit_off = self.una_off + min(
             self.snd_wnd, self.cong.cwnd
         )  # first non-sendable offset
         end = self.snd_buf.end_off
         while self.nxt_off < end and self.nxt_off < limit_off:
-            n = min(self.mss, end - self.nxt_off, limit_off - self.nxt_off)
+            if self._sacked:
+                jumped = self._sack_jump(self.nxt_off)
+                if jumped != self.nxt_off:  # peer holds this range: skip
+                    self.nxt_off = min(jumped, end)
+                    continue
+            stop = (
+                self._sack_limit(self.nxt_off, limit_off)
+                if self._sacked
+                else limit_off
+            )
+            n = min(self.mss, end - self.nxt_off, stop - self.nxt_off)
+            if n <= 0:
+                break
+            if (
+                self.cfg.nagle
+                and n < self.mss
+                and self.nxt_off + n == end
+                and self._bytes_in_flight() > 0
+                and not self.snd_buf.fin_queued
+            ):
+                # Nagle: hold the sub-MSS tail while data is in flight
+                break
             seg = self._data_segment(self.nxt_off, n)
             out.append(seg)
+            if self.nxt_off < self._max_sent_off:
+                self.retransmits += 1  # rewound range: this is a resend
             # Karn: only time ranges never transmitted before
             if self._timed is None and self.nxt_off >= self._max_sent_off:
                 self._timed = (self.nxt_off + n, now)
@@ -691,13 +860,15 @@ class TcpState:
         if seq_gt(seq_after, self.snd_max_seq):
             self.snd_max_seq = seq_after
 
-        # explicit dup-ACK train for out-of-order arrivals
+        # explicit dup-ACK train for out-of-order arrivals, carrying the
+        # SACK blocks that tell the peer exactly which ranges arrived
         if self._dup_ack_owed:
             ack_seg = Segment(
                 ACK,
                 seq=self._snd_nxt_seq(),
                 ack=self.rcv_nxt,
                 wnd=self._recv_window_field(),
+                sack=self._sack_blocks(),
             )
             out.extend([ack_seg] * self._dup_ack_owed)
             self._dup_ack_owed = 0
@@ -711,12 +882,19 @@ class TcpState:
                     seq=self._snd_nxt_seq(),
                     ack=self.rcv_nxt,
                     wnd=self._recv_window_field(),
+                    sack=self._sack_blocks(),
                 )
             )
         if any(s.flags & ACK for s in out):
             self._pending_ack = False
+            self._delack_deadline = None  # the held ACK rode along
         self.segs_sent += len(out)
         return out
+
+    def _sack_blocks(self) -> tuple:
+        if not self.sack_ok:
+            return ()
+        return tuple(self.rcv_buf.ooo_ranges()[:3])
 
     def _data_segment(self, off: int, n: int) -> Segment:
         payload = self.snd_buf.slice(off, n)

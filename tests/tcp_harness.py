@@ -82,13 +82,15 @@ class Wire:
 
 
 def handshake(latency_ns: int = 10 * MS, **kw) -> tuple[TcpState, TcpState, Wire]:
-    """Returns (client, server, wire) in ESTABLISHED."""
+    """Returns (client, server, wire) in ESTABLISHED. `cfg` sets both ends;
+    `cfg_server` overrides the server side (asymmetric-option tests)."""
     from shadow_tpu.tcp import State, TcpConfig
 
     cfg = kw.pop("cfg", TcpConfig())
+    cfg_server = kw.pop("cfg_server", cfg)
     client = TcpState(cfg, iss=1000)
     # server-side listener forks the actual connection on SYN
-    listener = TcpState(cfg, iss=0)
+    listener = TcpState(cfg_server, iss=0)
     listener.listen()
     server_box: list[TcpState] = []
 

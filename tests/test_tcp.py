@@ -193,7 +193,9 @@ def test_fast_retransmit_on_dup_acks():
 
 
 def test_zero_window_and_probe():
-    cfg = TcpConfig(recv_buf=2000, window_scaling=False)
+    # autotune off: this test REQUIRES the window to close (autotuning
+    # would grow the buffer instead, which is its own test)
+    cfg = TcpConfig(recv_buf=2000, window_scaling=False, autotune=False)
     c, s, w = handshake(cfg=cfg)
     data = os.urandom(10_000)
     sent = 0
@@ -400,7 +402,7 @@ def test_window_update_acks_are_not_dup_acks():
 
 
 def test_lost_zero_window_probe_is_retransmitted():
-    cfg = TcpConfig(recv_buf=1460, window_scaling=False)
+    cfg = TcpConfig(recv_buf=1460, window_scaling=False, autotune=False)
     c, s, w = handshake(cfg=cfg)
     # fill the peer window exactly, then queue one more byte
     c.send(b"a" * 1460)
@@ -478,7 +480,9 @@ def test_late_ack_after_rto_rewind_advances_una():
     """An ACK covering data transmitted before an RTO go-back-N rewind must
     advance una_off/send-buffer even though nxt_off was rewound (advisor
     finding: capped at nxt_off - una_off, i.e. zero after rewind)."""
-    c, s, w = handshake()
+    # delayed_ack off: this test hand-delivers segments with no timer
+    # servicing, and a held delack would stall the ACK it asserts on
+    c, s, w = handshake(cfg=TcpConfig(delayed_ack=False))
     payload = bytes(1000)
     c.send(payload)
     # deliver data to the server, but swallow everything the server says

@@ -1,0 +1,282 @@
+"""SACK, delayed ACK, and Nagle in the CPU-plane TCP machine (VERDICT r4
+missing #3; reference tcp.c:151-177 selectiveACKs, tcp.c:1254,2014 delayed
+ACK). Style mirrors /root/reference/src/lib/tcp/src/tests: two TcpState
+endpoints over a deterministic wire with scripted drops."""
+
+from __future__ import annotations
+
+from shadow_tpu.tcp import TcpConfig, TcpState
+from shadow_tpu.tcp.segment import ACK, SYN
+
+from tests.tcp_harness import MS, Wire, handshake
+
+
+def _drain(tcp: TcpState) -> bytes:
+    out = bytearray()
+    while True:
+        d = tcp.recv(1 << 20)
+        if not d:
+            break
+        out += d
+    return bytes(out)
+
+
+def _pure_acks_from(wire: Wire, who: str):
+    return [
+        (t, s)
+        for t, snd, s in wire.sent
+        if snd == who and s.flags == ACK and not s.payload
+    ]
+
+
+def _data_resends(wire: Wire, who: str) -> int:
+    seqs = [s.seq for _, snd, s in wire.sent if snd == who and s.payload]
+    return len(seqs) - len(set(seqs))
+
+
+def test_sack_negotiated_on_syn():
+    client, server, wire = handshake()
+    assert client.sack_ok and server.sack_ok
+
+
+def test_sack_disabled_when_peer_lacks_it():
+    client, server, wire = handshake(cfg_server=TcpConfig(sack=False))
+    assert not client.sack_ok and not server.sack_ok
+
+
+def test_mid_flow_loss_selective_retransmit():
+    """Drop one mid-flow data segment: the receiver SACKs the later ranges
+    and the sender retransmits ONLY the hole — one segment, not the window
+    (reference tcp.c:151-177 selectiveACKs)."""
+    dropped = []
+
+    def drop(idx, sender, seg):
+        if sender == "a" and seg.payload and not dropped:
+            nth = sum(
+                1 for _, s, x in wire.sent[:idx] if s == "a" and x.payload
+            )
+            if nth == 3:  # the 4th data segment, exactly once
+                dropped.append(seg)
+                return True
+        return False
+
+    client, server, wire = handshake(drop=drop)
+    payload = bytes(range(256)) * 40  # 10240 B = 8 segments at mss 1460
+    client.send(payload)
+    wire.run(until=lambda: server.rcv_buf.readable() == len(payload))
+    assert _drain(server) == payload
+    assert dropped, "the drop hook never fired"
+    # dup ACKs carried SACK blocks describing the post-hole data
+    assert any(s.sack for _, snd, s in wire.sent if snd == "b"), (
+        "receiver never advertised SACK blocks"
+    )
+    # recovery resent exactly the hole
+    assert _data_resends(wire, "a") == 1
+    assert client.retransmits == 1
+
+
+def _two_hole_drop_script(wire_ref):
+    """Drop data segments #3 and #10 (two separated holes) and let only the
+    first two post-loss pure ACKs through — the sender's scoreboard fills
+    (when SACK is on) but the 3-dup-ack fast retransmit never arms, so
+    recovery must go through the RTO."""
+    state = {"dropped": set(), "acks_after_loss": 0}
+
+    def drop(idx, sender, seg):
+        if not wire_ref:  # still inside the handshake helper's own run
+            return False
+        wire = wire_ref[0]
+        if sender == "a" and seg.payload:
+            nth = sum(
+                1 for _, s, x in wire.sent[:idx] if s == "a" and x.payload
+            )
+            if nth in (2, 9) and nth not in state["dropped"]:
+                state["dropped"].add(nth)
+                return True
+        if (
+            sender == "b"
+            and state["dropped"]
+            and seg.flags == ACK
+            and not seg.payload
+        ):
+            # suppress only DUPLICATE acks (unchanged ack field) beyond the
+            # first two — acks that advance must flow or nothing finishes
+            if seg.ack in state.setdefault("seen_acks", set()):
+                state["acks_after_loss"] += 1
+                return state["acks_after_loss"] > 2
+            state["seen_acks"].add(seg.ack)
+        return False
+
+    return drop
+
+
+def test_rto_with_sack_is_selective_repeat():
+    """Two holes, dup-ACK train suppressed: after the RTO rewind the SACK
+    scoreboard turns go-back-N into selective repeat — exactly the two lost
+    segments are resent, nothing the peer already holds."""
+    wire_ref = []
+    client, server, wire = handshake(drop=_two_hole_drop_script(wire_ref))
+    wire_ref.append(wire)
+    payload = b"\xab" * (1460 * 16)
+    client.send(payload)
+    wire.run(until=lambda: server.rcv_buf.readable() == len(payload))
+    assert _drain(server) == payload
+    assert _data_resends(wire, "a") == 2  # the two holes, nothing else
+
+
+def test_rto_without_sack_resends_held_data():
+    """Control: the identical drop script with SACK disabled resends data
+    the receiver already buffered (go-back-N waste) — the waste SACK
+    removes. The cumulative-ACK jumps bound it, so the margin is small but
+    strictly larger than the SACK run."""
+    wire_ref = []
+    client, server, wire = handshake(
+        cfg=TcpConfig(sack=False), drop=_two_hole_drop_script(wire_ref)
+    )
+    wire_ref.append(wire)
+    payload = b"\xcd" * (1460 * 16)
+    client.send(payload)
+    wire.run(until=lambda: server.rcv_buf.readable() == len(payload))
+    assert _drain(server) == payload
+    assert _data_resends(wire, "a") >= 3  # resent at least one held range
+
+
+def test_delayed_ack_coalesces_pairs():
+    """Two back-to-back segments produce ONE immediate ACK; a lone
+    segment's ACK is held until the delack timer fires."""
+    client, server, wire = handshake()
+    base = len(_pure_acks_from(wire, "b"))
+    client.send(b"x" * 2920)  # exactly 2 mss-sized segments
+    wire.run(until=lambda: server.rcv_buf.readable() == 2920)
+    wire.run()  # settle
+    pair_acks = _pure_acks_from(wire, "b")[base:]
+    assert len(pair_acks) == 1
+    t_mid = wire.now
+    client.send(b"y" * 100)  # lone sub-mss segment
+    wire.run(until=lambda: server.rcv_buf.readable() == 3020)
+    wire.run()
+    late = [t for t, _ in _pure_acks_from(wire, "b") if t > t_mid]
+    assert late, "held ACK never fired"
+    # it fired via the delack timer: arrival (+10 ms wire) + 40 ms hold
+    assert late[0] >= t_mid + 10 * MS + 40 * MS
+    assert _drain(server) == b"x" * 2920 + b"y" * 100
+
+
+def test_delayed_ack_disabled_acks_immediately():
+    """Without delayed ACK a LONE segment is acked at arrival time, not
+    after the 40 ms delack hold (contrast with the coalescing test)."""
+    cfg = TcpConfig(delayed_ack=False)
+    client, server, wire = handshake(cfg=cfg)
+    t0 = wire.now
+    client.send(b"x" * 100)  # lone sub-mss segment
+    wire.run(until=lambda: server.rcv_buf.readable() == 100)
+    wire.run()
+    late = [t for t, _ in _pure_acks_from(wire, "b") if t > t0]
+    assert late and late[0] <= t0 + 10 * MS  # at arrival (+wire latency)
+
+
+def test_nagle_holds_small_tail():
+    cfg = TcpConfig(nagle=True, delayed_ack=False)
+    client, server, wire = handshake(cfg=cfg)
+    client.send(b"A" * 1460)
+    client.poll_segments(wire.now)  # full segment departs
+    client.send(b"B" * 10)
+    held = client.poll_segments(wire.now)
+    assert not any(s.payload for s in held), "Nagle failed to hold the tail"
+    wire.run(until=lambda: server.rcv_buf.readable() == 1470)
+    assert _drain(server) == b"A" * 1460 + b"B" * 10
+
+
+def test_nodelay_sends_small_immediately():
+    cfg = TcpConfig(nagle=False, delayed_ack=False)
+    client, server, wire = handshake(cfg=cfg)
+    client.send(b"A" * 1460)
+    client.poll_segments(wire.now)
+    client.send(b"B" * 10)
+    now = client.poll_segments(wire.now)
+    assert any(len(s.payload) == 10 for s in now)
+    wire.run(until=lambda: server.rcv_buf.readable() == 1470)
+    assert _drain(server) == b"A" * 1460 + b"B" * 10
+
+
+def test_autotuned_buffers_beat_fixed_small_buffers():
+    """VERDICT r4 #10: a receive-window-limited transfer completes faster
+    with autotuning (the buffer doubles as the sender keeps it full) than
+    with the same small buffer fixed."""
+    data = b"\x5a" * (300 * 1024)
+
+    def run(autotune: bool) -> int:
+        cfg = TcpConfig(
+            recv_buf=8 * 1024, send_buf=512 * 1024,
+            autotune=autotune, buf_max=1024 * 1024, delayed_ack=False,
+        )
+        client, server, wire = handshake(cfg=cfg)
+        off = 0
+        while True:
+            off += client.send(data[off:])
+            got = server.rcv_buf.readable()
+            if got:
+                server.recv(1 << 20)  # drain so the window reopens
+            if off >= len(data) and server.segs_received and not wire.step():
+                break
+            if not wire.step() and off >= len(data):
+                break
+        return wire.now
+
+    t_fixed = run(False)
+    t_auto = run(True)
+    assert t_auto < t_fixed * 0.6, (t_auto, t_fixed)
+
+
+def test_tcp_knobs_flow_from_config_to_sockets(tmp_path):
+    """The host-level TCP options cascade into every socket's TcpConfig
+    (reference HostDefaultOptions socket buffer knobs)."""
+    from shadow_tpu.config.options import ConfigError, ConfigOptions
+    from shadow_tpu.net.graph import load_graph
+    from shadow_tpu.sim import expand_hosts_hybrid
+
+    cfg = ConfigOptions.from_dict(
+        {
+            "general": {"stop_time": "1 s"},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "host_option_defaults": {"tcp_send_buffer": "64 KiB",
+                                     "tcp_nagle": True},
+            "hosts": {
+                "m": {
+                    "network_node_id": 0,
+                    "host_options": {"tcp_recv_buffer": "128 KiB",
+                                     "tcp_autotune": False},
+                    "processes": [{"path": "udp_blast",
+                                   "args": ["server=m", "port=1", "count=1"]}],
+                },
+            },
+        }
+    )
+    graph = load_graph(cfg.network.graph)
+    (spec,) = expand_hosts_hybrid(cfg, graph)
+    t = spec.tcp_cfg
+    assert t.send_buf == 64 * 1024  # cascaded default
+    assert t.recv_buf == 128 * 1024  # per-host override
+    assert t.nagle is True and t.autotune is False
+    # unknown knobs are named loudly
+    import pytest
+
+    with pytest.raises(ConfigError, match="tcp_typo"):
+        ConfigOptions.from_dict(
+            {
+                "general": {"stop_time": "1 s"},
+                "network": {"graph": {"type": "1_gbit_switch"}},
+                "host_option_defaults": {"tcp_typo": 1},
+                "hosts": {"m": {"network_node_id": 0, "processes": [
+                    {"model": "timer"}]}},
+            }
+        )
+
+
+def test_syn_carries_sack_ok_on_wire():
+    client, server, wire = handshake()
+    client.send(b"z" * 100)
+    wire.run(until=lambda: server.rcv_buf.readable() == 100)
+    # the handshake helper feeds the SYN directly; check the SYN-ACK too
+    synacks = [s for _, snd, s in wire.sent if snd == "b" and s.flags & SYN]
+    assert all(s.sack_ok for s in synacks)
