@@ -146,6 +146,12 @@ class ExperimentalOptions:
     # most urgent events (tested contract); "append" is cheaper on TPU and
     # identical whenever queues are sized to never overflow
     overflow_shed: str = "urgency"
+    # multi-device cross-shard exchange: "gather" replicates the outbox to
+    # every shard; "alltoall" moves destination-sharded blocks so per-shard
+    # ICI bytes and merge input are O(global sends / world) — identical
+    # results while stats.a2a_shed stays 0 (see EngineConfig.exchange)
+    exchange: str = "gather"
+    a2a_block: int = 0  # entries per (src, dst-shard) block; 0 = auto
     # CPU model: simulated computation time charged per handled event
     # (reference host/cpu.rs; 0 = off). Applies to device-modeled hosts;
     # the pure-CPU oracle scheduler does not model it.
@@ -203,6 +209,20 @@ class ExperimentalOptions:
                 setattr(e, f, str(d.pop(f)))
         if "overflow_shed" in d:
             e.overflow_shed = str(d.pop("overflow_shed"))
+        if "exchange" in d:
+            e.exchange = str(d.pop("exchange"))
+        if "a2a_block" in d:
+            e.a2a_block = int(d.pop("a2a_block"))
+        if e.a2a_block < 0:
+            raise ConfigError(
+                f"experimental.a2a_block must be >= 0 (0 = auto), "
+                f"got {e.a2a_block}"
+            )
+        if e.exchange not in ("gather", "alltoall"):
+            raise ConfigError(
+                f"experimental.exchange must be gather|alltoall, "
+                f"got {e.exchange!r}"
+            )
         if "cpu_delay" in d:
             e.cpu_delay = parse_time_ns(d.pop("cpu_delay"), TimeUnit.MS)
         if e.strace_logging_mode not in ("off", "standard", "deterministic"):

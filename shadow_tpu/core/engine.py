@@ -117,6 +117,7 @@ class Stats(NamedTuple):
     monotonic_violations: Array  # i64[H] pushes scheduled in the past
     pkts_budget_dropped: Array  # i64[H] over the per-host round send budget
     ob_dropped: Array  # i64[1] outbox-overflow losses (invariant check: always 0)
+    a2a_shed: Array  # i64[1] all-to-all block-overflow losses (size blocks so 0)
     microsteps: Array  # i64[1] total microsteps (per shard)
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
@@ -211,6 +212,19 @@ class EngineConfig:
     microstep_limit: int = 0  # 0 -> queue_capacity * 2
     rounds_per_chunk: int = 64
     world: int = 1  # mesh size (1 = single device)
+    # cross-shard exchange strategy (multi-device only):
+    #   "gather"   — all_gather the full outbox to every shard; each shard
+    #                filters its rows. Exact, but per-shard ICI bytes and
+    #                merge input grow O(world).
+    #   "alltoall" — sort the local outbox by destination shard and
+    #                lax.all_to_all fixed-width blocks: per-shard ICI bytes
+    #                and merge input are O(global sends / world). Blocks
+    #                hold `a2a_block` entries per (src, dst-shard) pair;
+    #                overflow sheds the LATEST entries per the urgency
+    #                contract and counts in stats.a2a_shed (size the block
+    #                so it stays 0 — every test asserts it).
+    exchange: str = "gather"
+    a2a_block: int = 0  # 0 -> auto: 2 * outbox_rows / world, >= 64
 
     def __post_init__(self):
         check_order_limits(self.num_hosts)
@@ -219,6 +233,21 @@ class EngineConfig:
                 f"num_hosts={self.num_hosts} must divide evenly over "
                 f"world={self.world} mesh devices"
             )
+        if self.exchange not in ("gather", "alltoall"):
+            raise ValueError(
+                f"exchange must be gather|alltoall, got {self.exchange!r}"
+            )
+        if self.a2a_block < 0:
+            raise ValueError(
+                f"a2a_block must be >= 0 (0 = auto), got {self.a2a_block}"
+            )
+
+    @property
+    def a2a_block_size(self) -> int:
+        if self.a2a_block:
+            return self.a2a_block
+        rows = self.hosts_per_shard * self.sends_per_host_round
+        return min(rows, max(64, 2 * rows // max(self.world, 1)))
 
     @property
     def hosts_per_shard(self) -> int:
@@ -264,6 +293,7 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         monotonic_violations=zi(),
         pkts_budget_dropped=zi(),
         ob_dropped=jnp.zeros((cfg.world,), jnp.int64),
+        a2a_shed=jnp.zeros((cfg.world,), jnp.int64),
         microsteps=jnp.zeros((cfg.world,), jnp.int64),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
         rounds=jnp.zeros((), jnp.int64),
@@ -502,6 +532,7 @@ class Engine:
                 monotonic_violations=sh,
                 pkts_budget_dropped=sh,
                 ob_dropped=sh,
+                a2a_shed=sh,
                 microsteps=sh,
                 digest=sh,
                 rounds=rep,
@@ -1011,6 +1042,8 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
 
 
 def _exchange(cfg, axis, st: SimState):
+    if axis and cfg.exchange == "alltoall":
+        return _exchange_alltoall(cfg, axis, st)
     ob = st.outbox
     if axis:
         g = jax.tree.map(
@@ -1035,52 +1068,16 @@ def _exchange(cfg, axis, st: SimState):
         g.payload.reshape(-1, g.payload.shape[-1]), valid,
     )
     has_sends = jnp.sum(g.count) > 0
-    # the merge's sort dominates round cost; rounds where NO shard sent
-    # anything (timer-heavy workloads, drained phases) skip it entirely.
-    # g.count is identical on all shards post-gather, so the branch is
-    # uniform across the mesh. The cond wraps only the PLAN (sort +
-    # gathers): branches returning the whole queue forced XLA to copy
-    # every slab at the branch boundary each round — traced at ~55% of
-    # the PHOLD-torus round cost — while the plan is one packed [H, C, W]
-    # block and the apply runs unconditionally as a single where-pass.
-    if jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
-        # Fused merge inside the cond. On CPU the scatter path is faster
-        # and branch copies are cheap. On TPU this wins at SMALL slab
-        # capacities (measured: PHOLD-torus cap 16 ran 40% slower with the
-        # plan split — the [H, C, W] plan materialization costs more than
-        # the small branch-boundary copies it avoids; at cap >= ~48 the
-        # copy volume dominates and the split below wins).
-        queue = lax.cond(
-            has_sends,
-            lambda queue: merge_flat_events(
-                queue, *flat, cfg.max_round_inserts,
-                shed_urgency=not cfg.cheap_shed,
-            ),
-            lambda queue: queue,
-            st.queue,
-        )
-    else:
-        from shadow_tpu.ops.merge import (
-            merge_apply,
-            merge_empty_plan,
-            merge_plan,
-        )
+    queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
+    return st._replace(
+        queue=queue,
+        outbox=_fresh_outbox(ob),
+        sent_round=jnp.zeros_like(st.sent_round),
+    )
 
-        p_words = g.payload.shape[-1]
-        # the cond consumes ONLY the time plane (free-slot source): feeding
-        # the whole queue through would add a second consumer per slab and
-        # reintroduce the branch-boundary copies this split removes
-        take, gw, dropped_add = lax.cond(
-            has_sends,
-            lambda q_t: merge_plan(
-                q_t, *flat, cfg.max_round_inserts,
-                shed_urgency=not cfg.cheap_shed,
-            ),
-            lambda q_t: merge_empty_plan(q_t, p_words),
-            st.queue.t,
-        )
-        queue = merge_apply(st.queue, take, gw, dropped_add)
-    fresh = Outbox(
+
+def _fresh_outbox(ob: Outbox) -> Outbox:
+    return Outbox(
         dst=jnp.zeros_like(ob.dst),
         t=jnp.full_like(ob.t, TIME_MAX),
         order=jnp.zeros_like(ob.order),
@@ -1088,6 +1085,168 @@ def _exchange(cfg, axis, st: SimState):
         payload=jnp.zeros_like(ob.payload),
         count=jnp.zeros_like(ob.count),
     )
-    return st._replace(
-        queue=queue, outbox=fresh, sent_round=jnp.zeros_like(st.sent_round)
+
+
+def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
+    """Insert flat (local, t, order, kind, payload, valid) rows, skipping
+    the merge in empty rounds.
+
+    The merge's sort dominates round cost; rounds where NO shard sent
+    anything (timer-heavy workloads, drained phases) skip it entirely —
+    `has_sends` is identical on all shards, so the branch is uniform
+    across the mesh. The cond wraps only the PLAN (sort + gathers) at
+    large capacities: branches returning the whole queue forced XLA to
+    copy every slab at the branch boundary each round — traced at ~55% of
+    the PHOLD-torus round cost — while the plan is one packed [H, C, W]
+    block and the apply runs unconditionally as a single where-pass."""
+    if jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
+        # Fused merge inside the cond. On CPU the scatter path is faster
+        # and branch copies are cheap. On TPU this wins at SMALL slab
+        # capacities (measured: PHOLD-torus cap 16 ran 40% slower with the
+        # plan split — the [H, C, W] plan materialization costs more than
+        # the small branch-boundary copies it avoids; at cap >= ~48 the
+        # copy volume dominates and the split below wins).
+        return lax.cond(
+            has_sends,
+            lambda queue: merge_flat_events(
+                queue, *flat, cfg.max_round_inserts,
+                shed_urgency=not cfg.cheap_shed,
+            ),
+            lambda queue: queue,
+            queue0,
+        )
+    from shadow_tpu.ops.merge import merge_apply, merge_empty_plan, merge_plan
+
+    p_words = flat[4].shape[-1]
+    # the cond consumes ONLY the time plane (free-slot source): feeding
+    # the whole queue through would add a second consumer per slab and
+    # reintroduce the branch-boundary copies this split removes
+    take, gw, dropped_add = lax.cond(
+        has_sends,
+        lambda q_t: merge_plan(
+            q_t, *flat, cfg.max_round_inserts,
+            shed_urgency=not cfg.cheap_shed,
+        ),
+        lambda q_t: merge_empty_plan(q_t, p_words),
+        queue0.t,
     )
+    return merge_apply(queue0, take, gw, dropped_add)
+
+
+def _exchange_alltoall(cfg, axis, st: SimState):
+    """Destination-sharded exchange (VERDICT r4 #4): instead of replicating
+    the whole outbox to every shard (O(world) ICI bytes and merge input per
+    shard), sort the LOCAL outbox by destination shard and move fixed-width
+    blocks with `lax.all_to_all`.
+
+    Cost model (written out in BASELINE.md): with S = global sends/round
+    and W = shard count, the gather exchange moves (W-1) x rows_local x
+    row_bytes per shard over ICI and feeds W x rows_local rows into every
+    shard's merge sort; this path moves ~rows_local x row_bytes and feeds
+    ~rows_local rows — both O(S / W) for balanced traffic.
+
+    Determinism: rows are grouped per destination shard in (t, order)
+    urgency order, so when a block overflows the LATEST entries shed —
+    the same contract as the merge — and the final per-queue insertion
+    order is re-derived by the merge sort from (dst, t, order), identical
+    to the gather path whenever nothing sheds (`stats.a2a_shed` counts
+    sheds; size `a2a_block` so it stays zero)."""
+    ob = st.outbox
+    h_local = st.queue.t.shape[0]
+    world = cfg.world
+    k = cfg.a2a_block_size
+    n_loc = ob.t.shape[0] * ob.t.shape[1]
+    my = lax.axis_index(axis).astype(jnp.int32)
+
+    dst_f = ob.dst.reshape(-1)
+    t_f = ob.t.reshape(-1)
+    order_f = ob.order.reshape(-1)
+    kind_f = ob.kind.reshape(-1)
+    payload_f = ob.payload.reshape(-1, ob.payload.shape[-1])
+    valid = t_f != TIME_MAX
+    dshard = jnp.where(valid, dst_f // h_local, world).astype(jnp.int32)
+
+    # sort rows by (dst shard, t, order) plus one token per shard group —
+    # the same token trick the merge uses for segment extraction
+    iota = jnp.arange(n_loc, dtype=jnp.int32)
+    q_keys = jnp.arange(world + 1, dtype=jnp.int32)
+    all_sh = jnp.concatenate([dshard, q_keys])
+    all_t = jnp.concatenate([t_f, jnp.full((world + 1,), -1, t_f.dtype)])
+    all_o = jnp.concatenate(
+        [order_f, jnp.full((world + 1,), -1, order_f.dtype)]
+    )
+    all_idx = jnp.concatenate(
+        [iota + 1, jnp.zeros((world + 1,), jnp.int32)]
+    )
+    s_sh, _, _, s_tag = lax.sort((all_sh, all_t, all_o, all_idx), num_keys=3)
+    m = n_loc + world + 1
+    is_tok = s_tag == 0
+    key2 = jnp.where(is_tok, s_sh, jnp.int32(world + 1))
+    pos = jnp.arange(m, dtype=jnp.int32)
+    _, tok_pos = lax.sort((key2, pos), num_keys=1, is_stable=True)
+    first = tok_pos[: world + 1]
+    seg_len = first[1:] - first[:-1] - 1  # i32[world]
+
+    # pack rows (dst word + event words) and permute into sorted order
+    words = jnp.concatenate(
+        [
+            dst_f[:, None].astype(jnp.int32),
+            _pack_words_rows(t_f, order_f, kind_f, payload_f),
+        ],
+        axis=1,
+    )
+    s_idx = s_tag - 1
+    w_sorted = words[s_idx]  # [M, W+1]; token rows harmless (never taken)
+
+    # block j carries group j's first k rows (urgency order); later rows shed
+    rr = jnp.arange(k, dtype=jnp.int32)
+    in_seg = rr[None, :] < jnp.minimum(seg_len, k)[:, None]  # [world, k]
+    src_pos = jnp.where(in_seg, first[:world, None] + 1 + rr[None, :], 0)
+    blocks = w_sorted[src_pos]  # [world, k, W+1]
+    inval = _invalid_row(ob.payload.shape[-1])
+    blocks = jnp.where(in_seg[:, :, None], blocks, inval[None, None, :])
+    shed = jnp.sum(
+        jnp.maximum(seg_len - k, 0), dtype=jnp.int64
+    )
+
+    recv = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+    flat_rows = recv.reshape(world * k, -1)
+    r_dst = flat_rows[:, 0]
+    r_t, r_order, r_kind, r_payload = _unpack_words_rows(
+        flat_rows[:, 1:], ob.payload.shape[-1]
+    )
+    local = r_dst - my * h_local
+    r_valid = (r_t != TIME_MAX) & (local >= 0) & (local < h_local)
+    flat = (local, r_t, r_order, r_kind, r_payload, r_valid)
+
+    has_sends = lax.psum(jnp.sum(ob.count), axis) > 0
+    queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
+    stats = st.stats._replace(a2a_shed=st.stats.a2a_shed + shed[None])
+    return st._replace(
+        queue=queue,
+        outbox=_fresh_outbox(ob),
+        sent_round=jnp.zeros_like(st.sent_round),
+        stats=stats,
+    )
+
+
+def _pack_words_rows(t, order, kind, payload):
+    from shadow_tpu.ops.merge import _pack_words
+
+    return _pack_words(t, order, kind.astype(jnp.int32), payload)
+
+
+def _unpack_words_rows(g, p_words):
+    from shadow_tpu.ops.merge import _unpack_words
+
+    return _unpack_words(g, p_words)
+
+
+def _invalid_row(p_words: int):
+    """A packed row whose unpack yields t == TIME_MAX (the empty marker)."""
+    t = jnp.full((1,), TIME_MAX, jnp.int64)
+    o = jnp.full((1,), ORDER_MAX, jnp.int64)
+    row = _pack_words_rows(
+        t, o, jnp.zeros((1,), jnp.int32), jnp.zeros((1, p_words), jnp.int32)
+    )[0]
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32), row])

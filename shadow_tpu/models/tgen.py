@@ -191,7 +191,9 @@ class TgenTcpModel:
             "recover": jnp.full((h,), -1, jnp.int32),
             "srtt": zi64(),  # 0 = no sample yet (RFC 6298 first-sample rule)
             "rttvar": zi64(),
-            "rto": jnp.asarray(params["rto_init"]),
+            # copy, don't alias: state is DONATED to the jitted chunk while
+            # params ride alongside — sharing a buffer is a donation error
+            "rto": jnp.array(params["rto_init"], copy=True),
             "rtt_seq": jnp.full((h,), -1, jnp.int32),
             "rtt_t0": zi64(),
             "deadline": jnp.full((h,), TIME_MAX, jnp.int64),

@@ -386,6 +386,8 @@ class Simulation:
             ),
             cheap_shed=ex.overflow_shed == "append",
             cpu_delay_ns=ex.cpu_delay,
+            exchange=ex.exchange,
+            a2a_block=ex.a2a_block,
         )
         mesh = None
         if world > 1:

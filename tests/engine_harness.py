@@ -27,6 +27,7 @@ def build_sim(
     use_codel: bool = True,
     cpu_delay_ns: int = 0,
     jitter: int = 0,
+    exchange: str = "gather",
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -45,6 +46,7 @@ def build_sim(
         use_codel=use_codel,
         cpu_delay_ns=cpu_delay_ns,
         use_jitter=jitter > 0,
+        exchange=exchange,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)
