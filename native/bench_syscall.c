@@ -1,0 +1,63 @@
+/* Syscall round-trip microbenchmark guest (VERDICT r4 #5).
+ *
+ * Hammers EMULATED syscall arms in a tight loop so the host can measure
+ * the full futex-channel round trip (seccomp trap -> shim -> IPC ->
+ * Python dispatch -> reply -> resume). Modes:
+ *   fcntl  — fcntl(F_GETFL) on an emulated pipe vfd: the minimal arm
+ *            (no memory traffic, no blocking) = pure round-trip cost
+ *   pipe   — write(1 byte) + read(1 byte) through an emulated pipe:
+ *            the hot data-path arms with guest-memory access
+ *   clock  — clock_gettime(CLOCK_MONOTONIC): answered SHIM-LOCALLY from
+ *            shared memory (reference shim_sys.c precedent) = the
+ *            no-round-trip baseline the other modes are compared against
+ */
+#include <fcntl.h>
+#include <stdio.h>
+#include <sys/syscall.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <fcntl|pipe|clock> <iters>\n", argv[0]);
+        return 2;
+    }
+    long n = atol(argv[2]);
+    int fds[2];
+    if (pipe(fds) != 0) {
+        perror("pipe");
+        return 1;
+    }
+    if (!strcmp(argv[1], "fcntl")) {
+        long acc = 0;
+        for (long i = 0; i < n; i++) acc += fcntl(fds[0], F_GETFL);
+        printf("fcntl done %ld acc=%ld\n", n, acc);
+    } else if (!strcmp(argv[1], "pipe")) {
+        char b = 'x';
+        for (long i = 0; i < n; i++) {
+            if (write(fds[1], &b, 1) != 1 || read(fds[0], &b, 1) != 1) {
+                perror("pipe rw");
+                return 1;
+            }
+        }
+        printf("pipe done %ld\n", n);
+    } else if (!strcmp(argv[1], "getpid")) {
+        /* identity fast path: answered shim-locally from the ids block */
+        long acc = 0;
+        for (long i = 0; i < n; i++) acc += syscall(SYS_getpid);
+        printf("getpid done %ld acc=%ld\n", n, acc);
+    } else if (!strcmp(argv[1], "clock")) {
+        struct timespec ts;
+        long acc = 0;
+        for (long i = 0; i < n; i++) {
+            clock_gettime(CLOCK_MONOTONIC, &ts);
+            acc += ts.tv_nsec;
+        }
+        printf("clock done %ld acc=%ld\n", n, acc);
+    } else {
+        return 2;
+    }
+    return 0;
+}

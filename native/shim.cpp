@@ -565,6 +565,29 @@ extern "C" void shadow_shim_handle_sigsys(int sig, siginfo_t *info,
         ret = time_escape() ? forward_syscall(num, args)
                             : emulate_time_syscall(num, args[0], args[1]);
         break;
+    case SYS_getpid:
+    case SYS_getppid:
+    case SYS_getuid:
+    case SYS_geteuid:
+    case SYS_getgid:
+    case SYS_getegid:
+        /* identity fast path: virtual ids from shared memory, no round
+         * trip (ids are constant between set*id calls, which the
+         * simulator mirrors into the block). Same Nth-call escape as the
+         * time path so a getpid busy-loop cannot freeze simulated time. */
+        if (__atomic_load_n(&g_ipc->ids_valid, __ATOMIC_ACQUIRE) &&
+            !time_escape()) {
+            switch (num) {
+            case SYS_getpid: ret = g_ipc->virt_pid; break;
+            case SYS_getppid: ret = g_ipc->virt_ppid; break;
+            case SYS_getuid:
+            case SYS_geteuid: ret = g_ipc->virt_uid; break;
+            default: ret = g_ipc->virt_gid; break;
+            }
+        } else {
+            ret = forward_syscall(num, args);
+        }
+        break;
     case SYS_clock_getres: {
         struct timespec *ts = (struct timespec *)args[1];
         if (ts) {

@@ -248,6 +248,11 @@ def save_checkpoint_hybrid(path: str, hsim) -> str:
                 "now": h.now(),
                 "seq": h._seq,
                 "counters": h.counters,
+                "if_counters": h.if_counters,
+                "closed_socket_stats": h.closed_socket_stats,
+                "heartbeats": h.heartbeats,
+                "hb_prev": h._hb_prev,
+                "hb_closed_seen": sorted(h._hb_closed_seen),
                 "procs": [
                     {
                         "pid": p.pid,
@@ -325,6 +330,12 @@ def load_checkpoint_hybrid(path: str, hsim) -> None:
         h._now = rec["now"]
         h._seq = rec["seq"]
         h.counters.update(rec["counters"])
+        for k, v in rec.get("if_counters", {}).items():
+            h.if_counters[k].update(v)
+        h.closed_socket_stats = list(rec.get("closed_socket_stats", []))
+        h.heartbeats = list(rec.get("heartbeats", []))
+        h._hb_prev = rec.get("hb_prev")
+        h._hb_closed_seen = set(rec.get("hb_closed_seen", []))
         recs = {pr["pid"]: pr for pr in rec["procs"]}
         for p in h.processes.values():
             pr = recs.get(p.pid)

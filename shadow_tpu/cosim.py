@@ -485,6 +485,10 @@ class HybridSimulation:
                     f"{simmod.resource_heartbeat()}",
                     file=log,
                 )
+                # per-host tracker interval (per-socket/per-interface
+                # deltas, reference tracker.c heartbeats)
+                for h in self.hosts:
+                    h.record_heartbeat(window_end)
                 next_hb = (window_end // hb_ns + 1) * hb_ns
             if show_progress:
                 pct = min(100.0, 100.0 * window_end / max(stop, 1))
@@ -727,7 +731,20 @@ class HybridSimulation:
                 with open(base + ".stderr", "wb") as f:
                     f.write(b"".join(p.stderr))
             with open(os.path.join(hd, "host-stats.json"), "w") as f:
-                json.dump({"name": spec.name, "ip": spec.ip, **host.counters}, f)
+                json.dump(
+                    {
+                        "name": spec.name,
+                        "ip": spec.ip,
+                        **host.counters,
+                        # tracker.c:24-80 analogue: cumulative per-interface
+                        # + per-socket wire counters and the per-heartbeat
+                        # interval deltas recorded during the run
+                        "interfaces": host.if_counters,
+                        "sockets": host.socket_stats(),
+                        "heartbeats": host.heartbeats,
+                    },
+                    f,
+                )
         return data_dir
 
 

@@ -82,6 +82,80 @@ class CpuHost:
             "bytes_recv": 0,
             "syscalls": 0,
         }
+        # per-interface + per-socket byte/packet counters
+        # (tracker.c:24-80 — the reference tracker reports both per
+        # heartbeat interval; sockets are attributed by (proto, port)
+        # lookup at send/deliver time, closed sockets keep their totals)
+        self.if_counters = {
+            name: {"tx_pkts": 0, "tx_bytes": 0, "rx_pkts": 0, "rx_bytes": 0}
+            for name in ("lo", "eth0")
+        }
+        self.closed_socket_stats: list[dict] = []
+        self.heartbeats: list[dict] = []
+        self._hb_prev: dict | None = None
+        self._hb_closed_seen: set[int] = set()
+
+    # ---- tracker heartbeats (tracker.c:24-80) ------------------------------
+
+    def socket_stats(self) -> list[dict]:
+        """Per-socket cumulative tx/rx counters, live + closed."""
+        out = list(self.closed_socket_stats)
+        for sock in self.netns.live_sockets():
+            out.append(sock.stat_record())
+        return out
+
+    def record_heartbeat(self, t_ns: int) -> dict:
+        """Snapshot per-interface and per-socket counters as DELTAS since
+        the previous heartbeat (the reference tracker logs per-interval
+        numbers, not cumulative ones). A closed socket appears in exactly
+        ONE interval record (its final delta) and is then excluded from
+        the baseline — otherwise long many-connection runs would re-scan
+        every socket ever closed on each heartbeat."""
+        live = {
+            s["id"]: s
+            for s in (sk.stat_record() for sk in self.netns.live_sockets())
+        }
+        closed_new = {
+            s["id"]: s
+            for s in self.closed_socket_stats
+            if s["id"] not in self._hb_closed_seen
+        }
+        cur = {
+            "interfaces": {k: dict(v) for k, v in self.if_counters.items()},
+            "sockets": {**closed_new, **live},
+        }
+        prev = self._hb_prev or {"interfaces": {}, "sockets": {}}
+
+        def delta(now_d, prev_d):
+            return {
+                k: now_d[k] - prev_d.get(k, 0)
+                for k in ("tx_pkts", "tx_bytes", "rx_pkts", "rx_bytes")
+            }
+
+        rec = {
+            "t_ns": t_ns,
+            "interfaces": {
+                k: delta(v, prev["interfaces"].get(k, {}))
+                for k, v in cur["interfaces"].items()
+            },
+            "sockets": [
+                {**{f: s[f] for f in ("id", "proto", "local", "peer")},
+                 **delta(s, prev["sockets"].get(s["id"], {}))}
+                for s in cur["sockets"].values()
+            ],
+        }
+        # drop all-zero socket rows: long-lived idle sockets would bloat
+        # every interval record
+        rec["sockets"] = [
+            s for s in rec["sockets"]
+            if s["tx_pkts"] or s["rx_pkts"] or s["tx_bytes"] or s["rx_bytes"]
+        ]
+        self._hb_closed_seen.update(closed_new)
+        # baseline keeps only LIVE sockets: closed ones were just reported
+        # for the last time and can never change again
+        self._hb_prev = {"interfaces": cur["interfaces"], "sockets": live}
+        self.heartbeats.append(rec)
+        return rec
 
     # ---- clock & scheduling (TimerFd Scheduler protocol) -------------------
 
@@ -144,6 +218,15 @@ class CpuHost:
     def send_packet(self, pkt: NetPacket):
         self.counters["pkts_sent"] += 1
         self.counters["bytes_sent"] += pkt.size_bytes
+        iface = "lo" if pkt.dst_ip in ("127.0.0.1", self.ip) else "eth0"
+        ifc = self.if_counters[iface]
+        ifc["tx_pkts"] += 1
+        ifc["tx_bytes"] += pkt.size_bytes
+        sock = self.netns.socket_for_local(pkt.proto, pkt.src_port,
+                                           pkt.dst_ip, pkt.dst_port)
+        if sock is not None:
+            sock.stat["tx_pkts"] += 1
+            sock.stat["tx_bytes"] += pkt.size_bytes
         if pkt.dst_ip in ("127.0.0.1", self.ip):
             if self.pcap_lo is not None:
                 self.pcap_lo.write(self._now, pkt)
@@ -164,6 +247,14 @@ class CpuHost:
         show up on the eth0 capture."""
         self.counters["pkts_recv"] += 1
         self.counters["bytes_recv"] += pkt.size_bytes
+        ifc = self.if_counters["lo" if iface == "lo" else "eth0"]
+        ifc["rx_pkts"] += 1
+        ifc["rx_bytes"] += pkt.size_bytes
+        sock = self.netns.socket_for_local(pkt.proto, pkt.dst_port,
+                                           pkt.src_ip, pkt.src_port)
+        if sock is not None:
+            sock.stat["rx_pkts"] += 1
+            sock.stat["rx_bytes"] += pkt.size_bytes
         if iface == "eth" and self.pcap_eth is not None:
             self.pcap_eth.write(self._now, pkt)
         CallbackQueue.run(lambda q: self.netns.deliver(pkt))

@@ -32,6 +32,33 @@ class NetworkNamespace:
         self._next_ephemeral = EPHEMERAL_START
         # abstract unix-domain namespace (reference abstract_unix_ns.rs)
         self.abstract_unix: dict[str, object] = {}
+        self._sock_serial = 0
+
+    # ---- tracker support (tracker.c per-socket counters) -------------------
+
+    def next_sock_id(self) -> int:
+        self._sock_serial += 1
+        return self._sock_serial
+
+    def socket_for_local(self, proto: int, local_port: int,
+                         remote_ip: str, remote_port: int):
+        """Most-specific socket owning (proto, local_port) traffic with the
+        given remote endpoint — flow first, then port binding (the demux
+        rule, reused for counter attribution)."""
+        if proto == PROTO_TCP:
+            flow = self._flows.get(
+                (PROTO_TCP, local_port, remote_ip, remote_port)
+            )
+            if flow is not None:
+                return flow
+        return self._ports.get((proto, local_port))
+
+    def live_sockets(self):
+        seen = set()
+        for sock in list(self._ports.values()) + list(self._flows.values()):
+            if id(sock) not in seen:
+                seen.add(id(sock))
+                yield sock
 
     # ---- binding -----------------------------------------------------------
 
@@ -69,6 +96,15 @@ class NetworkNamespace:
             fkey = (PROTO_TCP, sock.local_port, sock.peer_ip, sock.peer_port)
             if self._flows.get(fkey) is sock:
                 del self._flows[fkey]
+        # tracker: keep the totals of any socket that saw traffic (the
+        # reference reports until-close activity, not just live sockets).
+        # Here because every socket type funnels through unbind at
+        # teardown, including TcpSocket whose close() bypasses the base.
+        if not getattr(sock, "_stats_recorded", False) and any(
+            sock.stat.values()
+        ):
+            sock._stats_recorded = True
+            self.host.closed_socket_stats.append(sock.stat_record())
 
     # ---- demux -------------------------------------------------------------
 

@@ -51,10 +51,28 @@ class _SocketBase(File):
         self.local_port: int | None = None
         self.peer_ip: str | None = None
         self.peer_port: int | None = None
+        # per-socket wire counters (reference tracker.c:24-80); attributed
+        # centrally in CpuHost.send_packet/deliver_packet by port lookup
+        self.sock_id = netns.next_sock_id()
+        self.stat = {"tx_pkts": 0, "tx_bytes": 0, "rx_pkts": 0, "rx_bytes": 0}
 
     @property
     def host(self):
         return self.netns.host
+
+    def stat_record(self) -> dict:
+        peer = (
+            f"{self.peer_ip}:{self.peer_port}"
+            if self.peer_port is not None
+            else None
+        )
+        return {
+            "id": self.sock_id,
+            "proto": "tcp" if self.PROTO == PROTO_TCP else "udp",
+            "local": f"{self.local_ip or '*'}:{self.local_port or 0}",
+            "peer": peer,
+            **self.stat,
+        }
 
     def bind(self, ip: str, port: int):
         if self.local_port is not None:
@@ -68,6 +86,9 @@ class _SocketBase(File):
     def close(self):
         if self.closed:
             return
+        # final stat capture happens in netns.unbind — the teardown point
+        # ALL socket types funnel through (TcpSocket.close does not call
+        # super(); its flow unbinds from _after_tcp when fully closed)
         self.netns.unbind(self)
         super().close()
 

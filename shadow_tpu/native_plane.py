@@ -52,7 +52,10 @@ CHANPAIR_SIZE = 160
 PAIR_TO_SHIM_OFF = 80
 HEAP_START_OFF = THREADS_OFF + IPC_MAX_THREADS * CHANPAIR_SIZE
 # + heap_start/heap_cur (MemoryMapper) + fork_sync barrier + pad
-IPC_SIZE = HEAP_START_OFF + 16 + 8
+FORK_SYNC_OFF = HEAP_START_OFF + 16
+# shim-local identity fast path: ids_valid u32 + pid/ppid/uid/gid i32 + pad
+IDS_OFF = FORK_SYNC_OFF + 8
+IPC_SIZE = IDS_OFF + 24
 HEAP_MAX = 256 << 20  # SHADOW_HEAP_MAX in ipc.h
 
 _libc = ctypes.CDLL(None, use_errno=True)
@@ -419,6 +422,12 @@ class IpcBlock:
                 self._base + DOORBELL_OFF, FUTEX_WAIT, bell,
                 min(remaining, 0.2),
             )
+
+    def publish_ids(self, pid: int, ppid: int, uid: int, gid: int):
+        """Mirror the virtual identity into shared memory so the shim
+        answers getpid/getppid/get[e]uid/get[e]gid locally (ipc.h ids
+        block). Call whenever an id changes (spawn, fork, exec, set*id)."""
+        struct.pack_into("<Iiiii", self._mm, IDS_OFF, 1, pid, ppid, uid, gid)
 
     def reply(self, kind: int, ret: int = 0):
         self.reply_slot(self.cur_slot, kind, ret)
@@ -1123,8 +1132,17 @@ class NativeProcess:
             self._die(97)
             return
         self._register_heap()  # MemoryMapper window (set up pre-handshake)
+        self._publish_ids()
         self.ipc.reply_slot(0, MSG_START_OK)
         self._service_loop()
+
+    def _publish_ids(self):
+        self.ipc.publish_ids(
+            self.pid,
+            self.parent.pid if self.parent is not None else 1,
+            self._uid,
+            self._gid,
+        )
 
     def _register_heap(self):
         """Map the shim's shared heap file so _vm_* serve heap accesses by
@@ -1515,6 +1533,7 @@ class NativeProcess:
         child._stdio_overridden = set(self._stdio_overridden)
         child._vfd_cloexec = set(self._vfd_cloexec)
         child._uid, child._gid = self._uid, self._gid
+        child._publish_ids()
         for sock in child._vfds.values():
             sock._nrefs = getattr(sock, "_nrefs", 1) + 1
         self._pending_forks[fork_id] = child
@@ -2244,6 +2263,7 @@ class NativeProcess:
                 eff = _take(args[1])
                 if eff is not None:
                     setattr(self, attr, eff)
+            self._publish_ids()  # keep the shim-local fast path coherent
             self.ipc.reply(MSG_SYSCALL_COMPLETE, 0)
             return False
         if num in (SYS["getuid"], SYS["geteuid"]):
@@ -4066,6 +4086,7 @@ class NativeProcess:
             self._die(97)
             return True
         self._register_heap()  # the new image set up its own window
+        self._publish_ids()  # same pid/ids, NEW ipc block
         self.ipc.reply_slot(0, MSG_START_OK)
         return False  # service loop continues with the new image
 

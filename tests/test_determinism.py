@@ -59,11 +59,11 @@ def test_alltoall_exchange_tgen_tcp_mesh_invariant():
     hosts = mk_hosts(8, {"flow_segs": 24, "flows": 2, "cwnd_cap": 8,
                          "rto_min": "100 ms"})
     stop = 20_000_000_000
-    _, s1, r1 = __import__("tests.engine_harness", fromlist=["run_sim"]).run_sim(
+    _, s1, r1 = run_sim(
         "tgen_tcp", hosts, stop, world=1, loss=0.05, latency=10_000_000,
         sends_budget=24, qcap=64,
     )
-    _, sa, ra = __import__("tests.engine_harness", fromlist=["run_sim"]).run_sim(
+    _, sa, ra = run_sim(
         "tgen_tcp", hosts, stop, world=8, loss=0.05, latency=10_000_000,
         sends_budget=24, qcap=64, exchange="alltoall",
     )

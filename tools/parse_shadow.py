@@ -66,6 +66,26 @@ def parse_data_dir(data_dir: str) -> dict:
             )
             hosts[name] = entry
     out["hosts"] = hosts
+    # network totals across hosts (reference tracker.c counters rolled up:
+    # per-socket and per-interface tx/rx byte+packet sums)
+    totals = {"tx_pkts": 0, "tx_bytes": 0, "rx_pkts": 0, "rx_bytes": 0}
+    per_iface: dict = {}
+    n_sockets = 0
+    for entry in hosts.values():
+        st = entry.get("stats", {})
+        for name, ifc in (st.get("interfaces") or {}).items():
+            agg = per_iface.setdefault(name, dict(totals))
+            for k in totals:
+                agg[k] += ifc.get(k, 0)
+        for s in st.get("sockets") or []:
+            n_sockets += 1
+            for k in totals:
+                totals[k] += s.get(k, 0)
+    out["network_totals"] = {
+        "sockets": n_sockets,
+        "per_socket_sum": totals,
+        "per_interface_sum": per_iface,
+    }
     log_path = os.path.join(data_dir, "shadow.log")
     if os.path.exists(log_path):
         # per-host record attribution from the sim-time-stamped logger

@@ -1,0 +1,78 @@
+"""Syscall round-trip rate through the managed-process plane (VERDICT r4
+#5; reference managed_thread.rs:187-324 is the loop being measured).
+
+Measures WALL syscalls/sec for emulated arms (full futex-channel round
+trip: seccomp trap -> shim -> IPC futex -> Python dispatch -> reply ->
+futex resume) against the shim-local clock_gettime baseline (answered
+from shared memory with no context switch, the shim_sys.c:25-114
+precedent). Usage:
+
+    python tools/syscallbench.py [iters]
+
+Prints one JSON line; numbers land in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shadow_tpu.host import CpuHost, HostConfig  # noqa: E402
+from shadow_tpu.native_plane import ensure_built, spawn_native  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEC = 1_000_000_000
+
+
+def run_mode(mode: str, iters: int) -> dict:
+    h = CpuHost(HostConfig(name="bench", ip="10.0.0.1", seed=1, host_id=0))
+    binpath = os.path.join(REPO, "native", "build", "bench_syscall")
+    t0 = time.monotonic()
+    p = spawn_native(h, [binpath, mode, str(iters)])
+    h.execute(3600 * SEC)
+    wall = time.monotonic() - t0
+    out = b"".join(p.stdout).decode()
+    err = b"".join(p.stderr).decode()
+    assert p.exit_code == 0, (mode, out, err)
+    calls = iters * (2 if mode == "pipe" else 1)
+    return {
+        "mode": mode,
+        "iters": iters,
+        "emulated_calls": calls if mode != "clock" else 0,
+        "wall_s": round(wall, 3),
+        "calls_per_s": round(calls / wall),
+        "us_per_call": round(1e6 * wall / calls, 2),
+    }
+
+
+def main() -> int:
+    assert ensure_built(), "native plane unavailable"
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 30_000
+    rows = {
+        m: run_mode(m, iters) for m in ("clock", "getpid", "fcntl", "pipe")
+    }
+    # the clock mode's per-call time is the shim-local floor; the fcntl
+    # round trip minus that floor is the IPC + Python dispatch cost
+    rt = rows["fcntl"]["us_per_call"] - rows["clock"]["us_per_call"]
+    print(
+        json.dumps(
+            {
+                "clock_local_us": rows["clock"]["us_per_call"],
+                "getpid_local_us": rows["getpid"]["us_per_call"],
+                "fcntl_roundtrip_us": rows["fcntl"]["us_per_call"],
+                "pipe_rw_us": rows["pipe"]["us_per_call"],
+                "roundtrip_minus_local_us": round(rt, 2),
+                "fcntl_calls_per_s": rows["fcntl"]["calls_per_s"],
+                "pipe_calls_per_s": rows["pipe"]["calls_per_s"],
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
