@@ -152,6 +152,10 @@ class ExperimentalOptions:
     # results while stats.a2a_shed stays 0 (see EngineConfig.exchange)
     exchange: str = "gather"
     a2a_block: int = 0  # entries per (src, dst-shard) block; 0 = auto
+    # packet delivery breadcrumbs on the CPU host planes (reference
+    # packet.rs:16-39), debug-only: drops land in host-stats.json with
+    # their full hop trail
+    packet_breadcrumbs: bool = False
     # CPU model: simulated computation time charged per handled event
     # (reference host/cpu.rs; 0 = off). Applies to device-modeled hosts;
     # the pure-CPU oracle scheduler does not model it.
@@ -240,7 +244,7 @@ class ExperimentalOptions:
                 f"experimental.scheduler must be tpu|cpu-reference, "
                 f"got {e.scheduler!r}"
             )
-        for f in ("use_dynamic_runahead", "use_codel"):
+        for f in ("use_dynamic_runahead", "use_codel", "packet_breadcrumbs"):
             if f in d:
                 setattr(e, f, bool(d.pop(f)))
         for f in (

@@ -239,6 +239,7 @@ class HybridSimulation:
                     host_id=s.host_id,
                     model_unblocked_latency=cfg.general.model_unblocked_syscall_latency,
                     tcp=s.tcp_cfg,
+                    breadcrumbs=cfg.experimental.packet_breadcrumbs,
                 )
             )
             h.egress = self._stage_send
@@ -336,11 +337,9 @@ class HybridSimulation:
         # hosts serialize; native hosts block in futex waits off-GIL.
         self._host_pool = None
         if cfg.experimental.host_workers > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            from shadow_tpu.host.scheduler import WorkStealingPool
 
-            self._host_pool = ThreadPoolExecutor(
-                cfg.experimental.host_workers
-            )
+            self._host_pool = WorkStealingPool(cfg.experimental.host_workers)
 
         # jitted ops (shard-mapped over the mesh when world > 1, exactly
         # like Engine.run_chunk — staged-send arrays ride in replicated and
@@ -387,6 +386,7 @@ class HybridSimulation:
         dst_gid = self.ip_to_gid.get(pkt.dst_ip)
         if dst_gid is None:
             self._unreach[gid] += 1
+            host.drop_packet(pkt, "inet_no_route")
             return
         key = int(self._send_seq[gid] % (1 << 31))
         self._send_seq[gid] += 1
@@ -406,7 +406,7 @@ class HybridSimulation:
 
     def _execute_hosts(self, until: int):
         if self._host_pool is not None:
-            list(self._host_pool.map(lambda h: h.execute(until), self.hosts))
+            self._host_pool.run(self.hosts, lambda h: h.execute(until))
         else:
             for h in self.hosts:  # deterministic host order
                 h.execute(until)
@@ -497,7 +497,7 @@ class HybridSimulation:
                 self._gc_bytes()
         self._execute_hosts(stop)
         if self._host_pool is not None:
-            self._host_pool.shutdown(wait=False)
+            self._host_pool.shutdown()
             self._host_pool = None
         # snapshot final states BEFORE reaping: a daemon alive at stop_time
         # satisfies expected_final_state: running even though shutdown kills
@@ -742,6 +742,11 @@ class HybridSimulation:
                         "interfaces": host.if_counters,
                         "sockets": host.socket_stats(),
                         "heartbeats": host.heartbeats,
+                        **(
+                            {"packet_drops": host.packet_drops}
+                            if host.cfg.breadcrumbs
+                            else {}
+                        ),
                     },
                     f,
                 )

@@ -46,6 +46,10 @@ class HostConfig:
     # per-host TCP socket defaults (reference HostDefaultOptions socket
     # buffer/autotune knobs); None = TcpConfig() defaults
     tcp: Any = None
+    # packet delivery-status breadcrumbs (reference packet.rs:16-39),
+    # debug-only: every wire hop stamps the packet; drops are collected
+    # with their full trail in `packet_drops`
+    breadcrumbs: bool = False
 
 
 class CpuHost:
@@ -92,6 +96,8 @@ class CpuHost:
         }
         self.closed_socket_stats: list[dict] = []
         self.heartbeats: list[dict] = []
+        # breadcrumb drop log (bounded; debug flag HostConfig.breadcrumbs)
+        self.packet_drops: list[dict] = []
         self._hb_prev: dict | None = None
         self._hb_closed_seen: set[int] = set()
 
@@ -215,7 +221,25 @@ class CpuHost:
 
     # ---- packets -----------------------------------------------------------
 
+    def drop_packet(self, pkt: NetPacket, status: str):
+        """Terminal breadcrumb: record WHERE the packet died (bounded so a
+        pathological workload cannot eat the heap)."""
+        pkt.crumb(self._now, status)
+        if pkt.trail is not None and len(self.packet_drops) < 10_000:
+            self.packet_drops.append(
+                {
+                    "t_ns": self._now,
+                    "src": f"{pkt.src_ip}:{pkt.src_port}",
+                    "dst": f"{pkt.dst_ip}:{pkt.dst_port}",
+                    "proto": pkt.proto,
+                    "dropped_at": status,
+                    "trail": list(pkt.trail),
+                }
+            )
+
     def send_packet(self, pkt: NetPacket):
+        if self.cfg.breadcrumbs and pkt.trail is None:
+            pkt.trail = []
         self.counters["pkts_sent"] += 1
         self.counters["bytes_sent"] += pkt.size_bytes
         iface = "lo" if pkt.dst_ip in ("127.0.0.1", self.ip) else "eth0"
@@ -227,6 +251,8 @@ class CpuHost:
         if sock is not None:
             sock.stat["tx_pkts"] += 1
             sock.stat["tx_bytes"] += pkt.size_bytes
+        if pkt.trail is not None:  # guard: no f-string on the hot path
+            pkt.crumb(self._now, f"snd_{self.name}_{iface}")
         if pkt.dst_ip in ("127.0.0.1", self.ip):
             if self.pcap_lo is not None:
                 self.pcap_lo.write(self._now, pkt)
@@ -247,6 +273,8 @@ class CpuHost:
         show up on the eth0 capture."""
         self.counters["pkts_recv"] += 1
         self.counters["bytes_recv"] += pkt.size_bytes
+        if pkt.trail is not None:  # guard: no f-string on the hot path
+            pkt.crumb(self._now, f"rcv_{self.name}_{iface}")
         ifc = self.if_counters["lo" if iface == "lo" else "eth0"]
         ifc["rx_pkts"] += 1
         ifc["rx_bytes"] += pkt.size_bytes

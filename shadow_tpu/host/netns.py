@@ -115,6 +115,7 @@ class NetworkNamespace:
                 (PROTO_TCP, pkt.dst_port, pkt.src_ip, pkt.src_port)
             )
             if flow is not None:
+                pkt.crumb(self.host.now(), "rcv_flow_delivered")
                 flow.deliver(pkt)
                 return
         sock = self._ports.get((pkt.proto, pkt.dst_port))
@@ -123,6 +124,7 @@ class NetworkNamespace:
             return
         # no receiver: TCP answers RST (reference closed-port behavior),
         # UDP drops (ICMP unreachable is out of scope, as in the reference)
+        self.host.drop_packet(pkt, "rcv_no_listener")
         if pkt.proto == PROTO_TCP and pkt.seg is not None:
             rst = rst_for(pkt.seg)
             if rst is not None:

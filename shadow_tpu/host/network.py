@@ -57,9 +57,9 @@ class CpuNetwork:
         self._staged: list[list] = [[] for _ in hosts]
         self._pool = None
         if self.workers > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            from shadow_tpu.host.scheduler import WorkStealingPool
 
-            self._pool = ThreadPoolExecutor(self.workers)
+            self._pool = WorkStealingPool(self.workers)
         # per-source counters summed on read: parallel sources must not race
         # on shared ints
         self._dropped = [0] * len(hosts)
@@ -76,14 +76,18 @@ class CpuNetwork:
     def _egress(self, src: CpuHost, pkt: NetPacket):
         dst = self.by_ip.get(pkt.dst_ip)
         if dst is None:
-            return  # unreachable: dropped (reference counts + drops too)
+            # unreachable: dropped (reference counts + drops too)
+            src.drop_packet(pkt, "inet_no_route")
+            return
         lat = self.latency_ns(src.host_id, dst.host_id)
         p = self.loss(src.host_id, dst.host_id)
         # loss drawn from the source host's RNG (worker.rs:374-390)
         if p > 0.0 and src.rng.random() < p:
             self._dropped[src.host_id] += 1
+            src.drop_packet(pkt, "inet_loss_draw")
             return
         self._relayed[src.host_id] += 1
+        pkt.crumb(src.now(), "inet_relayed")
         self._staged[src.host_id].append((src.now() + lat, dst, pkt))
 
     def _flush_staged(self):
@@ -97,8 +101,8 @@ class CpuNetwork:
 
     def _execute_all(self, until: int):
         if self._pool is not None:
-            # list() joins: every host finishes before the staged merge
-            list(self._pool.map(lambda h: h.execute(until), self.hosts))
+            # run() joins: every host finishes before the staged merge
+            self._pool.run(self.hosts, lambda h: h.execute(until))
         else:
             for h in self.hosts:  # deterministic host order
                 h.execute(until)
@@ -120,7 +124,7 @@ class CpuNetwork:
             rounds += 1
         self._execute_all(stop_ns)
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            self._pool.shutdown()
             self._pool = None
         return rounds
 

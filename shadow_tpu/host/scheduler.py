@@ -1,0 +1,143 @@
+"""Work-stealing host scheduler for the CPU host plane.
+
+Reference: `src/lib/scheduler/src/thread_per_core.rs:25-210` — N worker
+threads, hosts round-robined into per-thread queues, and an idle worker
+STEALS from the other threads' queues by cycling them (`:192-210`). The
+reference credits its custom pools with >10x over a naive task-per-host
+pool (`scheduler/src/lib.rs:8-11`).
+
+Python recast: persistent threads parked on a condition variable between
+rounds (the reference's latch pair), per-worker `deque`s, owner pops from
+the head and thieves from the tail (Chase-Lev shape; the GIL makes the
+individual deque ops atomic). Determinism does not depend on execution
+order at all: hosts share nothing inside a window and cross-host sends
+are staged per SOURCE and merged in host-id order after the round
+(CpuNetwork._flush_staged / HybridSimulation._flush_stage_buf), so the
+steal schedule cannot reorder anything observable — asserted by the
+serial-vs-parallel byte-compare gate in tests/test_scheduler_pool.py.
+
+GIL caveat (same as the prior plain pool): pure-Python hosts serialize;
+the win is hosts whose managed processes block in futex waits off-GIL.
+Stealing fixes the SKEW problem the round-robin split has there: one
+busy host no longer pins its whole queue behind it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable
+
+
+class WorkStealingPool:
+    def __init__(self, workers: int):
+        self.n = max(1, workers)
+        self._qs: list[deque] = [deque() for _ in range(self.n)]
+        self._steals = [0] * self.n  # per-worker: no racy shared increment
+        self._cv = threading.Condition()
+        self._fn: Callable | None = None
+        self._pending = 0
+        self._round_id = 0
+        self._error: BaseException | None = None
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(
+                target=self._worker, args=(i,), daemon=True,
+                name=f"host-worker-{i}",
+            )
+            for i in range(self.n)
+        ]
+        for t in self._threads:
+            t.start()
+
+    @property
+    def steals(self) -> int:
+        return sum(self._steals)
+
+    def run(self, items, fn: Callable) -> None:
+        """One scheduling round: `fn(item)` for every item, any worker.
+        Raises the first exception any worker hit (matching the replaced
+        ThreadPoolExecutor.map semantics — a raising host must surface,
+        not hang the barrier)."""
+        items = list(items)
+        if not items:
+            return
+        with self._cv:
+            # round-robin assignment (thread_per_core.rs:86-93); stealing
+            # rebalances whatever this split gets wrong. Items are TAGGED
+            # with the round id: a worker that lingers past the end of
+            # round N (it decremented the last _pending, releasing run(),
+            # but has not re-checked the round counter yet) would
+            # otherwise pop round N+1's items and run them under round
+            # N's closure — with a stale `until` horizon here.
+            self._round_id += 1
+            rid = self._round_id
+            for i, it in enumerate(items):
+                self._qs[i % self.n].append((rid, it))
+            self._fn = fn
+            self._pending = len(items)
+            self._error = None
+            self._cv.notify_all()
+            while self._pending > 0:
+                self._cv.wait()
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def _worker(self, wid: int):
+        seen_round = 0
+        while True:
+            with self._cv:
+                while self._round_id == seen_round and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown:
+                    return
+                seen_round = self._round_id
+                fn = self._fn
+            while True:
+                tagged = None
+                stolen_from = wid
+                try:
+                    tagged = self._qs[wid].popleft()  # own queue: head
+                except IndexError:
+                    # idle: cycle the other workers' queues and steal from
+                    # the TAIL (thread_per_core.rs:192-210)
+                    for k in range(1, self.n):
+                        j = (wid + k) % self.n
+                        try:
+                            tagged = self._qs[j].pop()
+                            stolen_from = j
+                            break
+                        except IndexError:
+                            continue
+                if tagged is None:
+                    break  # round drained (items in flight finish elsewhere)
+                rid, item = tagged
+                if rid != seen_round:
+                    # a NEWER round's item reached a stale worker: put it
+                    # back and go (re)synchronize on the round counter
+                    self._qs[stolen_from].append(tagged)
+                    break
+                if stolen_from != wid:
+                    self._steals[wid] += 1
+                try:
+                    fn(item)
+                except BaseException as e:  # noqa: BLE001 — must not hang
+                    with self._cv:
+                        if self._error is None:
+                            self._error = e
+                        self._pending -= 1
+                        if self._pending <= 0:
+                            self._cv.notify_all()
+                    continue
+                with self._cv:
+                    self._pending -= 1
+                    if self._pending <= 0:
+                        self._cv.notify_all()
+
+    def shutdown(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)

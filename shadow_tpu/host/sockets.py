@@ -36,6 +36,16 @@ class NetPacket:
     proto: int
     payload: bytes = b""
     seg: Segment | None = None
+    # delivery-status breadcrumbs (reference packet.rs:16-39): when the
+    # owning host enables them (HostConfig.breadcrumbs), every hop appends
+    # (sim_time_ns, status) so a dropped packet's DROP SITE is readable
+    # from host.packet_drops — digests say THAT histories diverged,
+    # breadcrumbs say WHERE a packet died. None = disabled (zero cost).
+    trail: list | None = None
+
+    def crumb(self, t_ns: int, status: str):
+        if self.trail is not None:
+            self.trail.append((t_ns, status))
 
     @property
     def size_bytes(self) -> int:
@@ -153,9 +163,15 @@ class UdpSocket(_SocketBase):
         if self.peer_ip is not None and (
             pkt.src_ip != self.peer_ip or pkt.src_port != self.peer_port
         ):
-            return  # connected socket filters other peers
+            # connected socket filters other peers
+            self.host.drop_packet(pkt, "rcv_udp_peer_filtered")
+            return
         if len(self._rcv) >= UDP_RCVBUF_PACKETS:
-            return  # rcvbuf overflow: silently dropped, like real UDP
+            # rcvbuf overflow: silently dropped (on the wire), like real
+            # UDP — but the breadcrumb trail names this exact site
+            self.host.drop_packet(pkt, "rcv_udp_buffer_full")
+            return
+        pkt.crumb(self.host.now(), "rcv_socket_delivered")
         self._rcv.append((pkt.src_ip, pkt.src_port, pkt.payload))
         self._set_state(on=FileState.READABLE)
 
@@ -327,7 +343,9 @@ class TcpListenerSocket(_SocketBase):
             return
         now = self.host.now()
         if len(self._pending) + len(self._accept_q) >= self.backlog:
-            return  # backlog full: drop SYN (peer retries), like Linux
+            # backlog full: drop SYN (peer retries), like Linux
+            self.host.drop_packet(pkt, "rcv_tcp_backlog_full")
+            return
         child_tcp = self.tcp.accept_segment(
             now, pkt.seg, child_iss=self.host.next_iss()
         )
