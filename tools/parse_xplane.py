@@ -1,0 +1,73 @@
+"""Minimal TPU profiler-trace analyzer (no tensorboard-plugin needed).
+
+Hand-rolled protobuf wire parser for the xplane.pb files written by
+`jax.profiler.start_trace`/`stop_trace` — the image's
+tensorboard-plugin-profile is version-skewed against its tensorflow, so
+this reads the XSpace wire format directly and prints per-op durations
+for the TPU device plane. This is how every round-5 engine finding
+(cond boundary copies, merge gather costs, routing-row DMAs) was
+measured. Usage:
+
+    python tools/parse_xplane.py /tmp/my_trace
+"""
+
+import glob, sys
+from collections import defaultdict
+
+def varint(buf, i):
+    r = 0; s = 0
+    while True:
+        b = buf[i]; i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80: return r, i
+        s += 7
+
+def fields(buf):
+    i = 0
+    while i < len(buf):
+        key, i = varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0: v, i = varint(buf, i)
+        elif wt == 2:
+            ln, i = varint(buf, i); v = buf[i:i+ln]; i += ln
+        elif wt == 5: v = buf[i:i+4]; i += 4
+        elif wt == 1: v = buf[i:i+8]; i += 8
+        else: raise ValueError(wt)
+        yield fn, wt, v
+
+path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tgen_trace"
+f = sorted(glob.glob(path + "/plugins/profile/*/vm.xplane.pb"))[-1]
+sp = open(f, "rb").read()
+for fn, wt, plane in fields(sp):
+    if fn != 1: continue
+    name = b""; evm = {}; lines = []
+    for pfn, pwt, pv in fields(plane):
+        if pfn == 2: name = pv
+        elif pfn == 3: lines.append(pv)
+        elif pfn == 4:
+            k = None; meta = None
+            for mfn, mwt, mv in fields(pv):
+                if mfn == 1: k = mv
+                elif mfn == 2: meta = mv
+            if meta is not None:
+                mname = b""
+                for efn, ewt, ev in fields(meta):
+                    if efn == 2: mname = ev
+                evm[k] = mname.decode(errors="replace")
+    if b"TPU" not in name and b"tpu" not in name: continue
+    agg = defaultdict(float)
+    for line in lines:
+        lname = b""
+        evs = []
+        for lfn, lwt, lv in fields(line):
+            if lfn == 2: lname = lv
+            elif lfn == 4: evs.append(lv)
+        for lv in evs:
+            mid = 0; dur = 0
+            for efn, ewt, ev in fields(lv):
+                if efn == 1: mid = ev
+                elif efn == 3: dur = ev
+            agg[(lname.decode(errors="replace"), evm.get(mid, str(mid)))] += dur / 1e12
+    rows = sorted(agg.items(), key=lambda kv: -kv[1])
+    for (ln, n), s in rows[:30]:
+        print(f"{s*1000:9.1f} ms  [{ln[:14]}] {n[:95]}")
