@@ -12,9 +12,10 @@ Differences from the reference, by design:
     (path/args/environment — the CPU co-optation plane) or a *device model*
     spec (`model:`/`model_args:`) executed as vectorized handlers on TPU.
   - `ExperimentalOptions` carries the TPU engine's static-shape knobs (event
-    queue capacity, outbox capacity, rounds per jit chunk) in place of the
-    reference's CPU-scheduler knobs (`use_cpu_pinning`, `use_worker_spinning`),
-    which have no TPU meaning.
+    queue capacity, outbox capacity, rounds per jit chunk) alongside the
+    reference's CPU-scheduler knobs, which here govern only the co-sim CPU
+    host plane (`host_workers`, `host_scheduler`, `use_cpu_pinning`;
+    `use_worker_spinning` has no analogue — workers park on condvars).
 """
 
 from __future__ import annotations
@@ -197,6 +198,14 @@ class ExperimentalOptions:
     # nothing inside a window; results are identical to serial by
     # construction (per-source staging merged in host-id order)
     host_workers: int = 1
+    # CPU host plane scheduling policy (reference scheduler crate):
+    # "steal" = thread-per-core work stealing (thread_per_core.rs:192-210);
+    # "per-host" = one dedicated thread per host, host_workers bounding
+    # concurrency (thread_per_host.rs:25-60 + ParallelismBoundedThreadPool)
+    host_scheduler: str = "steal"
+    # pin host-plane workers to logical CPUs, packed node/socket/core-first
+    # (reference use_cpu_pinning, core/affinity.c)
+    use_cpu_pinning: bool = False
 
     @staticmethod
     def from_dict(d: dict[str, Any] | None) -> "ExperimentalOptions":
@@ -244,7 +253,19 @@ class ExperimentalOptions:
                 f"experimental.scheduler must be tpu|cpu-reference, "
                 f"got {e.scheduler!r}"
             )
-        for f in ("use_dynamic_runahead", "use_codel", "packet_breadcrumbs"):
+        if "host_scheduler" in d:
+            e.host_scheduler = str(d.pop("host_scheduler"))
+        if e.host_scheduler not in ("steal", "per-host"):
+            raise ConfigError(
+                f"experimental.host_scheduler must be steal|per-host, "
+                f"got {e.host_scheduler!r}"
+            )
+        for f in (
+            "use_dynamic_runahead",
+            "use_codel",
+            "packet_breadcrumbs",
+            "use_cpu_pinning",
+        ):
             if f in d:
                 setattr(e, f, bool(d.pop(f)))
         for f in (

@@ -336,10 +336,13 @@ class HybridSimulation:
         # CpuNetwork for the staging argument). GIL caveat: pure-Python
         # hosts serialize; native hosts block in futex waits off-GIL.
         self._host_pool = None
-        if cfg.experimental.host_workers > 1:
-            from shadow_tpu.host.scheduler import WorkStealingPool
+        ex = cfg.experimental
+        if ex.host_workers > 1 or ex.host_scheduler == "per-host":
+            from shadow_tpu.host import affinity
+            from shadow_tpu.host.scheduler import make_pool
 
-            self._host_pool = WorkStealingPool(cfg.experimental.host_workers)
+            pin = affinity.assign(ex.host_workers) if ex.use_cpu_pinning else None
+            self._host_pool = make_pool(ex.host_scheduler, ex.host_workers, pin)
 
         # jitted ops (shard-mapped over the mesh when world > 1, exactly
         # like Engine.run_chunk — staged-send arrays ride in replicated and

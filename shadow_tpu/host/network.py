@@ -24,6 +24,8 @@ class CpuNetwork:
         loss: Callable[[int, int], float] | None = None,
         names: dict[str, str] | None = None,
         workers: int = 1,
+        scheduler: str = "steal",  # "steal" | "per-host" (thread_per_host.rs)
+        pin_cpus: list[int] | None = None,
     ):
         self.hosts = hosts
         self.by_ip = {h.ip: h for h in hosts}
@@ -56,10 +58,14 @@ class CpuNetwork:
         self.workers = max(1, workers)
         self._staged: list[list] = [[] for _ in hosts]
         self._pool = None
-        if self.workers > 1:
-            from shadow_tpu.host.scheduler import WorkStealingPool
+        if scheduler not in ("steal", "per-host"):
+            raise ValueError(
+                f"scheduler must be steal|per-host, got {scheduler!r}"
+            )
+        if self.workers > 1 or scheduler == "per-host":
+            from shadow_tpu.host.scheduler import make_pool
 
-            self._pool = WorkStealingPool(self.workers)
+            self._pool = make_pool(scheduler, self.workers, pin_cpus)
         # per-source counters summed on read: parallel sources must not race
         # on shared ints
         self._dropped = [0] * len(hosts)

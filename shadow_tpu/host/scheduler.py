@@ -24,14 +24,18 @@ busy host no longer pins its whole queue behind it.
 
 from __future__ import annotations
 
+import queue
 import threading
 from collections import deque
 from typing import Callable
 
+from shadow_tpu.host import affinity
+
 
 class WorkStealingPool:
-    def __init__(self, workers: int):
+    def __init__(self, workers: int, pin_cpus: list[int] | None = None):
         self.n = max(1, workers)
+        self._pin_cpus = pin_cpus
         self._qs: list[deque] = [deque() for _ in range(self.n)]
         self._steals = [0] * self.n  # per-worker: no racy shared increment
         self._cv = threading.Condition()
@@ -85,6 +89,8 @@ class WorkStealingPool:
                 raise err
 
     def _worker(self, wid: int):
+        if self._pin_cpus:
+            affinity.pin_current(self._pin_cpus[wid % len(self._pin_cpus)])
         seen_round = 0
         while True:
             with self._cv:
@@ -141,3 +147,137 @@ class WorkStealingPool:
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=2)
+
+
+class ThreadPerHostPool:
+    """Thread-per-host scheduling policy (`thread_per_host.rs:25-60`).
+
+    The reference spawns ONE OS thread per host, parks the host in that
+    thread's TLS, and bounds how many run at once with a
+    ParallelismBoundedThreadPool pinned over the logical processors. The
+    payoff is that a host's state never migrates threads: thread-local
+    caches, errno, and (here) any thread-affine guest state a managed
+    process leans on stay put for the host's whole lifetime.
+
+    Python recast: a dedicated worker thread is created the first time a
+    host is scheduled (keyed by `host_id` when present, else identity)
+    and every subsequent round runs that host on the SAME thread — the
+    TLS-stability guarantee, asserted by tests. A semaphore bounds
+    concurrent execution to `parallelism` (the reference's bounded pool);
+    blocked-in-futex native hosts release the GIL, so the bound governs
+    genuine concurrency, not just thread count. Determinism is the same
+    argument as WorkStealingPool: per-source staging merged in host-id
+    order makes the execution schedule unobservable.
+    """
+
+    def __init__(self, parallelism: int, pin_cpus: list[int] | None = None):
+        self.parallelism = max(1, parallelism)
+        self._sem = threading.Semaphore(self.parallelism)
+        # pinning follows the RUNNING slot, not the host thread: a host
+        # thread that wins a semaphore slot takes a CPU from this free
+        # list, pins, runs, and returns it — so the `parallelism` hosts
+        # running at any instant occupy distinct CPUs (the reference pins
+        # its bounded pool's N workers to N distinct LPs; pinning the
+        # unbounded host threads round-robin would let two admitted hosts
+        # share a CPU while assigned CPUs sit idle). deque append/popleft
+        # are GIL-atomic.
+        self._free_cpus: deque | None = (
+            deque(pin_cpus[: self.parallelism]) if pin_cpus else None
+        )
+        # run() is single-caller (the window loop); _get_queue mutates
+        # _workers/_threads without a lock on that contract
+        self._workers: dict[object, queue.SimpleQueue] = {}
+        self._threads: list[threading.Thread] = []
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._error: BaseException | None = None
+
+    @property
+    def thread_count(self) -> int:
+        return len(self._threads)
+
+    @staticmethod
+    def _key(item) -> object:
+        hid = getattr(item, "host_id", None)
+        return hid if hid is not None else id(item)
+
+    def _get_queue(self, item) -> queue.SimpleQueue:
+        key = self._key(item)
+        q = self._workers.get(key)
+        if q is None:
+            q = queue.SimpleQueue()
+            self._workers[key] = q
+            t = threading.Thread(
+                target=self._worker,
+                args=(q,),
+                daemon=True,
+                name=f"host-{key}",
+            )
+            self._threads.append(t)
+            t.start()
+        return q
+
+    def run(self, items, fn: Callable) -> None:
+        items = list(items)
+        if not items:
+            return
+        with self._cv:
+            self._pending = len(items)
+            self._error = None
+        for it in items:
+            self._get_queue(it).put((fn, it))
+        with self._cv:
+            while self._pending > 0:
+                self._cv.wait()
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def _worker(self, q: queue.SimpleQueue):
+        while True:
+            task = q.get()
+            if task is None:
+                return
+            fn, item = task
+            with self._sem:
+                cpu = None
+                if self._free_cpus:
+                    try:
+                        cpu = self._free_cpus.popleft()
+                        affinity.pin_current(cpu)
+                    except IndexError:
+                        cpu = None
+                try:
+                    fn(item)
+                except BaseException as e:  # noqa: BLE001 — must not hang
+                    with self._cv:
+                        if self._error is None:
+                            self._error = e
+                finally:
+                    if cpu is not None:
+                        self._free_cpus.append(cpu)
+                    with self._cv:
+                        self._pending -= 1
+                        if self._pending <= 0:
+                            self._cv.notify_all()
+
+    def shutdown(self):
+        for q in self._workers.values():
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+def make_pool(
+    scheduler: str, workers: int, pin_cpus: list[int] | None = None
+):
+    """The one scheduler-policy dispatch point (reference
+    Scheduler::new, scheduler/src/lib.rs): "steal" = WorkStealingPool,
+    "per-host" = ThreadPerHostPool; anything else raises."""
+    if scheduler == "per-host":
+        return ThreadPerHostPool(workers, pin_cpus)
+    if scheduler == "steal":
+        return WorkStealingPool(workers, pin_cpus)
+    raise ValueError(
+        f"host scheduler must be steal|per-host, got {scheduler!r}"
+    )

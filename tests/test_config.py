@@ -136,3 +136,25 @@ def test_static_shapes_autosize_from_host_count():
     ex.rounds_per_chunk = 16
     qcap, budget, rpc = ex.resolve_shapes(1_000_000)
     assert (qcap, budget, rpc) == (32, 1, 16)  # explicit wins, rest auto
+
+
+def test_host_scheduler_and_pinning_knobs():
+    """reference scheduler crate knobs: host_scheduler policy +
+    use_cpu_pinning (affinity.c), with validation."""
+    cfg = load_config(
+        "general: {stop_time: 1s}\n"
+        "experimental: {host_scheduler: per-host, use_cpu_pinning: true,"
+        " host_workers: 3}\n"
+        "hosts: {a: {processes: [{model: timer}]}}",
+        is_text=True,
+    )
+    assert cfg.experimental.host_scheduler == "per-host"
+    assert cfg.experimental.use_cpu_pinning is True
+    assert cfg.experimental.host_workers == 3
+    with pytest.raises(ConfigError, match="host_scheduler"):
+        load_config(
+            "general: {stop_time: 1s}\n"
+            "experimental: {host_scheduler: bogus}\n"
+            "hosts: {a: {processes: [{model: timer}]}}",
+            is_text=True,
+        )

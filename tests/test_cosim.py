@@ -360,3 +360,49 @@ def test_parallel_host_plane_matches_serial():
               "syscalls", "process_failures"):
         assert r1[k] == r4[k], k
     assert o1 == o4
+
+
+def test_per_host_scheduler_with_pinning_matches_serial():
+    """host_scheduler: per-host (thread_per_host.rs) + use_cpu_pinning
+    (affinity.c) through the full hybrid sim — digest-identical to the
+    serial default."""
+
+    def once(extra):
+        cfg = _cfg(
+            {
+                "server": {
+                    "network_node_id": 0,
+                    "processes": [
+                        {"path": "udp_echo_server", "args": ["port=9000"]}
+                    ],
+                },
+                "client": {
+                    "network_node_id": 0,
+                    "count": 10,
+                    "processes": [
+                        {
+                            "path": "udp_ping",
+                            "args": ["server=server", "port=9000", "count=2"],
+                            "expected_final_state": {"exited": 0},
+                        }
+                    ],
+                },
+            },
+            stop="4 s",
+            extra={"experimental": extra} if extra else None,
+        )
+        sim = HybridSimulation(cfg, world=1)
+        return sim.run()
+
+    r_serial = once(None)
+    r_ph = once(
+        {
+            "host_scheduler": "per-host",
+            "host_workers": 2,
+            "use_cpu_pinning": True,
+        }
+    )
+    assert r_serial["determinism_digest"] == r_ph["determinism_digest"]
+    for k in ("packets_sent", "packets_delivered", "events_processed",
+              "syscalls"):
+        assert r_serial[k] == r_ph[k], k

@@ -1,6 +1,8 @@
-"""Work-stealing host scheduler (VERDICT r4 #8; reference
-thread_per_core.rs:25-210 — per-thread queues + steal-on-idle) and the
-serial-vs-parallel determinism gate."""
+"""Host-plane schedulers (reference scheduler crate): work stealing
+(thread_per_core.rs:25-210 — per-thread queues + steal-on-idle),
+thread-per-host (thread_per_host.rs:25-60 — dedicated thread, bounded
+parallelism), CPU pinning (core/affinity.c), and the serial-vs-parallel
+determinism gate."""
 
 from __future__ import annotations
 
@@ -10,9 +12,9 @@ import time
 
 import pytest
 
-from shadow_tpu.host import CpuHost, HostConfig
+from shadow_tpu.host import CpuHost, HostConfig, affinity
 from shadow_tpu.host.network import CpuNetwork
-from shadow_tpu.host.scheduler import WorkStealingPool
+from shadow_tpu.host.scheduler import ThreadPerHostPool, WorkStealingPool
 
 MS = 1_000_000
 SEC = 1_000_000_000
@@ -92,6 +94,204 @@ def test_serial_vs_parallel_byte_identical():
         )
 
     assert once(1) == once(4)
+
+
+def test_per_host_pool_thread_stability():
+    """thread_per_host.rs's core contract: a host runs on the SAME
+    dedicated thread every round, for its whole lifetime."""
+
+    class FakeHost:
+        def __init__(self, hid):
+            self.host_id = hid
+
+    hosts = [FakeHost(i) for i in range(6)]
+    pool = ThreadPerHostPool(parallelism=2)
+    seen: dict[int, set[int]] = {h.host_id: set() for h in hosts}
+    lock = threading.Lock()
+
+    def work(h):
+        with lock:
+            seen[h.host_id].add(threading.get_ident())
+
+    for _ in range(8):
+        pool.run(hosts, work)
+    assert pool.thread_count == 6  # one dedicated thread per host
+    pool.shutdown()
+    for hid, tids in seen.items():
+        assert len(tids) == 1, f"host {hid} migrated threads: {tids}"
+    # distinct hosts got distinct threads
+    all_tids = [next(iter(t)) for t in seen.values()]
+    assert len(set(all_tids)) == 6
+
+
+def test_per_host_pool_parallelism_bound():
+    """The semaphore bounds how many hosts RUN concurrently even though
+    every host has its own thread (ParallelismBoundedThreadPool)."""
+    pool = ThreadPerHostPool(parallelism=2)
+    running = 0
+    peak = 0
+    lock = threading.Lock()
+
+    class FakeHost:
+        def __init__(self, hid):
+            self.host_id = hid
+
+    def work(_h):
+        nonlocal running, peak
+        with lock:
+            running += 1
+            peak = max(peak, running)
+        time.sleep(0.01)  # off-GIL so concurrency is real
+        with lock:
+            running -= 1
+
+    pool.run([FakeHost(i) for i in range(8)], work)
+    pool.shutdown()
+    assert peak <= 2, f"parallelism bound violated: peak={peak}"
+
+
+def test_per_host_pool_exception_propagates():
+    class FakeHost:
+        def __init__(self, hid):
+            self.host_id = hid
+
+    pool = ThreadPerHostPool(parallelism=4)
+    hosts = [FakeHost(i) for i in range(5)]
+
+    def boom(h):
+        if h.host_id == 2:
+            raise RuntimeError("host exploded")
+
+    with pytest.raises(RuntimeError, match="host exploded"):
+        pool.run(hosts, boom)
+    out = []
+    pool.run(hosts, lambda h: out.append(h.host_id))
+    pool.shutdown()
+    assert sorted(out) == [0, 1, 2, 3, 4]
+
+
+def test_serial_vs_per_host_byte_identical():
+    """Determinism gate for the thread-per-host policy: same workload,
+    serial vs per-host threads, byte-identical output."""
+    from shadow_tpu.native_plane import ensure_built, spawn_native
+
+    if not ensure_built():
+        pytest.skip("native toolchain unavailable")
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    udp_echo = os.path.join(repo, "native", "build", "test_udp_echo")
+    udp_client = os.path.join(repo, "native", "build", "test_udp_client")
+
+    def once(workers: int, sched: str):
+        hosts = [
+            CpuHost(HostConfig(name=f"h{i}", ip=f"10.0.0.{i + 1}", seed=5,
+                               host_id=i))
+            for i in range(4)
+        ]
+        net = CpuNetwork(hosts, latency_ns=lambda s, d: 15 * MS,
+                         workers=workers, scheduler=sched)
+        srv = spawn_native(hosts[0], [udp_echo, "9000", "6"])
+        clis = [
+            spawn_native(
+                hosts[i], [udp_client, "10.0.0.1", "9000", "2"],
+                start_time=i * 10 * MS,
+            )
+            for i in (1, 2, 3)
+        ]
+        net.run(5 * SEC)
+        return (
+            tuple(b"".join(c.stdout) for c in clis),
+            b"".join(srv.stdout),
+            tuple(tuple(sorted(h.counters.items())) for h in hosts),
+        )
+
+    assert once(1, "steal") == once(2, "per-host")
+
+
+def test_affinity_assign_packs_cores_first():
+    """affinity.c's greedy on a synthetic 2-node, 4-core, 8-cpu (SMT)
+    machine: workers land on distinct physical cores before any
+    hyperthread sibling is reused, alternating NUMA nodes stay balanced."""
+    cpus = [
+        # node 0, socket 0: cores 0,1; SMT siblings 4,5
+        affinity.CpuInfo(cpu=0, core=0, socket=0, node=0),
+        affinity.CpuInfo(cpu=1, core=1, socket=0, node=0),
+        affinity.CpuInfo(cpu=4, core=0, socket=0, node=0),
+        affinity.CpuInfo(cpu=5, core=1, socket=0, node=0),
+        # node 1, socket 1: cores 2,3; SMT siblings 6,7
+        affinity.CpuInfo(cpu=2, core=2, socket=1, node=1),
+        affinity.CpuInfo(cpu=3, core=3, socket=1, node=1),
+        affinity.CpuInfo(cpu=6, core=2, socket=1, node=1),
+        affinity.CpuInfo(cpu=7, core=3, socket=1, node=1),
+    ]
+    got = affinity.assign(8, cpus)
+    # all 8 logical cpus used exactly once before any repeats
+    assert sorted(got) == list(range(8))
+    # the first 4 workers cover 4 DISTINCT physical cores
+    by_cpu = {c.cpu: c for c in cpus}
+    first4 = {(by_cpu[c].node, by_cpu[c].socket, by_cpu[c].core)
+              for c in got[:4]}
+    assert len(first4) == 4, f"SMT sibling reused early: {got[:4]}"
+    # nodes alternate (load balance at node level)
+    nodes = [by_cpu[c].node for c in got[:4]]
+    assert sorted(nodes) == [0, 0, 1, 1]
+
+
+def test_per_host_pinning_follows_running_slot():
+    """With pinning on, the CPUs occupied at any instant are the
+    parallelism slots' CPUs — concurrently-admitted hosts never share a
+    pinned CPU while an assigned CPU sits idle. (Single-CPU box: assert
+    the slot free-list mechanics rather than real placement.)"""
+
+    class FakeHost:
+        def __init__(self, hid):
+            self.host_id = hid
+
+    pool = ThreadPerHostPool(parallelism=2, pin_cpus=[0, 0])
+    in_flight_cpus: list[int] = []
+    lock = threading.Lock()
+
+    def work(_h):
+        with lock:
+            # while running, this host's slot CPU is OUT of the free list
+            in_flight_cpus.append(len(pool._free_cpus))
+        time.sleep(0.005)
+
+    pool.run([FakeHost(i) for i in range(6)], work)
+    pool.shutdown()
+    # every observation saw <= parallelism CPUs checked out, and at least
+    # one observation saw a CPU checked out at all
+    assert all(0 <= n <= 2 for n in in_flight_cpus)
+    assert min(in_flight_cpus) < 2
+    assert len(pool._free_cpus) == 2  # all returned after the round
+
+
+def test_make_pool_rejects_unknown_policy():
+    from shadow_tpu.host.scheduler import make_pool
+
+    with pytest.raises(ValueError, match="per-host"):
+        make_pool("per_host", 2)  # typo'd underscore must not silently steal
+    with pytest.raises(ValueError, match="scheduler"):
+        CpuNetwork([], latency_ns=lambda s, d: 1, scheduler="bogus")
+
+
+def test_affinity_assign_more_workers_than_cpus():
+    cpus = [affinity.CpuInfo(cpu=0, core=0, socket=0, node=0)]
+    assert affinity.assign(3, cpus) == [0, 0, 0]
+    assert affinity.assign(2, []) == [0, 0]
+
+
+def test_affinity_topology_and_pin_on_this_box():
+    """Smoke the real sysfs parse + a real pin on whatever this box has."""
+    cpus = affinity.topology()
+    assert cpus, "topology() returned no CPUs"
+    allowed = set(os.sched_getaffinity(0))
+    assert {c.cpu for c in cpus} <= allowed
+    target = affinity.assign(1, cpus)[0]
+    try:
+        assert affinity.pin_current(target) is True
+        assert os.sched_getaffinity(0) == {target}
+    finally:
+        os.sched_setaffinity(0, allowed)  # restore even on assert failure
 
 
 def test_worker_exception_propagates_instead_of_hanging():
