@@ -48,6 +48,15 @@ int main(int argc, char **argv) {
         long acc = 0;
         for (long i = 0; i < n; i++) acc += syscall(SYS_getpid);
         printf("getpid done %ld acc=%ld\n", n, acc);
+    } else if (!strcmp(argv[1], "stdout")) {
+        /* descriptor fast path: write(2) on captured stdout answered
+         * shim-locally from the FastFd ring (r5) */
+        char line[32];
+        long len = (long)snprintf(line, sizeof line, "benchline\n");
+        for (long i = 0; i < n; i++) {
+            if (write(1, line, len) != len) return 1;
+        }
+        fprintf(stderr, "stdout done %ld\n", n);
     } else if (!strcmp(argv[1], "clock")) {
         struct timespec ts;
         long acc = 0;

@@ -72,6 +72,21 @@ typedef struct {
     ShimChan to_shim;
 } ShimChanPair; /* 160 bytes */
 
+#define FASTFD_MAX 8
+#define FASTFD_RING_CAP 32768
+
+struct FastFd {
+    int32_t vfd;   /* guest fd this entry serves; -1 = free */
+    uint32_t kind; /* FastKind */
+    uint64_t head; /* consumer cursor (free-running byte count) */
+    uint64_t tail; /* producer cursor */
+}; /* 24 bytes */
+
+enum FastKind {
+    FAST_NONE = 0,
+    FAST_TX_STREAM = 1, /* shim writes, simulator drains (stdout/stderr) */
+};
+
 typedef struct {
     int64_t sim_time_ns; /* simulator-maintained simulated clock */
     uint32_t doorbell;   /* futex word: bumped on every to_shadow send */
@@ -107,7 +122,22 @@ typedef struct {
     int32_t virt_uid;
     int32_t virt_gid;
     uint32_t _pad3;
-} IpcBlock; /* 16 + 32*160 + 16 + 8 + 24 = 5184 bytes */
+    /* Descriptor fast path (r5; the "descriptor state in shm" step the
+     * syscall microbench pointed at): per-fd ring buffers the shim can
+     * serve without a futex round trip. TX_STREAM = shim produces (tail),
+     * simulator consumes (head) — captured stdio writes; RX rings are the
+     * planned next kind. SAFETY ARGUMENT: exactly one guest thread runs
+     * at a time and the simulator is parked while it does, so entries are
+     * quiescent during guest execution; the simulator re-syncs entries
+     * before replying to any fd-table-mutating syscall and drains rings
+     * at every trap — rings are provably empty at every simulator
+     * decision point. `fast_calls` counts locally-answered calls so the
+     * simulator can fold them into syscall accounting at the next trap. */
+    uint32_t fast_enabled;
+    uint32_t fast_calls;
+    struct FastFd fast[FASTFD_MAX];
+    uint8_t fast_rings[FASTFD_MAX][FASTFD_RING_CAP];
+} IpcBlock;
 
 #define IPC_FLAGS_OFF 12
 
@@ -118,5 +148,40 @@ typedef struct {
 #define IPC_HEAP_START_OFF (IPC_THREADS_OFF + IPC_MAX_THREADS * IPC_CHANPAIR_SIZE)
 #define IPC_HEAP_CUR_OFF (IPC_HEAP_START_OFF + 8)
 #define SHADOW_HEAP_MAX (256l << 20) /* window file size (sparse tmpfs) */
+
+/* fast-path layout offsets (Python mirrors these; keep in sync) */
+#define IPC_IDS_OFF (IPC_HEAP_START_OFF + 16 + 8)
+#define IPC_FAST_ENABLED_OFF (IPC_IDS_OFF + 24)
+#define IPC_FAST_CALLS_OFF (IPC_FAST_ENABLED_OFF + 4)
+#define IPC_FAST_TABLE_OFF (IPC_FAST_CALLS_OFF + 4)
+#define IPC_FASTFD_SIZE 24
+#define IPC_FAST_RINGS_OFF (IPC_FAST_TABLE_OFF + FASTFD_MAX * IPC_FASTFD_SIZE)
+
+/* the offset macros above are what the Python side mirrors — pin them to
+ * the real struct layout so a field insertion breaks the BUILD, not a
+ * ring read at runtime */
+#include <stddef.h>
+#ifdef __cplusplus
+#define IPC_STATIC_ASSERT(c, m) static_assert(c, m)
+#else
+#define IPC_STATIC_ASSERT(c, m) _Static_assert(c, m)
+#endif
+IPC_STATIC_ASSERT(offsetof(IpcBlock, ids_valid) == IPC_IDS_OFF,
+               "ids block offset drifted");
+IPC_STATIC_ASSERT(offsetof(IpcBlock, fast_enabled) == IPC_FAST_ENABLED_OFF,
+               "fast_enabled offset drifted");
+IPC_STATIC_ASSERT(offsetof(IpcBlock, fast_calls) == IPC_FAST_CALLS_OFF,
+               "fast_calls offset drifted");
+IPC_STATIC_ASSERT(offsetof(IpcBlock, fast) == IPC_FAST_TABLE_OFF,
+               "fast table offset drifted");
+IPC_STATIC_ASSERT(sizeof(struct FastFd) == IPC_FASTFD_SIZE,
+               "FastFd size drifted");
+IPC_STATIC_ASSERT(offsetof(IpcBlock, fast_rings) == IPC_FAST_RINGS_OFF,
+               "ring arena offset drifted");
+IPC_STATIC_ASSERT(sizeof(IpcBlock) ==
+                   IPC_FAST_RINGS_OFF + FASTFD_MAX * FASTFD_RING_CAP,
+               "IpcBlock size drifted (update Python IPC_SIZE)");
+IPC_STATIC_ASSERT(offsetof(IpcBlock, heap_start) == IPC_HEAP_START_OFF,
+               "heap window offset drifted");
 
 #endif

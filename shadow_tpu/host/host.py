@@ -85,6 +85,9 @@ class CpuHost:
             "bytes_sent": 0,
             "bytes_recv": 0,
             "syscalls": 0,
+            # of which answered inside the shim from the descriptor fast
+            # table (native_plane._fast_drain folds them back in)
+            "syscalls_fast": 0,
         }
         # per-interface + per-socket byte/packet counters
         # (tracker.c:24-80 — the reference tracker reports both per

@@ -198,8 +198,10 @@ class ThreadPerHostPool:
 
     @staticmethod
     def _key(item) -> object:
-        hid = getattr(item, "host_id", None)
-        return hid if hid is not None else id(item)
+        # object identity, NOT host_id: ids default to 0, and two
+        # default-id hosts keyed by id would silently share one thread.
+        # Host objects persist for the simulation, so id() is stable.
+        return id(item)
 
     def _get_queue(self, item) -> queue.SimpleQueue:
         key = self._key(item)
@@ -207,11 +209,12 @@ class ThreadPerHostPool:
         if q is None:
             q = queue.SimpleQueue()
             self._workers[key] = q
+            label = getattr(item, "host_id", None)
             t = threading.Thread(
                 target=self._worker,
                 args=(q,),
                 daemon=True,
-                name=f"host-{key}",
+                name=f"host-{key if label is None else label}",
             )
             self._threads.append(t)
             t.start()

@@ -55,7 +55,17 @@ HEAP_START_OFF = THREADS_OFF + IPC_MAX_THREADS * CHANPAIR_SIZE
 FORK_SYNC_OFF = HEAP_START_OFF + 16
 # shim-local identity fast path: ids_valid u32 + pid/ppid/uid/gid i32 + pad
 IDS_OFF = FORK_SYNC_OFF + 8
-IPC_SIZE = IDS_OFF + 24
+# descriptor fast path: fast_enabled u32 + fast_calls u32 + FASTFD_MAX
+# 24-byte {vfd, kind, head, tail} entries + per-entry ring arena
+FAST_ENABLED_OFF = IDS_OFF + 24
+FAST_CALLS_OFF = FAST_ENABLED_OFF + 4
+FAST_TABLE_OFF = FAST_CALLS_OFF + 4
+FASTFD_MAX = 8
+FASTFD_SIZE = 24
+FAST_RINGS_OFF = FAST_TABLE_OFF + FASTFD_MAX * FASTFD_SIZE
+FASTFD_RING_CAP = 32768
+FAST_TX_STREAM = 1
+IPC_SIZE = FAST_RINGS_OFF + FASTFD_MAX * FASTFD_RING_CAP
 HEAP_MAX = 256 << 20  # SHADOW_HEAP_MAX in ipc.h
 
 _libc = ctypes.CDLL(None, use_errno=True)
@@ -352,6 +362,10 @@ class IpcBlock:
         os.close(fd)
         self._base = ctypes.addressof(ctypes.c_char.from_buffer(self._mm))
         self.cur_slot = 0
+        # called right before ANY reply returns control to the guest, so
+        # fd-table-mutating syscalls can re-sync the descriptor fast table
+        # before the guest can act on the new fd meanings
+        self.pre_reply = None
 
     @staticmethod
     def _shadow_off(slot: int) -> int:
@@ -429,6 +443,44 @@ class IpcBlock:
         block). Call whenever an id changes (spawn, fork, exec, set*id)."""
         struct.pack_into("<Iiiii", self._mm, IDS_OFF, 1, pid, ppid, uid, gid)
 
+    # -- descriptor fast path (ipc.h FastFd). Every mutation below runs
+    # only while ALL guest threads are parked (the one-thread-at-a-time
+    # invariant: entries are synced pre-reply and rings drained at trap
+    # entry), so plain reads/writes need no atomics on this side.
+    def fast_set_enabled(self, on: bool):
+        struct.pack_into("<I", self._mm, FAST_ENABLED_OFF, 1 if on else 0)
+
+    def fast_set_entry(self, idx: int, vfd: int, kind: int):
+        off = FAST_TABLE_OFF + idx * FASTFD_SIZE
+        struct.pack_into("<iIQQ", self._mm, off, vfd, kind, 0, 0)
+
+    def fast_clear_entry(self, idx: int):
+        off = FAST_TABLE_OFF + idx * FASTFD_SIZE
+        struct.pack_into("<iI", self._mm, off, -1, 0)
+
+    def fast_drain(self, idx: int) -> bytes:
+        """Take everything the shim produced into ring `idx` since the
+        last drain (TX direction: shim is the producer)."""
+        off = FAST_TABLE_OFF + idx * FASTFD_SIZE
+        head, tail = struct.unpack_from("<QQ", self._mm, off + 8)
+        if head == tail:
+            return b""
+        n = tail - head
+        ring = FAST_RINGS_OFF + idx * FASTFD_RING_CAP
+        pos = head % FASTFD_RING_CAP
+        first = min(n, FASTFD_RING_CAP - pos)
+        data = bytes(self._mm[ring + pos:ring + pos + first])
+        if n > first:
+            data += bytes(self._mm[ring:ring + (n - first)])
+        struct.pack_into("<Q", self._mm, off + 8, tail)  # head = tail
+        return data
+
+    def fast_take_calls(self) -> int:
+        n = struct.unpack_from("<I", self._mm, FAST_CALLS_OFF)[0]
+        if n:
+            struct.pack_into("<I", self._mm, FAST_CALLS_OFF, 0)
+        return n
+
     def reply(self, kind: int, ret: int = 0):
         self.reply_slot(self.cur_slot, kind, ret)
 
@@ -436,6 +488,8 @@ class IpcBlock:
         self, slot: int, kind: int, ret: int = 0, num: int = 0,
         args: tuple = (),
     ):
+        if self.pre_reply is not None:
+            self.pre_reply()
         off = self._shim_off(slot)
         a = list(args) + [0] * (6 - len(args))
         struct.pack_into(
@@ -518,6 +572,16 @@ SYS = {
     "prctl": 157, "setrlimit": 160, "waitid": 247,
 }
 _N2NAME = {v: k for k, v in SYS.items()}
+
+# syscalls whose handling can change what fd 1/2 mean (capture retarget,
+# vfd shadowing, exec image swap): servicing one re-syncs the descriptor
+# fast table before the guest resumes (NativeProcess._fast_pre_reply)
+_FAST_MUTATORS = frozenset(
+    SYS[n] for n in (
+        "close", "close_range", "dup", "dup2", "dup3", "fcntl",
+        "execve", "execveat",
+    )
+)
 
 # pass-through set: memory management, real-file reads, process metadata the
 # simulator doesn't virtualize (regular_file.c passthrough analogue)
@@ -1045,10 +1109,15 @@ class NativeProcess:
         self.stdout: list[bytes] = []
         self.stderr: list[bytes] = []
         self.ipc = IpcBlock(path=ipc_path)
+        self.ipc.pre_reply = self._fast_pre_reply
         self._child: subprocess.Popen | None = None
         self.syscall_count = 0
+        self._strace = None  # fn(t, pid, name, args, ret); see property
+        # descriptor fast path: idx -> captured stream (1|2) per active
+        # TX entry; dirty is set when a serviced syscall may remap fds
+        self._fast_map: dict[int, int] = {}
+        self._fast_dirty = False
         self.expected_final_state = "running"
-        self.strace = None  # fn(t, pid, name, args, ret)
         # virtual fds: emulated sockets living in the host's netns
         self._vfds: dict[int, object] = {}
         self._vfd_flags: dict[int, int] = {}  # O_NONBLOCK etc.
@@ -1133,6 +1202,7 @@ class NativeProcess:
             return
         self._register_heap()  # MemoryMapper window (set up pre-handshake)
         self._publish_ids()
+        self._fast_init()
         self.ipc.reply_slot(0, MSG_START_OK)
         self._service_loop()
 
@@ -1143,6 +1213,94 @@ class NativeProcess:
             self._uid,
             self._gid,
         )
+
+    @property
+    def strace(self):
+        """Per-call trace hook `fn(t, pid, name, args, ret)`. Setting a
+        hook — even after the process started — disables the descriptor
+        fast path: strace must see EVERY call, and fast-answered writes
+        never reach the simulator."""
+        return self._strace
+
+    @strace.setter
+    def strace(self, fn):
+        self._strace = fn
+        if fn is not None and self._fast_map:
+            self._fast_drain()  # rescue bytes written before attach
+            for idx in self._fast_map:
+                self.ipc.fast_clear_entry(idx)
+            self._fast_map = {}
+            self.ipc.fast_set_enabled(False)
+
+    # ---- descriptor fast path ---------------------------------------------
+    # write(2) on captured stdio answered inside the shim from a shared
+    # ring (ipc.h FastFd; the shim_sys.c "answer hot calls without a
+    # context switch" precedent extended to descriptors). Soundness:
+    # entries are re-synced BEFORE any reply to an fd-mutating syscall
+    # (pre_reply hook — the guest cannot act on a new fd meaning until
+    # that reply lands), and rings are drained at every trap entry — so
+    # rings are empty at every simulator decision point, and capture
+    # order vs slow-path writev/pwritev is preserved.
+
+    def _fast_init(self):
+        """Enable after the start handshake. Any strace mode disables the
+        path (strace must see every call, like the reference's handler
+        which never sees shim-answered time calls by design)."""
+        if self.strace is not None:
+            return
+        self._fast_sync()
+        self.ipc.fast_set_enabled(True)
+
+    def _fast_sync(self):
+        """Mirror the capture rules of the slow write arm: fd 1/2 is
+        fast-writable iff no vfd shadows it and _stdio_target still maps
+        it to a captured stream. Entry index == fd number.
+
+        At most ONE fast fd per target stream: after `2>&1` both fds
+        append to the stdout buffer, and two independent rings draining
+        back-to-back would lose the guest's write interleaving. The
+        non-canonical fd stays on the slow path, whose trap drains rings
+        BEFORE appending — program order per stream is exact either way."""
+        want: dict[int, int] = {}
+        claimed: set[int] = set()
+        for fd in (1, 2):
+            if fd not in self._vfds:
+                tgt = self._stdio_target(fd)
+                if tgt is not None and tgt not in claimed:
+                    want[fd] = tgt
+                    claimed.add(tgt)
+        cur = self._fast_map
+        if want == cur:
+            return
+        for fd, tgt in list(cur.items()):
+            if want.get(fd) != tgt:
+                data = self.ipc.fast_drain(fd)
+                if data:
+                    (self.stdout if tgt == 1 else self.stderr).append(data)
+                self.ipc.fast_clear_entry(fd)
+                del cur[fd]
+        for fd, tgt in want.items():
+            if fd not in cur:
+                self.ipc.fast_set_entry(fd, fd, FAST_TX_STREAM)
+                cur[fd] = tgt
+
+    def _fast_drain(self):
+        """Collect ring contents + locally-answered call counts (trap
+        entry, exit, and entry-retarget points)."""
+        n = self.ipc.fast_take_calls()
+        if n:
+            self.syscall_count += n
+            self.host.counters["syscalls"] += n
+            self.host.counters["syscalls_fast"] += n
+        for idx, tgt in self._fast_map.items():
+            data = self.ipc.fast_drain(idx)
+            if data:
+                (self.stdout if tgt == 1 else self.stderr).append(data)
+
+    def _fast_pre_reply(self):
+        if self._fast_dirty:
+            self._fast_dirty = False
+            self._fast_sync()
 
     def _register_heap(self):
         """Map the shim's shared heap file so _vm_* serve heap accesses by
@@ -1194,6 +1352,7 @@ class NativeProcess:
         if self._child is not None and self._child.poll() is None:
             self._child.kill()
             self._child.wait()
+        self._fast_drain()  # dying mid-burst: rescue unflushed ring bytes
         self.ipc.close()
         if self.parent is not None and self.parent.state == "running":
             parent = self.parent
@@ -1266,12 +1425,16 @@ class NativeProcess:
                 else:
                     self._cur = t
                     self.ipc.cur_slot = t.slot
+                    if self._fast_map:
+                        self._fast_drain()
                     self._handle(stash[1], stash[2])
                     if t.state != "running":
                         self._runner = None
                 continue
             self.syscall_count += 1
             self.host.counters["syscalls"] += 1
+            if self._fast_map:
+                self._fast_drain()  # ring bytes precede this trap: order
             self._cur = t
             # pending signals run their handlers BEFORE the syscall is
             # serviced (syscall entry = the deterministic delivery point)
@@ -1567,6 +1730,7 @@ class NativeProcess:
         if msg is None or msg[0] != MSG_START:
             self._die(97)
             return
+        self._fast_init()  # fresh block; entries from the inherited tables
         self.ipc.reply_slot(0, MSG_START_OK)
         self._service_loop()
 
@@ -1859,6 +2023,10 @@ class NativeProcess:
         """Returns True if the service loop should stop (blocked/exited)."""
         cpid = self._child.pid
         name = _N2NAME.get(num, str(num))
+        if num in _FAST_MUTATORS:
+            # this call may remap what fd 1/2 mean; re-sync the fast
+            # table before the arm's reply resumes the guest (pre_reply)
+            self._fast_dirty = True
         if self.strace is not None:
             self.strace(self.host.now(), self.pid, name, tuple(args[:3]), None)
 
@@ -4087,6 +4255,10 @@ class NativeProcess:
             return True
         self._register_heap()  # the new image set up its own window
         self._publish_ids()  # same pid/ids, NEW ipc block
+        self.ipc.pre_reply = self._fast_pre_reply
+        self._fast_map = {}  # old entries died with the old block
+        self._fast_dirty = False
+        self._fast_init()
         self.ipc.reply_slot(0, MSG_START_OK)
         return False  # service loop continues with the new image
 

@@ -1,0 +1,272 @@
+"""Descriptor fast path: write(2) on captured stdio answered inside the
+shim from a shared ring (native/ipc.h FastFd; the shim_sys.c
+answer-hot-calls-locally precedent extended to descriptors).
+
+The gates here are the dangerous paths: entry invalidation when fd 1/2
+is remapped (dup2 of a socket over stdout MUST stop the ring), ordering
+across slow-path writev interleavings, ring overflow, fork/exec block
+swaps, and byte-equality against the all-slow-path strace mode."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from shadow_tpu.host import CpuHost, HostConfig
+from shadow_tpu.host.network import CpuNetwork
+from shadow_tpu.native_plane import ensure_built, spawn_native
+
+MS = 1_000_000
+SEC = 1_000_000_000
+
+pytestmark = pytest.mark.skipif(
+    not ensure_built(), reason="native toolchain unavailable"
+)
+
+
+def _run_sh(script: str, stop=2 * SEC, strace=None, hosts=1, latency=10 * MS):
+    hs = [
+        CpuHost(HostConfig(name=f"h{i}", ip=f"10.0.0.{i + 1}", seed=3,
+                           host_id=i))
+        for i in range(hosts)
+    ]
+    net = CpuNetwork(hs, latency_ns=lambda s, d: latency)
+    p = spawn_native(hs[0], ["/bin/sh", "-c", script])
+    if strace is not None:
+        p.strace = strace
+    net.run(stop)
+    return hs[0], p
+
+
+def test_fast_writes_hit_and_capture_in_order():
+    h, p = _run_sh(
+        "i=0; while [ $i -lt 150 ]; do echo out$i; i=$((i+1)); done"
+    )
+    out = b"".join(p.stdout)
+    assert out.count(b"\n") == 150
+    assert out.startswith(b"out0\n") and out.endswith(b"out149\n")
+    assert h.counters["syscalls_fast"] >= 150
+    # fast calls are folded into the total, not double-booked
+    assert h.counters["syscalls"] >= h.counters["syscalls_fast"]
+    assert p.exit_code == 0
+
+
+def test_stderr_redirect_interleaves_on_one_stream():
+    """2>&1 makes fd 2's fast entry target the STDOUT buffer; strict
+    program order must survive the two entries draining into one list."""
+    h, p = _run_sh(
+        "exec 2>&1; i=0; while [ $i -lt 40 ]; do "
+        "echo o$i; echo e$i 1>&2; i=$((i+1)); done"
+    )
+    out = b"".join(p.stdout).decode()
+    assert b"".join(p.stderr) == b""
+    lines = out.splitlines()
+    assert lines[:4] == ["o0", "e0", "o1", "e1"]
+    assert len(lines) == 80
+    assert h.counters["syscalls_fast"] > 0
+
+
+def test_large_write_rides_slow_path_in_order():
+    """A single write larger than the 32 KiB ring must forward (slow
+    path) while neighboring small writes stay fast — byte order intact
+    within ONE process (no pipeline children muddying the capture)."""
+    hs = [CpuHost(HostConfig(name="a", ip="10.0.0.1", seed=3, host_id=0))]
+    net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+    p = spawn_native(hs[0], [
+        "/usr/bin/python3", "-c",
+        "import os\n"
+        "os.write(1, b'head\\n')\n"
+        "os.write(1, b'x' * 65536)\n"  # > FASTFD_RING_CAP: slow path
+        "os.write(1, b'\\ntail\\n')\n",
+    ])
+    net.run(2 * SEC)
+    out = b"".join(p.stdout)
+    assert out.startswith(b"head\n")
+    assert out.endswith(b"\ntail\n")
+    assert out.count(b"x") == 65536
+    assert hs[0].counters["syscalls_fast"] > 0
+
+
+def test_dup2_socket_over_stdout_invalidates_entry():
+    """After dup2(sock, 1), writes to fd 1 must reach the SOCKET — a
+    stale fast entry would silently swallow them into the capture
+    buffer. Exercised via a shell that redirects echo into a UDP
+    connection (/dev/udp is a bash-ism; use a python3 guest instead)."""
+    hs = [
+        CpuHost(HostConfig(name=f"h{i}", ip=f"10.0.0.{i + 1}", seed=3,
+                           host_id=i))
+        for i in range(2)
+    ]
+    net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+    srv = spawn_native(hs[0], [
+        "/usr/bin/python3", "-c",
+        "import socket\n"
+        "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+        "s.bind(('10.0.0.1', 7000))\n"
+        "d, a = s.recvfrom(100)\n"
+        "print('got', d.decode().strip())\n",
+    ])
+    cli = spawn_native(hs[1], [
+        "/usr/bin/python3", "-c",
+        "import os, socket, sys\n"
+        "print('before-dup')\n"
+        "sys.stdout.flush()\n"
+        "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+        "s.connect(('10.0.0.1', 7000))\n"
+        "os.dup2(s.fileno(), 1)\n"
+        "os.write(1, b'via-socket\\n')\n"  # must hit the wire, not capture
+        "os.dup2(2, 1)\n"  # restore a captured stream
+        "os.write(1, b'after-restore\\n')\n",
+    ], start_time=50 * MS)
+    net.run(3 * SEC)
+    assert b"got via-socket" in b"".join(srv.stdout)
+    cli_out = b"".join(cli.stdout) + b"".join(cli.stderr)
+    assert b"before-dup" in cli_out
+    assert b"after-restore" in cli_out
+    assert b"via-socket" not in cli_out  # never captured
+
+
+def test_fork_children_get_their_own_fast_entries():
+    h, p = _run_sh(
+        "echo parent-pre; (echo child-sub); /bin/echo forked-image; "
+        "echo parent-post"
+    )
+    out = b"".join(p.stdout)
+    assert b"parent-pre\n" in out and b"parent-post\n" in out
+    # forked children (subshell + external command) write through their
+    # OWN blocks' fast entries into their own captures (reaped children
+    # leave p.children; the host process table keeps them)
+    child_out = b"".join(
+        b"".join(pr.stdout)
+        for pid, pr in sorted(h.processes.items())
+        if pr is not p
+    )
+    assert b"child-sub\n" in child_out
+    assert b"forked-image\n" in child_out
+    assert out.count(b"\n") == 2  # nothing leaked across captures
+
+
+def test_execve_swaps_blocks_without_losing_bytes():
+    """Output written fast BEFORE an in-place exec must survive the IPC
+    block swap; the new image's writes flow through fresh entries."""
+    h, p = _run_sh("echo pre-exec; exec /bin/echo post-exec")
+    out = b"".join(p.stdout)
+    assert out == b"pre-exec\npost-exec\n"
+    assert p.exit_code == 0
+
+
+def test_strace_mode_is_byte_identical_to_fast_mode():
+    """strace modes disable the fast path entirely; the captured bytes
+    must be identical either way (the slow-vs-fast determinism gate)."""
+    script = (
+        "i=0; while [ $i -lt 60 ]; do echo ln$i; echo er$i 1>&2; "
+        "i=$((i+1)); done"
+    )
+
+    def run(mode_fast: bool):
+        hs = [CpuHost(HostConfig(name="a", ip="10.0.0.1", seed=3,
+                                 host_id=0))]
+        net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+        p = spawn_native(hs[0], ["/bin/sh", "-c", script])
+        if not mode_fast:
+            p.strace = lambda *a: None  # any strace hook forces slow path
+        net.run(2 * SEC)
+        return (b"".join(p.stdout), b"".join(p.stderr),
+                hs[0].counters["syscalls"], hs[0].counters["syscalls_fast"])
+
+    fo, fe, fn, ff = run(True)
+    so, se, sn, sf = run(False)
+    assert (fo, fe) == (so, se)
+    assert sf == 0 and ff > 0
+    assert fn == sn  # folded accounting matches trap-per-call exactly
+
+
+def test_same_stream_aliases_keep_program_order():
+    """dup2(1, 2) then ALTERNATING write(1)/write(2) with no other
+    syscalls in between: both fds append to the stdout buffer, and the
+    capture must preserve strict program order. (Review catch: two
+    independent rings for one stream drained back-to-back lost the
+    interleaving; now at most one fd per stream is fast and the other's
+    slow-path trap drains first.)"""
+    hs = [CpuHost(HostConfig(name="a", ip="10.0.0.1", seed=3, host_id=0))]
+    net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+    p = spawn_native(hs[0], [
+        "/usr/bin/python3", "-c",
+        "import os\n"
+        "os.dup2(1, 2)\n"
+        "for i in range(30):\n"
+        "    os.write(1, b'A%d ' % i)\n"
+        "    os.write(2, b'B%d ' % i)\n",
+    ])
+    net.run(2 * SEC)
+    out = b"".join(p.stdout).decode()
+    expect = "".join(f"A{i} B{i} " for i in range(30))
+    assert out == expect
+    assert hs[0].counters["syscalls_fast"] > 0  # fd 1 stayed fast
+
+
+def test_close_range_resyncs_fast_table():
+    """close_range mutates the capture tables (runc/systemd hygiene);
+    a stale fast entry must not survive it. Gate: byte-equality with
+    the all-slow-path strace mode on the same workload."""
+    code = (
+        "import os\n"
+        "os.write(1, b'before\\n')\n"
+        "os.close_range(3, 1023)\n"  # hygiene sweep, fds 1/2 untouched
+        "os.write(1, b'after\\n')\n"
+        "os.write(2, b'err\\n')\n"
+    )
+
+    def run(fast: bool):
+        hs = [CpuHost(HostConfig(name="a", ip="10.0.0.1", seed=3,
+                                 host_id=0))]
+        net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+        p = spawn_native(hs[0], ["/usr/bin/python3", "-c", code])
+        if not fast:
+            p.strace = lambda *a: None
+        net.run(2 * SEC)
+        return b"".join(p.stdout), b"".join(p.stderr), p.exit_code
+
+    assert run(True) == run(False)
+
+
+def test_bad_buffer_returns_efault_not_sigsegv():
+    """write(1, bad_ptr, n) on a fast fd must fail exactly like the slow
+    path (-EFAULT surfaced as OSError), not kill the guest with SIGSEGV
+    inside the SIGSYS handler. The shim copies into the ring via
+    process_vm_readv-on-self so the kernel does the fault check (note a
+    devnull write-probe canNOT work: /dev/null never reads the buffer)."""
+    hs = [CpuHost(HostConfig(name="a", ip="10.0.0.1", seed=3, host_id=0))]
+    net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+    p = spawn_native(hs[0], [
+        "/usr/bin/python3", "-c",
+        "import ctypes, os\n"
+        "os.write(1, b'alive\\n')\n"
+        "write = ctypes.CDLL(None, use_errno=True).write\n"
+        "r = write(1, ctypes.c_void_p(0x10), 16)\n"  # unmapped pointer
+        "assert r == -1 and ctypes.get_errno() == 14, (r, ctypes.get_errno())\n"
+        "os.write(1, b'survived\\n')\n",
+    ])
+    net.run(2 * SEC)
+    out = b"".join(p.stdout)
+    assert out == b"alive\nsurvived\n", (out, b"".join(p.stderr))
+    assert p.exit_code == 0
+    assert p.term_signal is None
+
+
+def test_latency_model_escape_still_advances_time():
+    """With model_unblocked_syscall_latency on, every Nth fast write
+    forwards so a write loop cannot freeze simulated time."""
+    hs = [CpuHost(HostConfig(name="a", ip="10.0.0.1", seed=3, host_id=0,
+                             model_unblocked_latency=True))]
+    net = CpuNetwork(hs, latency_ns=lambda s, d: 10 * MS)
+    p = spawn_native(hs[0], [
+        "/bin/sh", "-c",
+        "i=0; while [ $i -lt 300 ]; do echo t$i; i=$((i+1)); done",
+    ])
+    net.run(2 * SEC)
+    out = b"".join(p.stdout)
+    assert out.count(b"\n") == 300
+    assert hs[0].counters["syscalls_fast"] > 0
+    assert p.exit_code == 0

@@ -124,6 +124,28 @@ def test_per_host_pool_thread_stability():
     assert len(set(all_tids)) == 6
 
 
+def test_per_host_pool_default_host_ids_still_get_distinct_threads():
+    """Hosts left at the default host_id (0) must NOT collapse onto one
+    thread — keying is by object identity (review catch)."""
+
+    class FakeHost:
+        host_id = 0  # everyone at the default
+
+    hosts = [FakeHost() for _ in range(4)]
+    pool = ThreadPerHostPool(parallelism=4)
+    tids: dict[int, int] = {}
+    lock = threading.Lock()
+
+    def work(h):
+        with lock:
+            tids[id(h)] = threading.get_ident()
+
+    pool.run(hosts, work)
+    pool.shutdown()
+    assert pool.thread_count == 4
+    assert len(set(tids.values())) == 4
+
+
 def test_per_host_pool_parallelism_bound():
     """The semaphore bounds how many hosts RUN concurrently even though
     every host has its own thread (ParallelismBoundedThreadPool)."""

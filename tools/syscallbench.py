@@ -53,7 +53,8 @@ def main() -> int:
     assert ensure_built(), "native plane unavailable"
     iters = int(sys.argv[1]) if len(sys.argv) > 1 else 30_000
     rows = {
-        m: run_mode(m, iters) for m in ("clock", "getpid", "fcntl", "pipe")
+        m: run_mode(m, iters)
+        for m in ("clock", "getpid", "stdout", "fcntl", "pipe")
     }
     # the clock mode's per-call time is the shim-local floor; the fcntl
     # round trip minus that floor is the IPC + Python dispatch cost
@@ -63,6 +64,7 @@ def main() -> int:
             {
                 "clock_local_us": rows["clock"]["us_per_call"],
                 "getpid_local_us": rows["getpid"]["us_per_call"],
+                "stdout_write_us": rows["stdout"]["us_per_call"],
                 "fcntl_roundtrip_us": rows["fcntl"]["us_per_call"],
                 "pipe_rw_us": rows["pipe"]["us_per_call"],
                 "roundtrip_minus_local_us": round(rt, 2),
