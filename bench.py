@@ -297,6 +297,11 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
                 # as a server) + control, or budget drops act as loss
                 "sends_per_host_round": 40,
                 "rounds_per_chunk": 256,
+                # merge_rows deliberately unset: measured on this workload
+                # (66k sends/round avg, >121k peaks) a 196k truncation was
+                # behavior-clean but 2 ms/round SLOWER than the full 410k
+                # permute — an XLA scheduling artifact, A/B-verified twice.
+                # 128k and below shed (protocol-visible). See BASELINE.md.
             },
             "hosts": host_groups,
         }

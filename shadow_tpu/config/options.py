@@ -153,6 +153,12 @@ class ExperimentalOptions:
     # results while stats.a2a_shed stays 0 (see EngineConfig.exchange)
     exchange: str = "gather"
     a2a_block: int = 0  # entries per (src, dst-shard) block; 0 = auto
+    # static cap on post-sort merge gather rows (0 = unbounded): bounds the
+    # exchange-merge's per-round gather work at the real traffic level
+    # instead of the worst-case outbox (hosts x send budget). Exact while
+    # per-round packets + hosts + 1 <= merge_rows; overflow sheds loudly
+    # into queue_overflow_dropped. See EngineConfig.merge_rows.
+    merge_rows: int = 0
     # packet delivery breadcrumbs on the CPU host planes (reference
     # packet.rs:16-39), debug-only: drops land in host-stats.json with
     # their full hop trail
@@ -275,6 +281,7 @@ class ExperimentalOptions:
             "rounds_per_chunk",
             "microstep_limit",
             "host_workers",
+            "merge_rows",
         ):
             if f in d:
                 setattr(e, f, int(d.pop(f)))

@@ -225,6 +225,19 @@ class EngineConfig:
     #                so it stays 0 — every test asserts it).
     exchange: str = "gather"
     a2a_block: int = 0  # 0 -> auto: 2 * outbox_rows / world, >= 64
+    # Static cap on post-sort merge gather work (ops/merge.py): only the
+    # first `merge_rows` sorted exchange rows are materialized. Exact while
+    # (valid rows + num_hosts + 1) <= merge_rows; beyond it rows shed by
+    # sorted position and count in queue.dropped. 0 = unbounded (the full
+    # worst-case outbox, num_hosts * sends_per_host_round rows).
+    merge_rows: int = 0
+    # Trace-time affine-routing constant, set by Engine.init_state when the
+    # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
+    # shape every `count:`-group config produces): the per-send node lookup
+    # becomes an integer divide on the VPU instead of a 10k-descriptor
+    # gather (measured 83 us per microstep per send port at H=10k). 0 = map
+    # is irregular, gather stays.
+    hosts_per_node: int = 0
 
     def __post_init__(self):
         check_order_limits(self.num_hosts)
@@ -578,6 +591,17 @@ class Engine:
         rows_ok = cfg.num_hosts * n_nodes <= 32 << 20 and not _os.environ.get(
             "SHADOW_TPU_FORCE_GATHER_ROUTING"
         )
+        # affine host->node detection (see EngineConfig.hosts_per_node)
+        if n_nodes > 1:
+            node_np = np.asarray(params.node_of)
+            counts = np.bincount(node_np, minlength=n_nodes)
+            g = int(counts[0])
+            if (
+                g > 0
+                and (counts == g).all()
+                and (node_np == np.arange(node_np.shape[0]) // g).all()
+            ):
+                self.cfg = cfg = dataclasses.replace(cfg, hosts_per_node=g)
         if params.lat_ns.shape != (1, 1) and rows_ok and params.lat_rows is None:
             # materialize the per-host routing rows (see EngineParams)
             with host_build_context():
@@ -934,10 +958,15 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             lossp = jnp.broadcast_to(params.loss[0, 0], dst.shape)
             jit = jnp.broadcast_to(params.jitter_ns[0, 0], dst.shape)
         elif params.lat_rows is not None:
-            # ONE gather (dst -> node), then a one-hot masked reduction
-            # over the node axis for each table — vector work on the VPU
-            # instead of scalar-core gathers (see EngineParams.lat_rows)
-            dst_node = params.node_of[dst].astype(jnp.int32)
+            # node lookup (then a one-hot masked reduction over the node
+            # axis for each table — vector work on the VPU instead of
+            # scalar-core gathers, see EngineParams.lat_rows). With an
+            # affine host->node map even the lookup's gather disappears
+            # into a VPU divide (EngineConfig.hosts_per_node).
+            if cfg.hosts_per_node > 0:
+                dst_node = (dst // cfg.hosts_per_node).astype(jnp.int32)
+            else:
+                dst_node = params.node_of[dst].astype(jnp.int32)
             n_nodes = params.lat_rows.shape[1]
             eq = (
                 jnp.arange(n_nodes, dtype=jnp.int32)[None, :]
@@ -947,8 +976,12 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             lossp = jnp.sum(jnp.where(eq, params.loss_rows, 0.0), axis=1)
             jit = jnp.sum(jnp.where(eq, params.jit_rows, 0), axis=1)
         else:
-            src_node = params.node_of[host_gid]
-            dst_node = params.node_of[dst]
+            if cfg.hosts_per_node > 0:
+                src_node = host_gid // cfg.hosts_per_node
+                dst_node = dst // cfg.hosts_per_node
+            else:
+                src_node = params.node_of[host_gid]
+                dst_node = params.node_of[dst]
             lat = params.lat_ns[src_node, dst_node]
             lossp = params.loss[src_node, dst_node]
             jit = params.jitter_ns[src_node, dst_node]
@@ -1094,11 +1127,12 @@ def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
     The merge's sort dominates round cost; rounds where NO shard sent
     anything (timer-heavy workloads, drained phases) skip it entirely —
     `has_sends` is identical on all shards, so the branch is uniform
-    across the mesh. The cond wraps only the PLAN (sort + gathers) at
-    large capacities: branches returning the whole queue forced XLA to
-    copy every slab at the branch boundary each round — traced at ~55% of
-    the PHOLD-torus round cost — while the plan is one packed [H, C, W]
-    block and the apply runs unconditionally as a single where-pass."""
+    across the mesh. The cond wraps only the PLAN (sorts + SoA sorted
+    vectors): branches returning the whole queue forced XLA to copy every
+    slab at the branch boundary each round — traced at ~55% of the
+    PHOLD-torus round cost — while the plan is one [H, C] index map plus
+    [K]-vector sorted fields, cheap to copy at every capacity. The apply
+    runs unconditionally as a single where-pass."""
     if jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
         # Fused merge inside the cond. On CPU the scatter path is faster
         # and branch copies are cheap. On TPU this wins at SMALL slab
@@ -1111,6 +1145,7 @@ def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
             lambda queue: merge_flat_events(
                 queue, *flat, cfg.max_round_inserts,
                 shed_urgency=not cfg.cheap_shed,
+                merge_rows=cfg.merge_rows,
             ),
             lambda queue: queue,
             queue0,
@@ -1126,6 +1161,7 @@ def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
         lambda q_t: merge_plan(
             q_t, *flat, cfg.max_round_inserts,
             shed_urgency=not cfg.cheap_shed,
+            merge_rows=cfg.merge_rows,
         ),
         lambda q_t: merge_empty_plan(q_t, p_words),
         queue0.t,

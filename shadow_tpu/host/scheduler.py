@@ -160,8 +160,10 @@ class ThreadPerHostPool:
     process leans on stay put for the host's whole lifetime.
 
     Python recast: a dedicated worker thread is created the first time a
-    host is scheduled (keyed by `host_id` when present, else identity)
-    and every subsequent round runs that host on the SAME thread — the
+    host is scheduled (keyed by host object identity — NOT `host_id`,
+    which collapses distinct hosts carrying a default/duplicate id onto
+    one thread) and every subsequent round runs that host on the SAME
+    thread — the
     TLS-stability guarantee, asserted by tests. A semaphore bounds
     concurrent execution to `parallelism` (the reference's bounded pool);
     blocked-in-futex native hosts release the GIL, so the bound governs

@@ -1225,11 +1225,16 @@ class NativeProcess:
     @strace.setter
     def strace(self, fn):
         self._strace = fn
-        if fn is not None and self._fast_map:
-            self._fast_drain()  # rescue bytes written before attach
-            for idx in self._fast_map:
-                self.ipc.fast_clear_entry(idx)
-            self._fast_map = {}
+        if fn is not None:
+            # disable unconditionally — not only when entries are live:
+            # a transiently-empty _fast_map (e.g. both stdio fds shadowed
+            # at attach time) must not leave the path armed for
+            # _fast_sync to re-enable behind the hook's back
+            if self._fast_map:
+                self._fast_drain()  # rescue bytes written before attach
+                for idx in self._fast_map:
+                    self.ipc.fast_clear_entry(idx)
+                self._fast_map = {}
             self.ipc.fast_set_enabled(False)
 
     # ---- descriptor fast path ---------------------------------------------
@@ -1263,7 +1268,10 @@ class NativeProcess:
         BEFORE appending — program order per stream is exact either way."""
         want: dict[int, int] = {}
         claimed: set[int] = set()
-        for fd in (1, 2):
+        # strace must see EVERY call: never (re-)arm entries while a hook
+        # is attached, whatever the fd table looks like now (want stays
+        # empty, so the diff below clears any live entries)
+        for fd in (1, 2) if self._strace is None else ():
             if fd not in self._vfds:
                 tgt = self._stdio_target(fd)
                 if tgt is not None and tgt not in claimed:

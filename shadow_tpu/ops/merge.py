@@ -28,6 +28,21 @@ TWO gathers run — the sorted->original index map `s_idx[j]`, then ONE
 row-gather of all event fields bit-packed into an [N, W] i32 matrix (row
 gathers move contiguous words, amortizing the per-element index cost that made
 seven separate field gathers the dominant merge cost).
+
+`merge_rows` (round 5) statically truncates the sorted-permute gather: every
+row a non-shedding round needs lives in the first (valid + H + 1) sorted
+positions, so only that prefix is materialized — the permute cost tracks the
+REAL per-round traffic instead of the worst-case outbox (H x send budget).
+Rows past the bound shed by sorted position and are counted, never silent.
+
+Formulations tried and rejected in round 5 (measured on the v5e, kept for
+the record — all three looked faster in isolated microbenches and were not):
+  - fully-SoA element gathers per field: in-context element gathers are
+    descriptor-rate-bound (~7 ns/element, ~5 gathers) — 8.6 s/chunk vs the
+    packed row gather's 0.74 s (row descriptors amortize all 9 words);
+  - vmap(dynamic_slice) per-host contiguous blocks: lowers to a
+    10k-iteration while LOOP on TPU (~0.45 s per field per chunk);
+  - lax.gather with multi-element slice_sizes: same while-loop lowering.
 """
 
 from __future__ import annotations
@@ -40,7 +55,8 @@ from shadow_tpu.ops.events import EventQueue
 from shadow_tpu.simtime import TIME_MAX
 
 
-def _merge_scatter(q, s_dst, s_idx, t, order, kind, payload, r_cap):
+def _merge_scatter(q, s_dst, s_idx, t, order, kind, payload, r_cap,
+                   merge_rows=0):
     """CPU insertion path: rank entries within their dst segment and scatter
     each into its dst's rank-th free slot (the round-1 formulation)."""
     num_hosts, cap = q.t.shape
@@ -63,6 +79,14 @@ def _merge_scatter(q, s_dst, s_idx, t, order, kind, payload, r_cap):
     slot_of_rank = slot_of_rank.at[hh, scatter_r].set(cc, mode="drop")
 
     in_rank = s_valid & (rank < r_cap)
+    if merge_rows > 0:
+        # mirror the gather path's positional truncation bit-exactly: its
+        # sorted array interleaves one token per host, so this path's
+        # position p sits at gather position p + s_dst[p] + 1 (tokens for
+        # hosts 0..s_dst[p] precede it). Rows landing at or past the bound
+        # shed there and must shed identically here.
+        gather_pos = jnp.arange(n, dtype=jnp.int64) + s_dst + 1
+        in_rank = in_rank & (gather_pos < merge_rows)
     h_safe = jnp.where(s_valid, s_dst, 0).astype(jnp.int32)
     r_safe = jnp.where(in_rank, rank, 0).astype(jnp.int32)
     slot = slot_of_rank[h_safe, r_safe]
@@ -107,6 +131,7 @@ def merge_plan(
     valid,
     max_inserts: int,
     shed_urgency: bool = True,
+    merge_rows: int = 0,
 ):
     """The sort/gather half of the gather-path merge, WITHOUT writing the
     queue: returns (take bool[H, C], g i32[H, C, W], dropped_add i64[H]).
@@ -121,7 +146,8 @@ def merge_plan(
     copy the slabs around the branch anyway (measured as a 40% round-cost
     regression on PHOLD-torus before the narrowing)."""
     return _merge_gather_plan(
-        q_t, dst, t, order, kind, payload, valid, max_inserts, shed_urgency
+        q_t, dst, t, order, kind, payload, valid, max_inserts, shed_urgency,
+        merge_rows,
     )
 
 
@@ -159,6 +185,7 @@ def merge_flat_events(
     max_inserts: int,
     shed_urgency: bool = True,
     force_path: str | None = None,  # tests: 'gather' | 'scatter'
+    merge_rows: int = 0,
 ) -> EventQueue:
     """`shed_urgency=True` (default): overflow sheds by (time, order) so the
     most urgent events always win slots — the tested contract. False: a
@@ -192,19 +219,22 @@ def merge_flat_events(
             s_packed = lax.sort(packed)
             s_dst = (s_packed >> idx_bits).astype(jnp.int32)
             s_idx = (s_packed & ((1 << idx_bits) - 1)).astype(jnp.int32)
-        return _merge_scatter(q, s_dst, s_idx, t, order, kind, payload, r_cap)
+        return _merge_scatter(
+            q, s_dst, s_idx, t, order, kind, payload, r_cap, merge_rows
+        )
 
     return merge_apply(
         q,
         *_merge_gather_plan(
             q.t, dst, t, order, kind, payload, valid, max_inserts,
-            shed_urgency
+            shed_urgency, merge_rows
         ),
     )
 
 
 def _merge_gather_plan(
-    q_t, dst, t, order, kind, payload, valid, max_inserts, shed_urgency
+    q_t, dst, t, order, kind, payload, valid, max_inserts, shed_urgency,
+    merge_rows=0,
 ):
     num_hosts, cap = q_t.shape
     n = dst.shape[0]
@@ -269,19 +299,27 @@ def _merge_gather_plan(
     seg_len = first[1:] - first[:-1] - 1  # i32[H]
 
     # -- 3. r-th free slot of host h gathers sorted entry at
-    # first[h] + 1 + r (the +1 skips host h's own token)
+    # first[h] + 1 + r (the +1 skips host h's own token), bounded by the
+    # segment length, the insert cap, and the merge_rows truncation
+    k = m if merge_rows <= 0 else min(merge_rows, m)
+    n_ins = jnp.minimum(
+        jnp.minimum(seg_len, r_cap),
+        jnp.maximum(k - 1 - first[:-1], 0),
+    )  # i32[H]
     free = q_t == TIME_MAX  # [H, C]
     free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1  # [H, C]
-    take = free & (free_rank < r_cap) & (free_rank < seg_len[:, None])
+    take = free & (free_rank < n_ins[:, None])
     j = jnp.where(take, first[:-1, None] + 1 + free_rank, 0)  # [H, C]
     words = _pack_words(t, order, kind.astype(jnp.int32), payload)
-    # row permutation (gather 1); token rows (s_idx == -1) wrap to the last
-    # row — never selected by `take`, and harmless to fetch. Note (r5): the
-    # composed form `words[s_idx[j]]` — skipping the [M, W] materialization
-    # — was tried and measured ~7% SLOWER at M = 400k: the second gather's
-    # rows are near-sequential in w_sorted (per-host segments) but random
-    # in the original entry order, and locality wins over the saved pass.
-    w_sorted = words[s_idx]  # [M, W]
+    # row permutation (gather 1), truncated to the first k sorted positions
+    # (every row `take` can reference satisfies j < k by the n_ins bound);
+    # token rows (s_idx == -1) wrap to the last row — never selected by
+    # `take`, and harmless to fetch. Note (r5): the composed form
+    # `words[s_idx[j]]` — skipping the [M, W] materialization — was tried
+    # and measured ~7% SLOWER at M = 400k: the second gather's rows are
+    # near-sequential in w_sorted (per-host segments) but random in the
+    # original entry order, and locality wins over the saved pass.
+    w_sorted = words[s_idx[:k]]  # [K, W]
     g = w_sorted[j]  # [H, C, W] row gather — all fields at once (gather 2)
 
     # -- overflow accounting (elementwise: order-independent, deterministic)

@@ -388,6 +388,7 @@ class Simulation:
             cpu_delay_ns=ex.cpu_delay,
             exchange=ex.exchange,
             a2a_block=ex.a2a_block,
+            merge_rows=ex.merge_rows,
         )
         mesh = None
         if world > 1:

@@ -187,3 +187,102 @@ def test_merge_gather_and_scatter_paths_agree():
                 assert np.array_equal(np.asarray(fa), np.asarray(fb)), (
                     f"trial {trial} shed={shed} field {name}"
                 )
+
+
+def test_merge_rows_truncation_exact_when_sized():
+    """merge_rows >= valid + H + 1 must change nothing (gather path)."""
+    rng = np.random.default_rng(11)
+    hh, cc, n = 6, 4, 30
+    q = make_queue(hh, cc)
+    dst = jnp.asarray(rng.integers(0, hh, n), jnp.int32)
+    t = jnp.asarray(rng.integers(1, 1000, n), jnp.int64)
+    order = jnp.asarray(
+        [int(pack_order(0, int(rng.integers(0, hh)), i)) for i in range(n)],
+        jnp.int64,
+    )
+    kind = jnp.asarray(rng.integers(0, 5, n), jnp.int32)
+    payload = jnp.asarray(
+        rng.integers(0, 100, (n, EVENT_PAYLOAD_WORDS)), jnp.int32
+    )
+    valid = jnp.asarray(rng.random(n) < 0.7)
+    a = merge_flat_events(
+        q, dst, t, order, kind, payload, valid, max_inserts=cc,
+        force_path="gather",
+    )
+    b = merge_flat_events(
+        q, dst, t, order, kind, payload, valid, max_inserts=cc,
+        force_path="gather", merge_rows=n + hh + 1,
+    )
+    for fa, fb, name in zip(a, b, a._fields):
+        assert np.array_equal(np.asarray(fa), np.asarray(fb)), name
+
+
+def test_merge_rows_truncation_sheds_counted():
+    """An undersized merge_rows sheds by sorted position — and every shed
+    event lands in `dropped`, never silently."""
+    hh, cc = 3, 4
+    q = make_queue(hh, cc)
+    n = 9
+    dst = jnp.asarray([0, 0, 0, 1, 1, 1, 2, 2, 2], jnp.int32)
+    t = jnp.arange(1, n + 1, dtype=jnp.int64)
+    order = jnp.asarray([int(pack_order(0, 0, i)) for i in range(n)], jnp.int64)
+    kind = jnp.zeros((n,), jnp.int32)
+    payload = jnp.zeros((n, EVENT_PAYLOAD_WORDS), jnp.int32)
+    valid = jnp.ones((n,), bool)
+    # full run inserts all 9
+    full = merge_flat_events(
+        q, dst, t, order, kind, payload, valid, max_inserts=cc,
+        force_path="gather",
+    )
+    assert int(np.asarray(queue_len(full)).sum()) == 9
+    assert int(np.asarray(full.dropped).sum()) == 0
+    # sorted layout: [tok0, e0, e1, e2, tok1, e3, e4, e5, tok2, e6, e7, e8,
+    # tok3]; merge_rows=7 keeps positions < 7 -> host 0 whole, host 1 only
+    # its first entry (position 5, 6 -> e3 at 5... entries at 5,6 = e3, e4)
+    cut = merge_flat_events(
+        q, dst, t, order, kind, payload, valid, max_inserts=cc,
+        force_path="gather", merge_rows=7,
+    )
+    kept = int(np.asarray(queue_len(cut)).sum())
+    shed = int(np.asarray(cut.dropped).sum())
+    assert kept + shed == 9
+    assert kept == 5  # host0: 3, host1: 2 (positions 5, 6), host2: 0
+    # host 0 intact, host 2 fully shed
+    assert int(np.asarray(queue_len(cut))[0]) == 3
+    assert int(np.asarray(queue_len(cut))[2]) == 0
+
+
+def test_merge_rows_truncation_paths_agree():
+    """merge_rows sheds must be bit-identical between the gather path and
+    the scatter path (the scatter side mirrors the token-interleaved
+    positional cut) — cross-backend digest stability in the shed regime."""
+    rng = np.random.default_rng(23)
+    for trial in range(6):
+        hh, cc = int(rng.integers(2, 10)), int(rng.integers(2, 6))
+        n = int(rng.integers(4, 40))
+        mr = int(rng.integers(2, n + hh + 2))
+        q = make_queue(hh, cc)
+        dst = jnp.asarray(rng.integers(0, hh, n), jnp.int32)
+        t = jnp.asarray(rng.integers(1, 1000, n), jnp.int64)
+        order = jnp.asarray(
+            [int(pack_order(0, int(rng.integers(0, hh)), 50 + i)) for i in range(n)],
+            jnp.int64,
+        )
+        kind = jnp.asarray(rng.integers(0, 5, n), jnp.int32)
+        payload = jnp.asarray(
+            rng.integers(0, 100, (n, EVENT_PAYLOAD_WORDS)), jnp.int32
+        )
+        valid = jnp.asarray(rng.random(n) < 0.8)
+        for shed in (True, False):
+            a = merge_flat_events(
+                q, dst, t, order, kind, payload, valid, max_inserts=cc,
+                shed_urgency=shed, force_path="gather", merge_rows=mr,
+            )
+            b = merge_flat_events(
+                q, dst, t, order, kind, payload, valid, max_inserts=cc,
+                shed_urgency=shed, force_path="scatter", merge_rows=mr,
+            )
+            for fa, fb, name in zip(a, b, a._fields):
+                assert np.array_equal(np.asarray(fa), np.asarray(fb)), (
+                    f"trial {trial} shed={shed} mr={mr} field {name}"
+                )

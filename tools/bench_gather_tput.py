@@ -1,0 +1,62 @@
+"""Measure raw TPU gather throughput: element gathers from vectors vs row
+gathers from [N, W] matrices, at merge-relevant shapes. Indices are passed
+as arguments (no constant folding); donate nothing; block on results."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+rng = np.random.default_rng(3)
+K = 65_536
+
+
+def timed(f, *args, n=50):
+    g = jax.jit(f)
+    jax.block_until_ready(g(*args))
+    t0 = time.monotonic()
+    for _ in range(n):
+        o = g(*args)
+    jax.block_until_ready(o)
+    return (time.monotonic() - t0) / n * 1000
+
+
+def main():
+    vec32 = jnp.asarray(rng.integers(0, 2**31, K, np.int64), jnp.int32)
+    vec64 = jnp.asarray(rng.integers(0, 2**62, K, np.int64), jnp.int64)
+    for out_n in (10_000, 160_000, 320_000, 640_000):
+        idx = jnp.asarray(rng.integers(0, K, out_n).astype(np.int32))
+        t32 = timed(lambda v, i: v[i], vec32, idx)
+        t64 = timed(lambda v, i: v[i], vec64, idx)
+        print(
+            f"element gather out={out_n:7d}: i32 {t32:7.3f} ms "
+            f"({t32 * 1e6 / out_n:6.2f} ns/el)  i64 {t64:7.3f} ms"
+        )
+
+    # 7-field SoA gather at [H] x R ranks fused in one jit
+    H, R = 10_000, 32
+    first = jnp.asarray(np.sort(rng.integers(0, K - R, H)).astype(np.int32))
+
+    def multi(v64a, v64b, v32a, p0, p1, p2, p3, first):
+        outs = []
+        for r in range(R):
+            i = first + 1 + r
+            outs.append(
+                (v64a[i], v64b[i], v32a[i], p0[i], p1[i], p2[i], p3[i])
+            )
+        return outs
+
+    args = (vec64, vec64, vec32, vec32, vec32, vec32, vec32, first)
+    t = timed(multi, *args, n=10)
+    print(f"SoA rank-loop gather 7 fields x R={R} x H={H}: {t:7.3f} ms")
+
+    # row gather from [K, 9] for reference (with index as arg)
+    mat = jnp.asarray(rng.integers(0, 2**31, (K, 9), np.int64), jnp.int32)
+    idx = jnp.asarray(rng.integers(0, K, 640_000).astype(np.int32))
+    t = timed(lambda m, i: m[i], mat, idx)
+    print(f"row gather [640k, 9] from [K, 9]: {t:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

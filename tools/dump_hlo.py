@@ -9,19 +9,19 @@ import re
 
 import jax
 
-from bench import bench_config
+from bench import baseline_config, bench_config
 from shadow_tpu.config.options import ConfigOptions
 from shadow_tpu.sim import Simulation
 
 
 def main():
-    cfg = ConfigOptions.from_dict(bench_config(10_000, 100))
+    if len(sys.argv) > 1:
+        cfg_dict, _, _ = baseline_config(int(sys.argv[1]), False)
+        cfg = ConfigOptions.from_dict(cfg_dict)
+    else:
+        cfg = ConfigOptions.from_dict(bench_config(10_000, 100))
     sim = Simulation(cfg, world=1)
-    lowered = jax.jit(sim.engine._chunk_fn).lower(sim.state, sim.params) \
-        if hasattr(sim.engine, "_chunk_fn") else None
-    if lowered is None:
-        # engine.run_chunk is already a jit-wrapped callable
-        lowered = sim.engine.run_chunk.lower(sim.state, sim.params)
+    lowered = sim.engine.run_chunk.lower(sim.state, sim.params)
     compiled = lowered.compile()
     try:
         ca = compiled.cost_analysis()

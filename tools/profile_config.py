@@ -1,0 +1,55 @@
+"""Profile one bench.py config on the real TPU and dump an xplane trace.
+
+Usage: python tools/profile_config.py [config_n] [trace_dir] [--small]
+Then:  python tools/parse_xplane.py <trace_dir>
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+from bench import baseline_config
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    trace = sys.argv[2] if len(sys.argv) > 2 else "/tmp/cfg_trace"
+    small = "--small" in sys.argv
+    cfg_dict, metric, stop_s = baseline_config(n, small)
+    cfg = ConfigOptions.from_dict(cfg_dict)
+    sim = Simulation(cfg, world=1)
+    state, params, engine = sim.state, sim.params, sim.engine
+    t0 = time.monotonic()
+    state = engine.run_chunk(state, params)  # compile + first chunk
+    jax.block_until_ready(state)
+    print(f"compile+first chunk: {time.monotonic() - t0:.1f}s", flush=True)
+    # warm chunk timing (no profiler overhead)
+    rounds0 = int(state.stats.rounds)
+    sim0 = int(state.now)
+    t0 = time.monotonic()
+    state = engine.run_chunk(state, params)
+    jax.block_until_ready(state)
+    dt = time.monotonic() - t0
+    dr = int(state.stats.rounds) - rounds0
+    dsim = (int(state.now) - sim0) / 1e9
+    print(
+        f"warm chunk: {dt:.3f}s, {dr} rounds, {dt / max(dr, 1) * 1000:.2f} ms/round, "
+        f"{dsim / dt:.2f} sim-s/wall-s",
+        flush=True,
+    )
+    ms = int(jax.device_get(state.stats.microsteps).sum())
+    print(f"microsteps so far: {ms} (~{ms / max(int(state.stats.rounds), 1):.1f}/round)")
+    jax.profiler.start_trace(trace)
+    state = engine.run_chunk(state, params)
+    jax.block_until_ready(state)
+    jax.profiler.stop_trace()
+    print(f"trace written to {trace}")
+
+
+if __name__ == "__main__":
+    main()
