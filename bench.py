@@ -292,10 +292,16 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
             "network": {"graph": {"type": "gml",
                                   "inline": torus_gml(side, lat_ms=50)}},
             "experimental": {
-                "event_queue_capacity": 64,
-                # must exceed cwnd_cap (data) + cwnd_cap (acks the host owes
-                # as a server) + control, or budget drops act as loss
-                "sends_per_host_round": 40,
+                # Every slab pass and the merge sort scale with cap x H and
+                # B x H, so both are tuned to the measured drop cliff plus
+                # ~15% margin: cap 24 / B 20 drop (cap 26 is margin-free);
+                # 28/24 runs the FULL 120 sim-s with zero queue/budget
+                # drops and digests identical to the roomy 64/40 shapes,
+                # at 10.3 vs 18.1 ms/round. Retune against the drop
+                # counters if the workload changes (drops act as loss —
+                # protocol-visible).
+                "event_queue_capacity": 28,
+                "sends_per_host_round": 24,
                 "rounds_per_chunk": 256,
                 # merge_rows deliberately unset: measured on this workload
                 # (66k sends/round avg, >121k peaks) a 196k truncation was
