@@ -539,6 +539,12 @@ class HybridSimulation:
         kind = np.zeros((n,), np.int32)
         payload = np.zeros((n, 4), np.int32)
         valid = np.zeros((n,), bool)
+        # order keys are packed in NUMPY for the whole batch: the jax
+        # pack_order builds traced scalars and int() forces a sync PER
+        # PACKET — profiled at ~4 s of a 21 s tor-minimal run (the same
+        # per-event-jax pathology seed_queue hit at 1M hosts)
+        from shadow_tpu.ops.events import _LOCAL_SHIFT, _SRC_SHIFT, SEQ_MASK
+
         for i, (gid, t_ns, dst_gid, size, key, _sock) in enumerate(staged):
             src[i] = gid
             t[i] = t_ns
@@ -549,8 +555,12 @@ class HybridSimulation:
             # key doubles as the order tiebreak: under round-robin the list
             # order changed, so re-sequence (the payload keeps the original
             # key for the byte-store lookup)
-            order[i] = int(pack_order(1, gid, key if self._qdisc == "fifo"
-                                      else self._order_seq(gid)))
+            seq = key if self._qdisc == "fifo" else self._order_seq(gid)
+            order[i] = (
+                (np.int64(1) << _LOCAL_SHIFT)
+                | (np.int64(gid) << _SRC_SHIFT)
+                | (np.int64(seq) & SEQ_MASK)
+            )
             kind[i] = KIND_SENDREQ
             payload[i, PW_SIZE] = size
             payload[i, PW_DST_OR_SRC] = dst_gid
