@@ -301,6 +301,12 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
                 # counters if the workload changes (drops act as loss —
                 # protocol-visible).
                 "event_queue_capacity": 28,
+                # two-level bucketed queue: 4 blocks of 7 slots (B ~ sqrt(C)
+                # balances the [H, C/B] + [H, B] levels). Digests are
+                # bit-identical to the flat queue (tests/test_bucketq.py);
+                # the microstep pop/push pair stops paying full-capacity
+                # reductions — see tools/bench_bucketq.py for the sweep.
+                "event_queue_block": 7,
                 "sends_per_host_round": 24,
                 "rounds_per_chunk": 256,
                 # merge_rows deliberately unset: measured on this workload

@@ -126,8 +126,8 @@ class ExperimentalOptions:
 
     Kept from the reference: `scheduler`, `runahead`, `use_dynamic_runahead`,
     `interface_qdisc`. New (static-shape knobs the TPU engine needs):
-    `event_queue_capacity`, `sends_per_host_round`, `max_round_inserts`,
-    `rounds_per_chunk`, `microstep_limit`.
+    `event_queue_capacity`, `event_queue_block`, `sends_per_host_round`,
+    `max_round_inserts`, `rounds_per_chunk`, `microstep_limit`.
     """
 
     scheduler: str = "tpu"  # "tpu" | "cpu-reference" (pure-numpy oracle)
@@ -155,9 +155,14 @@ class ExperimentalOptions:
     a2a_block: int = 0  # entries per (src, dst-shard) block; 0 = auto
     # static cap on post-sort merge gather rows (0 = unbounded): bounds the
     # exchange-merge's per-round gather work at the real traffic level
-    # instead of the worst-case outbox (hosts x send budget). Exact while
-    # per-round packets + hosts + 1 <= merge_rows; overflow sheds loudly
-    # into queue_overflow_dropped. See EngineConfig.merge_rows.
+    # instead of the worst-case outbox (hosts x send budget). The exactness
+    # bound is PER SHARD — the merge runs shard-locally, so with world > 1
+    # it is: locally-destined rows + local host count (num_hosts / world)
+    # + 1 <= merge_rows, NOT the global packet/host counts (sizing from
+    # global counts over-provisions the permute on every shard; sizing from
+    # a naive global/world split can under-provision a shard that receives
+    # a traffic burst). Overflow sheds loudly into queue_overflow_dropped.
+    # See EngineConfig.merge_rows and docs/usage.md.
     merge_rows: int = 0
     # packet delivery breadcrumbs on the CPU host planes (reference
     # packet.rs:16-39), debug-only: drops land in host-stats.json with
@@ -169,6 +174,14 @@ class ExperimentalOptions:
     cpu_delay: int = 0  # stored ns; bare numbers in YAML/CLI parse as ms
     # --- TPU engine static shapes (0 = auto-size from host count) ---
     event_queue_capacity: int = 0  # per-host pending-event slots
+    # two-level bucketed event queue: slots per block (must divide the
+    # queue capacity). The per-host slab carries incrementally-maintained
+    # per-block min caches so the microstep's pop/push reductions scale
+    # O(C/B + B) instead of O(C); results (events, digests, drop counters)
+    # are bit-identical to the flat queue. 0 = flat (the B=C degenerate
+    # case). Sweep tools/bench_bucketq.py to pick B; B ~ sqrt(C) balances
+    # the two levels. See docs/architecture.md "Two-level event queue".
+    event_queue_block: int = 0
     sends_per_host_round: int = 0  # per-host round send budget (drop above)
     max_round_inserts: int = 0  # max packets merged into one host per round; 0 = auto
     rounds_per_chunk: int = 0  # rounds per jit'd chunk between host syncs
@@ -276,6 +289,7 @@ class ExperimentalOptions:
                 setattr(e, f, bool(d.pop(f)))
         for f in (
             "event_queue_capacity",
+            "event_queue_block",
             "sends_per_host_round",
             "max_round_inserts",
             "rounds_per_chunk",
@@ -285,6 +299,11 @@ class ExperimentalOptions:
         ):
             if f in d:
                 setattr(e, f, int(d.pop(f)))
+        if e.event_queue_block < 0:
+            raise ConfigError(
+                f"experimental.event_queue_block must be >= 0 (0 = flat), "
+                f"got {e.event_queue_block}"
+            )
         if d:
             raise ConfigError(f"unknown experimental options: {sorted(d)}")
         return e

@@ -64,6 +64,7 @@ def _restore_leaves(data, state, engine):
             raise CheckpointError(f"leaf {i}: shape/dtype mismatch")
         new_leaves.append(jnp.asarray(arr))
     out = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    out = _refresh_queue_caches(out)
     if engine.mesh is not None:
         specs = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(engine.mesh, s),
@@ -71,6 +72,20 @@ def _restore_leaves(data, state, engine):
         )
         out = jax.device_put(out, specs)
     return out
+
+
+def _refresh_queue_caches(state):
+    """Checkpoint restore is a block-cache REBUILD point (like the exchange
+    merge): a bucketed queue's (bt, bo, bfill) minima are derived state, so
+    they are recomputed from the restored slab rather than trusted from the
+    file — a hand-edited or bit-rotted .npz can desynchronize the caches but
+    never the simulation."""
+    from shadow_tpu.ops.events import BucketQueue, bucket_rebuild
+
+    q = getattr(state, "queue", None)
+    if isinstance(q, BucketQueue):
+        state = state._replace(queue=bucket_rebuild(q, q.block))
+    return state
 
 
 def _fingerprint(engine_cfg, treedef, params) -> str:

@@ -65,14 +65,18 @@ from shadow_tpu.net import (
     tb_init,
 )
 from shadow_tpu.ops import (
+    BucketQueue,
     EventQueue,
     ORDER_MAX,
+    block_minima,
+    bucket_rebuild,
+    as_flat,
     check_order_limits,
     merge_flat_events,
-    next_time,
     pack_order,
-    pop_min,
-    push_many,
+    q_next_time,
+    q_pop_min,
+    q_push_many,
 )
 from shadow_tpu.ops.events import unpack_order_src
 from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
@@ -80,6 +84,21 @@ from shadow_tpu.ops.rng import RngState, rng_init, rng_uniform
 from shadow_tpu.simtime import TIME_MAX
 
 AXIS = "hosts"  # mesh axis name for the host dimension
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map with a fallback for older jax (< 0.5: the API lives in
+    jax.experimental.shard_map and the replication check is `check_rep`)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
 
 _FNV_PRIME = jnp.uint64(1099511628211)
 _MIX1 = jnp.uint64(0x9E3779B97F4A7C15)
@@ -119,6 +138,7 @@ class Stats(NamedTuple):
     ob_dropped: Array  # i64[1] outbox-overflow losses (invariant check: always 0)
     a2a_shed: Array  # i64[1] all-to-all block-overflow losses (size blocks so 0)
     microsteps: Array  # i64[1] total microsteps (per shard)
+    bq_rebuilds: Array  # i64[1] wholesale block-cache rebuilds (bucketed queue)
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
 
@@ -203,6 +223,14 @@ class EngineConfig:
     # executing. 0 = off (statically elided).
     cpu_delay_ns: int = 0
     queue_capacity: int = 64
+    # Two-level bucketed event queue (ops/events.py BucketQueue): split the
+    # capacity axis into queue_capacity/queue_block blocks and carry
+    # incrementally-maintained per-block (min-time, min-order, fill) caches
+    # so the microstep's pop/push reductions run over [H, C/B] + [H, B]
+    # instead of the whole [H, C] slab. Bit-identical digests, events, and
+    # drop counters to the flat queue by construction (tests/test_bucketq.py
+    # is the gate). 0 = flat queue (the B=C degenerate case).
+    queue_block: int = 0
     # Per-HOST send budget per round. Budget-drop decisions depend only on a
     # host's own send count, and the shard outbox is sized hosts_per_shard *
     # budget so aggregate overflow is impossible — this is what keeps drop
@@ -253,6 +281,13 @@ class EngineConfig:
         if self.a2a_block < 0:
             raise ValueError(
                 f"a2a_block must be >= 0 (0 = auto), got {self.a2a_block}"
+            )
+        if self.queue_block < 0 or (
+            self.queue_block and self.queue_capacity % self.queue_block
+        ):
+            raise ValueError(
+                f"queue_block={self.queue_block} must be 0 (flat) or divide "
+                f"queue_capacity={self.queue_capacity} evenly"
             )
 
     @property
@@ -308,6 +343,7 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         ob_dropped=jnp.zeros((cfg.world,), jnp.int64),
         a2a_shed=jnp.zeros((cfg.world,), jnp.int64),
         microsteps=jnp.zeros((cfg.world,), jnp.int64),
+        bq_rebuilds=jnp.zeros((cfg.world,), jnp.int64),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
         rounds=jnp.zeros((), jnp.int64),
     )
@@ -473,12 +509,8 @@ class Engine:
         if self.mesh is not None:
             state_spec = self.state_specs()
             param_spec = self.param_specs()
-            chunk = jax.shard_map(
-                chunk,
-                mesh=self.mesh,
-                in_specs=(state_spec, param_spec),
-                out_specs=state_spec,
-                check_vma=False,
+            chunk = _shard_map(
+                chunk, self.mesh, (state_spec, param_spec), state_spec
             )
         self.run_chunk = jax.jit(chunk, donate_argnums=0)
 
@@ -491,12 +523,9 @@ class Engine:
             state_spec = self.state_specs()
             sh = P(AXIS)
             ob_spec = Outbox(dst=sh, t=sh, order=sh, kind=sh, payload=sh, count=sh)
-            step = jax.shard_map(
-                step,
-                mesh=self.mesh,
-                in_specs=(state_spec, self.param_specs()),
-                out_specs=(state_spec, ob_spec),
-                check_vma=False,
+            step = _shard_map(
+                step, self.mesh, (state_spec, self.param_specs()),
+                (state_spec, ob_spec),
             )
         return jax.jit(step)
 
@@ -521,10 +550,17 @@ class Engine:
 
     def state_specs(self):
         sh, rep = P(AXIS), P()
+        if self.cfg.queue_block:
+            qspec = BucketQueue(
+                t=sh, order=sh, kind=sh, payload=sh, dropped=sh,
+                bt=sh, bo=sh, bfill=sh,
+            )
+        else:
+            qspec = EventQueue(t=sh, order=sh, kind=sh, payload=sh, dropped=sh)
         return SimState(
             now=rep,
             done=rep,
-            queue=EventQueue(t=sh, order=sh, kind=sh, payload=sh, dropped=sh),
+            queue=qspec,
             rng=RngState(s=sh),
             seq=sh,
             sent_round=sh,
@@ -547,6 +583,7 @@ class Engine:
                 ob_dropped=sh,
                 a2a_shed=sh,
                 microsteps=sh,
+                bq_rebuilds=sh,
                 digest=sh,
                 rounds=rep,
             ),
@@ -615,6 +652,8 @@ class Engine:
         self._build_run_chunk()
         with host_build_context():
             queue, seq = seed_queue(cfg, initial_events)
+            if cfg.queue_block:
+                queue = bucket_rebuild(queue, cfg.queue_block)
             state = SimState(
                 now=jnp.zeros((), jnp.int64),
                 done=jnp.zeros((), bool),
@@ -798,7 +837,7 @@ def _effective_next(cfg: EngineConfig, st: SimState):
     model's busy horizon (a busy host keeps its events queued — order
     intact — and resumes at busy_until, exactly the reference's CPU-delay
     rescheduling, host.rs:820-847)."""
-    nt = next_time(st.queue)
+    nt = q_next_time(st.queue)
     if cfg.cpu_delay_ns > 0:
         nt = jnp.where(nt == TIME_MAX, nt, jnp.maximum(nt, st.cpu_busy_until))
     return nt
@@ -817,7 +856,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         limit_h = jnp.where(
             st.cpu_busy_until < window_end, window_end, jnp.int64(0)
         )
-        queue, ev, active = pop_min(st.queue, limit_h)
+        queue, ev, active = q_pop_min(st.queue, limit_h)
         exec_t = jnp.maximum(ev.t, st.cpu_busy_until)
         ev = ev._replace(t=jnp.where(active, exec_t, ev.t))
         st = st._replace(
@@ -826,7 +865,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             )
         )
     else:
-        queue, ev, active = pop_min(st.queue, window_end)
+        queue, ev, active = q_pop_min(st.queue, window_end)
 
     stats = st.stats
     stats = stats._replace(
@@ -925,7 +964,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             jnp.asarray(p.kind, jnp.int32) & KIND_MASK, p.payload,
         ))
     if push_list:
-        queue = push_many(queue, push_list)
+        queue = q_push_many(queue, push_list)
 
     # ---- sends: egress pipeline (worker.rs:330-425 send_packet). Each
     # port may carry a BURST (PacketSend.count/count_max): up to count_max
@@ -1102,10 +1141,16 @@ def _exchange(cfg, axis, st: SimState):
     )
     has_sends = jnp.sum(g.count) > 0
     queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
+    stats = st.stats
+    if isinstance(st.queue, BucketQueue):
+        stats = stats._replace(
+            bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]
+        )
     return st._replace(
         queue=queue,
         outbox=_fresh_outbox(ob),
         sent_round=jnp.zeros_like(st.sent_round),
+        stats=stats,
     )
 
 
@@ -1120,9 +1165,17 @@ def _fresh_outbox(ob: Outbox) -> Outbox:
     )
 
 
-def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
+def _merge_into_queue(cfg, queue0, flat, has_sends):
     """Insert flat (local, t, order, kind, payload, valid) rows, skipping
     the merge in empty rounds.
+
+    A `BucketQueue` merges through its flat slab view and its block caches
+    are rebuilt wholesale afterwards — the exchange merge is the one hot-path
+    point where incremental maintenance is not worth it (a merge can touch
+    every block). The rebuild sits under the same `has_sends` cond as the
+    merge plan: its outputs are the small [H, C/B] cache planes, so the
+    branch-boundary copies that rule out whole-slab conds do not apply, and
+    empty rounds keep their caches for free.
 
     The merge's sort dominates round cost; rounds where NO shard sent
     anything (timer-heavy workloads, drained phases) skip it entirely —
@@ -1133,6 +1186,7 @@ def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
     PHOLD-torus round cost — while the plan is one [H, C] index map plus
     [K]-vector sorted fields, cheap to copy at every capacity. The apply
     runs unconditionally as a single where-pass."""
+    q_flat = as_flat(queue0)
     if jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
         # Fused merge inside the cond. On CPU the scatter path is faster
         # and branch copies are cheap. On TPU this wins at SMALL slab
@@ -1140,7 +1194,7 @@ def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
         # plan split — the [H, C, W] plan materialization costs more than
         # the small branch-boundary copies it avoids; at cap >= ~48 the
         # copy volume dominates and the split below wins).
-        return lax.cond(
+        merged = lax.cond(
             has_sends,
             lambda queue: merge_flat_events(
                 queue, *flat, cfg.max_round_inserts,
@@ -1148,25 +1202,41 @@ def _merge_into_queue(cfg, queue0: EventQueue, flat, has_sends) -> EventQueue:
                 merge_rows=cfg.merge_rows,
             ),
             lambda queue: queue,
-            queue0,
+            q_flat,
         )
-    from shadow_tpu.ops.merge import merge_apply, merge_empty_plan, merge_plan
+    else:
+        from shadow_tpu.ops.merge import (
+            merge_apply, merge_empty_plan, merge_plan,
+        )
 
-    p_words = flat[4].shape[-1]
-    # the cond consumes ONLY the time plane (free-slot source): feeding
-    # the whole queue through would add a second consumer per slab and
-    # reintroduce the branch-boundary copies this split removes
-    take, gw, dropped_add = lax.cond(
+        p_words = flat[4].shape[-1]
+        # the cond consumes ONLY the time plane (free-slot source): feeding
+        # the whole queue through would add a second consumer per slab and
+        # reintroduce the branch-boundary copies this split removes
+        take, gw, dropped_add = lax.cond(
+            has_sends,
+            lambda q_t: merge_plan(
+                q_t, *flat, cfg.max_round_inserts,
+                shed_urgency=not cfg.cheap_shed,
+                merge_rows=cfg.merge_rows,
+            ),
+            lambda q_t: merge_empty_plan(q_t, p_words),
+            q_flat.t,
+        )
+        merged = merge_apply(q_flat, take, gw, dropped_add)
+    if not isinstance(queue0, BucketQueue):
+        return merged
+    nb = queue0.bt.shape[1]
+    bt, bo, bfill = lax.cond(
         has_sends,
-        lambda q_t: merge_plan(
-            q_t, *flat, cfg.max_round_inserts,
-            shed_urgency=not cfg.cheap_shed,
-            merge_rows=cfg.merge_rows,
-        ),
-        lambda q_t: merge_empty_plan(q_t, p_words),
-        queue0.t,
+        lambda to: block_minima(to[0], to[1], nb),
+        lambda _to: (queue0.bt, queue0.bo, queue0.bfill),
+        (merged.t, merged.order),
     )
-    return merge_apply(queue0, take, gw, dropped_add)
+    return BucketQueue(
+        merged.t, merged.order, merged.kind, merged.payload, merged.dropped,
+        bt, bo, bfill,
+    )
 
 
 def _exchange_alltoall(cfg, axis, st: SimState):
@@ -1258,6 +1328,10 @@ def _exchange_alltoall(cfg, axis, st: SimState):
     has_sends = lax.psum(jnp.sum(ob.count), axis) > 0
     queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
     stats = st.stats._replace(a2a_shed=st.stats.a2a_shed + shed[None])
+    if isinstance(st.queue, BucketQueue):
+        stats = stats._replace(
+            bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]
+        )
     return st._replace(
         queue=queue,
         outbox=_fresh_outbox(ob),

@@ -19,6 +19,13 @@ so model arithmetic is common-mode; what differs is everything the engine
 does around it: queue order, window computation, shaping, budget, exchange.
 
 Deliberately slow (pure Python loops): use small host counts / short sims.
+
+Queue-layout independence: golden keeps per-host `heapq` heaps and never
+models the device slab, so `cfg.queue_block` (flat vs two-level bucketed
+EventQueue, ops/events.py) is invisible here BY DESIGN — the same golden
+digests and counters gate both layouts, which is what makes this module the
+oracle for the bucket-equivalence determinism tests (tests/test_bucketq.py):
+flat engine == bucketed engine == golden, or one of the three is wrong.
 """
 
 from __future__ import annotations

@@ -61,7 +61,7 @@ from shadow_tpu.models.hybrid import (
 )
 from shadow_tpu.net.dns import Dns
 from shadow_tpu.obs import PcapWriter, PerfTimers, SimLogger, StraceLogger
-from shadow_tpu.ops import merge_flat_events, next_time, pack_order
+from shadow_tpu.ops import merge_flat_events, pack_order, q_next_time
 from shadow_tpu.programs import get_program
 from shadow_tpu.simtime import NS_PER_SEC, TIME_MAX
 from shadow_tpu import sim as simmod
@@ -148,6 +148,10 @@ class HybridSimulation:
             use_dynamic_runahead=False,
             use_codel=ex.use_codel,
             queue_capacity=qcap,
+            # the bucketed queue rides along on hybrid sims too (merge and
+            # pop/push dispatch on queue type); a block that does not divide
+            # the roomier hybrid capacity fails loudly in EngineConfig
+            queue_block=ex.event_queue_block,
             sends_per_host_round=max(auto_budget, 32),
             max_round_inserts=ex.max_round_inserts or qcap,
             # bounds the guarded round loop — the ONLY device execution path,
@@ -364,19 +368,14 @@ class HybridSimulation:
             state_spec = self.engine.state_specs()
             param_spec = self.engine.param_specs()
             rep = P()
-            prepare = jax.shard_map(
-                prepare,
-                mesh=self.mesh,
-                in_specs=(state_spec, rep, rep, rep, rep, rep, rep),
-                out_specs=state_spec,
-                check_vma=False,
+            from shadow_tpu.core.engine import _shard_map
+
+            prepare = _shard_map(
+                prepare, self.mesh,
+                (state_spec, rep, rep, rep, rep, rep, rep), state_spec,
             )
-            guarded = jax.shard_map(
-                guarded,
-                mesh=self.mesh,
-                in_specs=(state_spec, param_spec, rep),
-                out_specs=state_spec,
-                check_vma=False,
+            guarded = _shard_map(
+                guarded, self.mesh, (state_spec, param_spec, rep), state_spec
             )
         self._prepare = jax.jit(prepare, donate_argnums=0)
         self._guarded = jax.jit(guarded, donate_argnums=0)
@@ -448,7 +447,7 @@ class HybridSimulation:
         hb_ns = cfg.general.heartbeat_interval
         next_hb = hb_ns or 0
         while True:
-            dev_min = int(jnp.min(next_time(self.state.queue)))
+            dev_min = int(jnp.min(q_next_time(self.state.queue)))
             t_next = min(self._cpu_min_next(), dev_min)
             if t_next >= stop:
                 break

@@ -1236,6 +1236,15 @@ class NativeProcess:
                     self.ipc.fast_clear_entry(idx)
                 self._fast_map = {}
             self.ipc.fast_set_enabled(False)
+        elif self.state == "running":
+            # detach: re-run the fast-init enable so per-fd entries and the
+            # global flag transition TOGETHER. Without this, a later
+            # _fast_sync (strace now None) could re-arm entries while the
+            # flag stayed off — a latent armed-entries/disabled-flag split
+            # that only the shim's flag gate kept harmless. Pre-start
+            # detaches need nothing: start's _fast_init covers them.
+            self._fast_sync()
+            self.ipc.fast_set_enabled(True)
 
     # ---- descriptor fast path ---------------------------------------------
     # write(2) on captured stdio answered inside the shim from a shared

@@ -7,8 +7,16 @@ fixed-shape vectorized array programs so XLA can fuse and tile them.
 """
 
 from shadow_tpu.ops.events import (
+    BucketQueue,
     EventQueue,
     EVENT_PAYLOAD_WORDS,
+    as_flat,
+    block_minima,
+    bucket_rebuild,
+    bq_next_time,
+    bq_pop_min,
+    bq_push_many,
+    make_bucket_queue,
     make_queue,
     next_time,
     queue_len,
@@ -17,14 +25,25 @@ from shadow_tpu.ops.events import (
     push_one,
     pack_order,
     check_order_limits,
+    q_next_time,
+    q_pop_min,
+    q_push_many,
     ORDER_MAX,
 )
 from shadow_tpu.ops.merge import merge_flat_events
 from shadow_tpu.ops.rng import RngState, rng_init, rng_next_u64, rng_uniform
 
 __all__ = [
+    "BucketQueue",
     "EventQueue",
     "EVENT_PAYLOAD_WORDS",
+    "as_flat",
+    "block_minima",
+    "bucket_rebuild",
+    "bq_next_time",
+    "bq_pop_min",
+    "bq_push_many",
+    "make_bucket_queue",
     "make_queue",
     "next_time",
     "queue_len",
@@ -33,6 +52,9 @@ __all__ = [
     "push_one",
     "pack_order",
     "check_order_limits",
+    "q_next_time",
+    "q_pop_min",
+    "q_push_many",
     "ORDER_MAX",
     "merge_flat_events",
     "RngState",

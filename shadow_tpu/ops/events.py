@@ -237,3 +237,309 @@ def push_one(q: EventQueue, mask, t, order, kind, payload) -> EventQueue:
         ),
         dropped=q.dropped + jnp.where(mask & ~has_free, 1, 0).astype(jnp.int64),
     )
+
+
+# --------------------------------------------------------------------------
+# two-level bucketed queue (per-block incremental min-caches)
+# --------------------------------------------------------------------------
+
+
+class BucketQueue(NamedTuple):
+    """Two-level SoA event slab: the flat [H, C] planes of `EventQueue` plus
+    per-block cached minima over C/B blocks of B contiguous slots.
+
+    Invariant (the *block-min invariant*, enforced by tests/test_bucketq.py):
+    for every host h and block j,
+
+      (bt[h, j], bo[h, j]) == lexicographic min of (t, order) over the
+                              block's live slots  (TIME_MAX/ORDER_MAX if empty)
+      bfill[h, j]          == number of live slots in the block
+
+    Caches are maintained INCREMENTALLY on the microstep hot path — a pop
+    recomputes only the victim block's minimum, a push is a 2-way min update
+    of its block cache — and rebuilt wholesale only at the cross-shard
+    exchange merge and on checkpoint restore (`bucket_rebuild`). pop/push
+    semantics are bit-identical to the flat `EventQueue` ops: the same event
+    pops, pushes land in the same slots, drops count the same — the flat
+    queue IS the B=C degenerate case. What changes is the per-microstep
+    footprint: the min reductions run over [H, C/B] block minima plus one
+    [H, B] victim block instead of the whole [H, C] slab (O(C/B + B) versus
+    O(C) per event)."""
+
+    t: Array  # i64[H, C] event time; TIME_MAX = empty
+    order: Array  # i64[H, C] secondary sort key; ORDER_MAX = empty
+    kind: Array  # i32[H, C]
+    payload: Array  # i32[H, C, P]
+    dropped: Array  # i64[H]
+    bt: Array  # i64[H, C/B] cached block-min time
+    bo: Array  # i64[H, C/B] order key at that minimum
+    bfill: Array  # i32[H, C/B] live slots per block
+
+    @property
+    def block(self) -> int:
+        """Slots per block (B)."""
+        return self.t.shape[1] // self.bt.shape[1]
+
+
+def as_flat(q) -> EventQueue:
+    """The flat-slab view of either queue type (shared planes, no copy)."""
+    if isinstance(q, BucketQueue):
+        return EventQueue(q.t, q.order, q.kind, q.payload, q.dropped)
+    return q
+
+
+def block_minima(t, order, num_blocks: int):
+    """(bt, bo, bfill) recomputed wholesale from the slab — the rebuild
+    primitive used at the exchange merge and on checkpoint restore."""
+    h, c = t.shape
+    b = c // num_blocks
+    t3 = t.reshape(h, num_blocks, b)
+    o3 = order.reshape(h, num_blocks, b)
+    bt = jnp.min(t3, axis=2)
+    bo = jnp.min(jnp.where(t3 == bt[:, :, None], o3, ORDER_MAX), axis=2)
+    bfill = jnp.sum((t3 != TIME_MAX).astype(jnp.int32), axis=2)
+    return bt, bo, bfill
+
+
+def bucket_rebuild(q, block: int) -> BucketQueue:
+    """Wrap a flat queue (or refresh a bucketed one) with freshly computed
+    block caches."""
+    q = as_flat(q)
+    h, c = q.t.shape
+    if block <= 0 or c % block:
+        raise ValueError(
+            f"block={block} must be positive and divide capacity {c}"
+        )
+    bt, bo, bfill = block_minima(q.t, q.order, c // block)
+    return BucketQueue(q.t, q.order, q.kind, q.payload, q.dropped, bt, bo, bfill)
+
+
+def make_bucket_queue(num_hosts: int, capacity: int, block: int) -> BucketQueue:
+    return bucket_rebuild(make_queue(num_hosts, capacity), block)
+
+
+def bq_next_time(q: BucketQueue) -> Array:
+    """Per-host earliest pending event time from the [H, C/B] caches alone —
+    no slab read (the flat `next_time` is a full [H, C] reduction)."""
+    return jnp.min(q.bt, axis=1)
+
+
+def bq_pop_min(
+    q: BucketQueue, limit, force_path: str | None = None
+) -> tuple[BucketQueue, Event, Array]:
+    """`pop_min` over the two-level queue: identical event, slot clear, and
+    `active` as the flat op, computed from [H, C/B] + [H, B] reductions.
+
+    The winning block is the lexicographic min over the cached
+    (bt, bo) pairs; the winning slot is found inside that one block. The
+    victim block's cache is then recomputed from its B slots — the only
+    incremental maintenance a pop needs. Block selection by (bt, bo) is
+    exact because order keys are globally unique: at most one block can
+    match (tmin, omin) while a host is active, and inactive hosts never
+    write (multiple empty blocks share the sentinel pair, but active
+    implies the winner holds a real event).
+
+    `force_path` ('gather' | 'onehot') pins the backend formulation — the
+    tests' lever for exercising the TPU one-hot path on CPU; both compute
+    the identical event and slab."""
+    limit = jnp.asarray(limit, jnp.int64)
+    h, c = q.t.shape
+    nb = q.bt.shape[1]
+    b = c // nb
+    tmin = jnp.min(q.bt, axis=1)  # [H]
+    active = tmin < limit
+    cand = jnp.where(q.bt == tmin[:, None], q.bo, ORDER_MAX)
+    omin = jnp.min(cand, axis=1)  # [H]
+    t3 = q.t.reshape(h, nb, b)
+    o3 = q.order.reshape(h, nb, b)
+    k3 = q.kind.reshape(h, nb, b)
+    p3 = q.payload.reshape(h, nb, b, q.payload.shape[-1])
+
+    path = force_path or (
+        "gather" if jax.default_backend() == "cpu" else "onehot"
+    )
+    if path == "gather":
+        # gather formulation for READS (same backend split as the flat
+        # pop_min: CPU row gathers are cheap, and they touch only [H, B]
+        # victim blocks); writes stay one-hot `where` passes — measured on
+        # XLA-CPU a [H, C] scatter costs ~3x the compare+select pair
+        bidx = jnp.argmin(cand, axis=1)  # [H] winning block
+        hh = jnp.arange(h)
+        blk_t = t3[hh, bidx]  # [H, B]
+        blk_o = o3[hh, bidx]
+        soh = (
+            active[:, None]
+            & (blk_t == tmin[:, None])
+            & (blk_o == omin[:, None])
+        )  # <=1 true per row
+        sidx = jnp.argmax(soh, axis=1)  # [H] winning slot within block
+        ev = Event(
+            t=jnp.where(active, blk_t[hh, sidx], TIME_MAX),
+            order=jnp.where(active, blk_o[hh, sidx], ORDER_MAX),
+            kind=jnp.where(active, k3[hh, bidx, sidx], 0),
+            payload=jnp.where(active[:, None], p3[hh, bidx, sidx], 0),
+        )
+        col = bidx * b + sidx
+        clear = active[:, None] & (jnp.arange(c)[None, :] == col[:, None])
+        boh = active[:, None] & (jnp.arange(nb)[None, :] == bidx[:, None])
+    else:
+        # one-hot formulation: per-row dynamic gathers lower to slow custom
+        # kernels on TPU; exact masked SUMS extract the victim block instead
+        # (one hit per row — see the flat pop_min's one-hot rationale)
+        boh = active[:, None] & (q.bt == tmin[:, None]) & (q.bo == omin[:, None])
+
+        def ext(v3):
+            return jnp.sum(jnp.where(boh[:, :, None], v3, 0), axis=1, dtype=v3.dtype)
+
+        blk_t = ext(t3)  # [H, B] victim block (zeros when inactive)
+        blk_o = ext(o3)
+        blk_k = ext(k3)
+        blk_p = jnp.sum(
+            jnp.where(boh[:, :, None, None], p3, 0), axis=1, dtype=p3.dtype
+        )
+        soh = active[:, None] & (blk_t == tmin[:, None]) & (blk_o == omin[:, None])
+
+        def sel(vb, default):
+            got = jnp.sum(jnp.where(soh, vb, 0), axis=1, dtype=vb.dtype)
+            return jnp.where(active, got, default)
+
+        ev = Event(
+            t=sel(blk_t, TIME_MAX),
+            order=sel(blk_o, ORDER_MAX),
+            kind=sel(blk_k, 0),
+            payload=jnp.where(
+                active[:, None],
+                jnp.sum(
+                    jnp.where(soh[:, :, None], blk_p, 0), axis=1,
+                    dtype=blk_p.dtype,
+                ),
+                0,
+            ),
+        )
+        clear = (boh[:, :, None] & soh[:, None, :]).reshape(h, c)
+    # slot clear + victim-block cache recompute, shared by both paths: each
+    # produced the victim block (blk_t, blk_o) [H, B] and active-gated slot
+    # (soh) / block (boh) one-hots — keeping this in ONE place is what keeps
+    # the two backend formulations from diverging
+    new_t = jnp.where(clear, TIME_MAX, q.t)
+    new_order = jnp.where(clear, ORDER_MAX, q.order)
+    bt2 = jnp.where(soh, TIME_MAX, blk_t)
+    bo2 = jnp.where(soh, ORDER_MAX, blk_o)
+    nbt = jnp.min(bt2, axis=1)
+    nbo = jnp.min(jnp.where(bt2 == nbt[:, None], bo2, ORDER_MAX), axis=1)
+    return (
+        q._replace(
+            t=new_t,
+            order=new_order,
+            bt=jnp.where(boh, nbt[:, None], q.bt),
+            bo=jnp.where(boh, nbo[:, None], q.bo),
+            bfill=q.bfill - boh.astype(jnp.int32),
+        ),
+        ev,
+        active,
+    )
+
+
+def bq_push_many(
+    q: BucketQueue, pushes, force_path: str | None = None
+) -> BucketQueue:
+    """`push_many` over the two-level queue: identical slot assignment and
+    drop accounting as the flat op.
+
+    `push_many` is defined as sequential `push_one` semantics (each push
+    lands in the first free slot of the state its predecessors left), so
+    both formulations here chase the FIRST not-full block from the RUNNING
+    `bfill` cache — no [H, C] free-count reduction ever runs:
+
+      - CPU: gather the target block's B slots from the updated slab and
+        take its first free slot (per-row gathers are cheap on CPU);
+      - TPU: free masks are computed once up front and the k-th push lands
+        at pre-ranked free-slot k (the same bijection the flat op uses) —
+        one [H, C/B + B]-shaped one-hot per push, no gathers.
+
+    Block-major × slot order == plain slot order, so the written slab is
+    bit-identical to the flat `push_many`. Each push 2-way-min-updates its
+    block's (bt, bo) cache and bumps `bfill` — pops stay cheap without ever
+    rebuilding. `force_path` ('gather' | 'onehot') pins the formulation for
+    tests; both write the identical slab."""
+    h, c = q.t.shape
+    nb = q.bt.shape[1]
+    b = c // nb
+    path = force_path or (
+        "gather" if jax.default_backend() == "cpu" else "onehot"
+    )
+    cpu = path == "gather"
+    hh = jnp.arange(h)
+    cols = jnp.arange(c, dtype=jnp.int32)[None, :]
+    blks = jnp.arange(nb, dtype=jnp.int32)[None, :]
+    if not cpu:
+        # pre-ranked free structure (computed once, like the flat op):
+        # push k of this call lands at global free rank k, found as
+        # (block where the rank falls by cached occupancy, local rank)
+        free3 = q.t.reshape(h, nb, b) == TIME_MAX
+        lrank = jnp.cumsum(free3.astype(jnp.int32), axis=2) - 1  # [H, NB, B]
+        bfree0 = b - q.bfill
+        excl = jnp.cumsum(bfree0, axis=1) - bfree0  # exclusive block prefix
+        need = jnp.zeros((h,), jnp.int32)
+    new_t, new_order, new_kind, new_payload = q.t, q.order, q.kind, q.payload
+    bt, bo, bfill = q.bt, q.bo, q.bfill
+    dropped = q.dropped
+    for mask, t, order, kind, payload in pushes:
+        not_full = bfill < b  # [H, NB] running occupancy
+        ok = mask & jnp.any(not_full, axis=1)
+        if cpu:
+            tb = jnp.argmax(not_full, axis=1)  # first not-full block
+            blk = new_t.reshape(h, nb, b)[hh, tb]  # [H, B] current slots
+            sidx = jnp.argmax(blk == TIME_MAX, axis=1)  # its first free slot
+            col = (tb * b + sidx).astype(jnp.int32)
+            oh = ok[:, None] & (cols == col[:, None])
+            boh = ok[:, None] & (blks == tb[:, None].astype(jnp.int32))
+        else:
+            nd = need[:, None]
+            # interval test against the ORIGINAL free structure (excl/bfree0
+            # pair): ranks are assigned on the entry state, like the flat op
+            boh = ok[:, None] & (excl <= nd) & (nd < excl + bfree0)  # <=1/row
+            r = nd - excl  # local free rank within the target block
+            oh = (boh[:, :, None] & free3 & (lrank == r[:, :, None])).reshape(
+                h, c
+            )
+            need = need + ok.astype(jnp.int32)
+        t_arr = jnp.asarray(t, jnp.int64)
+        o_arr = jnp.asarray(order, jnp.int64)
+        new_t = jnp.where(oh, t_arr[:, None], new_t)
+        new_order = jnp.where(oh, o_arr[:, None], new_order)
+        new_kind = jnp.where(
+            oh, jnp.asarray(kind, jnp.int32)[:, None], new_kind
+        )
+        new_payload = jnp.where(
+            oh[:, :, None], jnp.asarray(payload, jnp.int32)[:, None, :],
+            new_payload,
+        )
+        # incremental cache maintenance: lexicographic 2-way min against the
+        # RUNNING cache (two pushes into one block chain correctly)
+        better = boh & (
+            (t_arr[:, None] < bt)
+            | ((t_arr[:, None] == bt) & (o_arr[:, None] < bo))
+        )
+        bt = jnp.where(better, t_arr[:, None], bt)
+        bo = jnp.where(better, o_arr[:, None], bo)
+        bfill = bfill + boh.astype(jnp.int32)
+        dropped = dropped + jnp.where(mask & ~ok, 1, 0).astype(jnp.int64)
+    return BucketQueue(
+        new_t, new_order, new_kind, new_payload, dropped, bt, bo, bfill
+    )
+
+
+# ---- queue-kind dispatchers (trace-time: the queue type is static) --------
+
+
+def q_next_time(q) -> Array:
+    return bq_next_time(q) if isinstance(q, BucketQueue) else next_time(q)
+
+
+def q_pop_min(q, limit):
+    return bq_pop_min(q, limit) if isinstance(q, BucketQueue) else pop_min(q, limit)
+
+
+def q_push_many(q, pushes):
+    return bq_push_many(q, pushes) if isinstance(q, BucketQueue) else push_many(q, pushes)

@@ -51,7 +51,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from shadow_tpu.ops.events import EventQueue
+from shadow_tpu.ops.events import (
+    BucketQueue,
+    EventQueue,
+    as_flat,
+    bucket_rebuild,
+)
 from shadow_tpu.simtime import TIME_MAX
 
 
@@ -192,7 +197,21 @@ def merge_flat_events(
     single-key sort grouped by dst with buffer-order ranks; identical
     simulation results whenever nothing overflows (pop_min re-derives the
     total order from slot contents), at a fraction of the sort cost — the
-    engine's `cheap_shed` knob for workloads sized to never overflow."""
+    engine's `cheap_shed` knob for workloads sized to never overflow.
+
+    Accepts either queue type: a `BucketQueue` merges through its flat slab
+    view and comes back with freshly rebuilt block caches — merges are
+    wholesale cache-rebuild points (along with checkpoint restore). This
+    entry point rebuilds unconditionally (the hybrid bridge's per-window
+    injection lands here); the engine's split plan/apply path refreshes the
+    caches itself so empty rounds can skip the rebuild."""
+    if isinstance(q, BucketQueue):
+        merged = merge_flat_events(
+            as_flat(q), dst, t, order, kind, payload, valid, max_inserts,
+            shed_urgency=shed_urgency, force_path=force_path,
+            merge_rows=merge_rows,
+        )
+        return bucket_rebuild(merged, q.block)
     num_hosts, cap = q.t.shape
     n = dst.shape[0]
     r_cap = min(max_inserts, cap)

@@ -374,6 +374,7 @@ class Simulation:
             use_dynamic_runahead=ex.use_dynamic_runahead,
             use_codel=ex.use_codel,
             queue_capacity=qcap,
+            queue_block=ex.event_queue_block,
             sends_per_host_round=send_budget,
             max_round_inserts=ex.max_round_inserts or qcap,
             rounds_per_chunk=rpc,
@@ -611,6 +612,7 @@ class Simulation:
             ),
             "packets_budget_dropped": int(s.pkts_budget_dropped[:n].sum()),
             "outbox_overflow_dropped": int(np.asarray(s.ob_dropped).sum()),
+            "bucket_cache_rebuilds": int(np.asarray(s.bq_rebuilds).sum()),
             "monotonic_violations": int(s.monotonic_violations[:n].sum()),
             "determinism_digest": f"{int(np.bitwise_xor.reduce(s.digest[:n])):016x}",
             "model_report": self.model.report(

@@ -28,6 +28,7 @@ def build_sim(
     cpu_delay_ns: int = 0,
     jitter: int = 0,
     exchange: str = "gather",
+    queue_block: int = 0,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -39,6 +40,7 @@ def build_sim(
         runahead_floor=runahead_floor,
         static_min_latency=latency,
         queue_capacity=qcap,
+        queue_block=queue_block,
         sends_per_host_round=sends_budget,
         max_round_inserts=qcap,
         rounds_per_chunk=64,

@@ -1,0 +1,315 @@
+"""Two-level bucketed event queue: equivalence + cache-coherence gate.
+
+The `BucketQueue` contract (ops/events.py) is *bit-identical behavior* to the
+flat `EventQueue` — same popped events, same written slots, same drop
+counters — with per-block (min-time, min-order, fill) caches maintained
+incrementally on pop/push and rebuilt wholesale only at the exchange merge
+and checkpoint restore. These tests are the determinism gate for that claim:
+
+  1. a property test drives random interleavings of pop / push / merge
+     through both queue types (and both backend formulations of each op)
+     and asserts slabs, events, drops, and the block-min invariant after
+     every single operation;
+  2. a regression test for the nastiest incremental case: pop empties a
+     block, a push refills it, the next pop must see the refreshed cache;
+  3. engine-level runs of echo, phold, and tgen produce bit-identical
+     per-host digests for flat vs two different block sizes (the ISSUE's
+     acceptance gate, CPU backend);
+  4. checkpoint round-trip of a bucketed sim resumes identically (restore
+     is a cache-rebuild point).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.ops import (
+    as_flat,
+    block_minima,
+    bq_pop_min,
+    bq_push_many,
+    bucket_rebuild,
+    make_bucket_queue,
+    make_queue,
+    merge_flat_events,
+    next_time,
+    bq_next_time,
+    pack_order,
+    pop_min,
+    push_many,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+from shadow_tpu.simtime import TIME_MAX
+
+from tests.engine_harness import mk_hosts, run_sim
+
+
+def assert_caches_coherent(bq, msg=""):
+    """The block-min invariant: caches == wholesale recompute from the slab."""
+    nb = bq.bt.shape[1]
+    bt, bo, bfill = block_minima(bq.t, bq.order, nb)
+    np.testing.assert_array_equal(np.asarray(bq.bt), np.asarray(bt), err_msg=f"bt {msg}")
+    np.testing.assert_array_equal(np.asarray(bq.bo), np.asarray(bo), err_msg=f"bo {msg}")
+    np.testing.assert_array_equal(
+        np.asarray(bq.bfill), np.asarray(bfill), err_msg=f"bfill {msg}"
+    )
+
+
+def assert_queues_equal(qf, bq, msg=""):
+    ff = as_flat(bq)
+    for fa, fb, name in zip(qf, ff, qf._fields):
+        np.testing.assert_array_equal(
+            np.asarray(fa), np.asarray(fb), err_msg=f"{name} {msg}"
+        )
+
+
+# ------------------------------------------------------------------ property
+
+
+@pytest.mark.parametrize("path", ["gather", "onehot"])
+@pytest.mark.parametrize("block", [2, 4, 8])
+def test_random_ops_bit_identical_to_flat(block, path):
+    """Random pop/push/merge interleavings: flat and bucketed queues must
+    stay bit-identical (slabs, events, active masks, drop counters) and the
+    block caches must satisfy the block-min invariant after EVERY op —
+    across block sizes and both backend formulations of pop/push."""
+    hh, cc = 6, 8
+    rng = np.random.default_rng(block * 100 + (path == "onehot"))
+    qf = make_queue(hh, cc)
+    bq = make_bucket_queue(hh, cc, block)
+    seq = 0
+    for step in range(60):
+        op = rng.choice(["push", "pop", "merge"], p=[0.45, 0.35, 0.2])
+        msg = f"step {step} op {op} block {block} path {path}"
+        if op == "push":
+            k = int(rng.integers(1, 4))
+            pushes = []
+            for _ in range(k):
+                mask = jnp.asarray(rng.random(hh) < 0.7)
+                t = jnp.asarray(rng.integers(1, 1000, hh), jnp.int64)
+                order = jnp.asarray(
+                    [int(pack_order(1, i, seq + 7 * i)) for i in range(hh)],
+                    jnp.int64,
+                )
+                seq += 1
+                kind = jnp.asarray(rng.integers(0, 5, hh), jnp.int32)
+                payload = jnp.asarray(
+                    rng.integers(0, 99, (hh, EVENT_PAYLOAD_WORDS)), jnp.int32
+                )
+                pushes.append((mask, t, order, kind, payload))
+            qf = push_many(qf, pushes)
+            bq = bq_push_many(bq, pushes, force_path=path)
+        elif op == "pop":
+            limit = int(rng.choice([TIME_MAX, 500, 50]))
+            qf, evf, af = pop_min(qf, limit)
+            bq, evb, ab = bq_pop_min(bq, limit, force_path=path)
+            np.testing.assert_array_equal(np.asarray(af), np.asarray(ab), err_msg=msg)
+            for fa, fb, name in zip(evf, evb, evf._fields):
+                np.testing.assert_array_equal(
+                    np.asarray(fa), np.asarray(fb), err_msg=f"ev.{name} {msg}"
+                )
+            np.testing.assert_array_equal(
+                np.asarray(next_time(qf)), np.asarray(bq_next_time(bq)), err_msg=msg
+            )
+        else:
+            n = int(rng.integers(1, 12))
+            dst = jnp.asarray(rng.integers(0, hh, n), jnp.int32)
+            t = jnp.asarray(rng.integers(1, 1000, n), jnp.int64)
+            order = jnp.asarray(
+                [int(pack_order(0, int(rng.integers(0, hh)), 5000 + seq + i))
+                 for i in range(n)],
+                jnp.int64,
+            )
+            seq += n
+            kind = jnp.asarray(rng.integers(0, 5, n), jnp.int32)
+            payload = jnp.asarray(
+                rng.integers(0, 99, (n, EVENT_PAYLOAD_WORDS)), jnp.int32
+            )
+            valid = jnp.asarray(rng.random(n) < 0.8)
+            qf = merge_flat_events(
+                qf, dst, t, order, kind, payload, valid, max_inserts=cc
+            )
+            bq = merge_flat_events(
+                bq, dst, t, order, kind, payload, valid, max_inserts=cc
+            )
+        assert_queues_equal(qf, bq, msg)
+        assert_caches_coherent(bq, msg)
+
+
+# ---------------------------------------------------------------- regression
+
+
+@pytest.mark.parametrize("path", ["gather", "onehot"])
+def test_pop_after_push_into_popped_empty_block(path):
+    """Popping a block empty, pushing into it, then popping again must see
+    the refreshed cache: the pop's incremental recompute has to clear the
+    victim block's minimum, and the push's 2-way update has to resurrect it
+    — a stale cache either replays the popped event or hides the new one."""
+    bq = make_bucket_queue(1, 4, 2)
+    one = jnp.ones((1,), bool)
+
+    def push(q, t, seq):
+        return bq_push_many(
+            q,
+            [(one, jnp.asarray([t], jnp.int64),
+              jnp.asarray([int(pack_order(1, 0, seq))], jnp.int64),
+              jnp.asarray([1], jnp.int32),
+              jnp.zeros((1, EVENT_PAYLOAD_WORDS), jnp.int32))],
+            force_path=path,
+        )
+
+    # fill block 0 (slots 0-1) and one slot of block 1
+    bq = push(bq, 10, 0)
+    bq = push(bq, 20, 1)
+    bq = push(bq, 30, 2)  # lands in block 1
+    # drain block 0
+    bq, ev, active = bq_pop_min(bq, TIME_MAX, force_path=path)
+    assert int(ev.t[0]) == 10 and bool(active[0])
+    bq, ev, _ = bq_pop_min(bq, TIME_MAX, force_path=path)
+    assert int(ev.t[0]) == 20
+    assert_caches_coherent(bq, "after draining block 0")
+    assert int(bq.bfill[0, 0]) == 0 and int(bq.bt[0, 0]) == TIME_MAX
+    # push into the popped-empty block (first-free slot is in block 0)
+    bq = push(bq, 5, 3)
+    assert_caches_coherent(bq, "after refilling block 0")
+    assert int(bq.bt[0, 0]) == 5
+    # next pops must order across the refreshed block-0 cache and block 1
+    bq, ev, _ = bq_pop_min(bq, TIME_MAX, force_path=path)
+    assert int(ev.t[0]) == 5
+    bq, ev, _ = bq_pop_min(bq, TIME_MAX, force_path=path)
+    assert int(ev.t[0]) == 30
+    bq, _, active = bq_pop_min(bq, TIME_MAX, force_path=path)
+    assert not bool(active[0])
+    assert_caches_coherent(bq, "after draining everything")
+
+
+def test_rebuild_rejects_bad_block():
+    q = make_queue(2, 8)
+    with pytest.raises(ValueError):
+        bucket_rebuild(q, 3)  # does not divide capacity
+    with pytest.raises(ValueError):
+        bucket_rebuild(q, 0)
+
+
+def test_degenerate_block_equals_capacity():
+    """B=C (one block) is the flat queue with a cache bolted on — it must
+    still behave identically."""
+    bq = make_bucket_queue(2, 4, 4)
+    qf = make_queue(2, 4)
+    mask = jnp.asarray([True, True])
+    push = [(mask, jnp.asarray([7, 3], jnp.int64),
+             jnp.asarray([int(pack_order(1, 0, 0)), int(pack_order(1, 1, 0))],
+                         jnp.int64),
+             jnp.asarray([1, 1], jnp.int32),
+             jnp.zeros((2, EVENT_PAYLOAD_WORDS), jnp.int32))]
+    qf = push_many(qf, push)
+    bq = bq_push_many(bq, push)
+    assert_queues_equal(qf, bq)
+    assert_caches_coherent(bq)
+    qf, evf, _ = pop_min(qf, TIME_MAX)
+    bq, evb, _ = bq_pop_min(bq, TIME_MAX)
+    np.testing.assert_array_equal(np.asarray(evf.t), np.asarray(evb.t))
+    assert_queues_equal(qf, bq)
+
+
+# ------------------------------------------------------- engine determinism
+
+
+def _run(model, hosts, stop, qb, **kw):
+    _, stats, _ = run_sim(model, hosts, stop, world=1, queue_block=qb, **kw)
+    return stats
+
+
+@pytest.mark.parametrize(
+    "model,hosts,stop,kw",
+    [
+        ("phold", mk_hosts(10, {"mean_delay": "20 ms", "population": 2}),
+         400_000_000, dict(loss=0.1)),
+        ("udp_echo",
+         [dict(host_id=0, name="server", start_time=0,
+               model_args={"role": "server"})]
+         + [dict(host_id=i, name=f"c{i}", start_time=0,
+                 model_args={"role": "client", "peer": "server",
+                             "interval": "4 ms", "size_bytes": 2000})
+            for i in range(1, 5)],
+         300_000_000, dict(bw_bits=2_000_000, loss=0.05, use_codel=True)),
+        ("tgen_tcp",
+         mk_hosts(6, {"flow_segs": 12, "flows": 1, "cwnd_cap": 8,
+                      "rto_min": "100 ms"}),
+         4_000_000_000, dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+    ],
+    ids=["phold", "echo", "tgen_tcp"],
+)
+def test_engine_digest_flat_vs_bucketed(model, hosts, stop, kw):
+    """The ISSUE acceptance gate: per-host event digests bit-identical
+    between the flat queue and the bucketed queue on echo, phold, and tgen
+    workloads (same seed, CPU backend), across TWO different block sizes."""
+    s_flat = _run(model, hosts, stop, 0, **kw)
+    for qb in (8, 16):  # harness queue capacity is 32: C/B = 4 and 2
+        s_b = _run(model, hosts, stop, qb, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(s_flat.digest), np.asarray(s_b.digest),
+            err_msg=f"{model} block={qb}",
+        )
+        assert int(np.asarray(s_flat.events).sum()) == int(
+            np.asarray(s_b.events).sum()
+        )
+        # bucketed runs actually rebuilt caches at exchanges (sanity that
+        # the two-level path was exercised, not silently flat)
+        assert int(np.asarray(s_b.bq_rebuilds).sum()) > 0
+
+
+# ----------------------------------------------------------------- restore
+
+
+def test_checkpoint_roundtrip_bucketed(tmp_path):
+    """Checkpoint restore is a cache-rebuild point: a bucketed sim resumed
+    from a snapshot must finish with the same digest as an uninterrupted
+    run, and a flat-queue checkpoint must not restore into a bucketed sim
+    (different engine config => guard refuses)."""
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.core.checkpoint import (
+        CheckpointError,
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from shadow_tpu.sim import Simulation
+
+    def cfg(block=4):
+        return ConfigOptions.from_dict({
+            "general": {"stop_time": "4 s", "seed": 17},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "experimental": {"event_queue_capacity": 16,
+                             "event_queue_block": block},
+            "hosts": {
+                "n": {
+                    "count": 8,
+                    "network_node_id": 0,
+                    "processes": [{
+                        "model": "phold",
+                        "model_args": {"population": 2,
+                                       "mean_delay": "100 ms"},
+                    }],
+                }
+            },
+        })
+
+    a = Simulation(cfg(), world=1)
+    a.run(progress=False)
+    digest_a = a.stats_report()["determinism_digest"]
+
+    b = Simulation(cfg(), world=1)
+    b.state = b.engine.run_chunk(b.state, b.params)
+    assert not bool(b.state.done)
+    ckpt = str(tmp_path / "bq.npz")
+    save_checkpoint(ckpt, b)
+
+    c = Simulation(cfg(), world=1)
+    load_checkpoint(ckpt, c)
+    assert_caches_coherent(c.state.queue, "after restore")
+    c.run(progress=False)
+    assert c.stats_report()["determinism_digest"] == digest_a
+
+    d = Simulation(cfg(block=8), world=1)  # different layout: refuse loudly
+    with pytest.raises(CheckpointError):
+        load_checkpoint(ckpt, d)

@@ -1,0 +1,148 @@
+"""Microbench: flat vs two-level bucketed event queue on the microstep pair.
+
+The measured unit is the engine's per-microstep queue work — one `pop_min`
+(earliest event per host) followed by one `push_many` (reschedule) — run as a
+K-deep `lax.fori_loop` inside a single jit so dispatch overhead is amortized
+and XLA sees the same fusion opportunities the round loop gets. The flat
+`EventQueue` formulation is compared against `BucketQueue` over a sweep of
+block sizes B; both start from the SAME randomly-occupied slab, and the final
+slabs are asserted bit-identical (the bench doubles as an equivalence check —
+a fast bucketed variant that popped different events would be meaningless).
+
+Defaults match the tgen_tcp_10k regime: H=10k hosts, C=64 slots. Sweep:
+
+    python tools/bench_bucketq.py [--hosts 10000] [--cap 64] [--fill 12]
+                                  [--steps 64] [--reps 5] [--blocks 8,16,32,64]
+"""
+
+import argparse
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import shadow_tpu  # noqa: F401  (enables x64)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from shadow_tpu.ops.events import (
+    EVENT_PAYLOAD_WORDS,
+    bucket_rebuild,
+    make_queue,
+    pack_order,
+    pop_min,
+    push_many,
+    bq_pop_min,
+    bq_push_many,
+)
+from shadow_tpu.simtime import TIME_MAX
+
+DELTA_NS = 1_000_000  # reschedule delay: popped event returns at t + 1 ms
+
+
+def seed_slab(h: int, c: int, fill: int, seed: int = 7):
+    """A flat queue with `fill` live events per host at random slots/times
+    (random slot positions matter: they spread load across blocks). Order
+    keys are packed in numpy for the whole batch — per-event jax
+    `pack_order` + `int()` forces a device sync per key (the same
+    construction pathology seed_queue documents)."""
+    from shadow_tpu.ops.events import _LOCAL_SHIFT, _SRC_SHIFT
+
+    rng = np.random.default_rng(seed)
+    t = np.full((h, c), TIME_MAX, np.int64)
+    order = np.full((h, c), (1 << 63) - 1, np.int64)
+    kind = np.zeros((h, c), np.int32)
+    payload = np.zeros((h, c, EVENT_PAYLOAD_WORDS), np.int32)
+    # one random slot permutation per host, first `fill` columns chosen
+    slots = np.argsort(rng.random((h, c)), axis=1)[:, :fill]
+    hh = np.arange(h)[:, None]
+    t[hh, slots] = rng.integers(1, 1_000_000_000, (h, fill))
+    order[hh, slots] = (
+        (np.int64(1) << _LOCAL_SHIFT)
+        | (hh.astype(np.int64) << _SRC_SHIFT)
+        | np.arange(fill, dtype=np.int64)[None, :]
+    )
+    q = make_queue(h, c)
+    return q._replace(
+        t=jnp.asarray(t), order=jnp.asarray(order),
+        kind=jnp.asarray(kind), payload=jnp.asarray(payload),
+    )
+
+
+def make_stepper(h: int, steps: int, pop, push):
+    """K chained microstep pairs: pop the per-host min, push it back at
+    t + DELTA (occupancy stays constant, times advance, order keys stay
+    globally unique via the carried per-host seq counter)."""
+    hosts = jnp.arange(h, dtype=jnp.int64)
+
+    def body(_, carry):
+        q, seq = carry
+        q, ev, active = pop(q, TIME_MAX)
+        order = jax.vmap(pack_order, in_axes=(None, 0, 0))(1, hosts, seq)
+        q = push(q, [(active, ev.t + DELTA_NS, order, ev.kind, ev.payload)])
+        return q, seq + active.astype(jnp.int64)
+
+    def run(q, seq):
+        return lax.fori_loop(0, steps, body, (q, seq))
+
+    return jax.jit(run)
+
+
+def timed(fn, q0, seq0, reps: int):
+    out = fn(q0, seq0)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = fn(q0, seq0)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / reps, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=10_000)
+    ap.add_argument("--cap", type=int, default=64)
+    ap.add_argument("--fill", type=int, default=12)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--blocks", default="8,16,32,64")
+    args = ap.parse_args()
+    h, c = args.hosts, args.cap
+    blocks = [int(b) for b in args.blocks.split(",") if int(b) <= c]
+
+    flat0 = seed_slab(h, c, args.fill)
+    seq0 = jnp.full((h,), args.fill, jnp.int64)
+    print(
+        f"backend={jax.default_backend()} H={h} C={c} fill={args.fill} "
+        f"steps={args.steps} reps={args.reps}"
+    )
+
+    flat_step = make_stepper(h, args.steps, pop_min, push_many)
+    t_flat, (qf, _) = timed(flat_step, flat0, seq0, args.reps)
+    per = t_flat / args.steps * 1e3
+    print(f"flat      pop+push pair: {per:8.3f} ms/step  "
+          f"({t_flat * 1e3:8.1f} ms / {args.steps} steps)")
+
+    ref_t = np.asarray(qf.t)
+    for b in blocks:
+        if c % b:
+            print(f"B={b:3d}: skipped (does not divide C={c})")
+            continue
+        bq0 = bucket_rebuild(flat0, b)
+        bq_step = make_stepper(h, args.steps, bq_pop_min, bq_push_many)
+        t_b, (qb, _) = timed(bq_step, bq0, seq0, args.reps)
+        per_b = t_b / args.steps * 1e3
+        same = bool(np.array_equal(np.asarray(qb.t), ref_t))
+        print(
+            f"bucket B={b:3d} (C/B={c // b:3d}): {per_b:8.3f} ms/step  "
+            f"speedup x{t_flat / t_b:5.2f}  slab==flat: {same}"
+        )
+        if not same:
+            raise SystemExit(f"B={b}: bucketed slab diverged from flat")
+
+
+if __name__ == "__main__":
+    main()
