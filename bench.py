@@ -265,6 +265,20 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
     if n == 6:
         side = 4 if small else 10
         per_node = 8 if small else 100  # 10k hosts on 100 torus nodes
+        # K-way microstep fold (r6): swept with tools/bench_popk.py. On
+        # the CPU backend the e2e winner is K=1 — the microstep loop is
+        # HANDLER-dispatch bound there (decomposed in BASELINE.md r6:
+        # ~15 ms handler vs ~3 ms queue work per microstep at 10k hosts),
+        # and folding grows the full-width handler-dispatch count. On TPU
+        # the r5 on-chip trace shows the opposite balance (slab passes
+        # dominate the ~0.5 ms microstep), which is the regime the fold
+        # amortizes — K=4 is the r5-trace-predicted winner there, to be
+        # measured the next time a chip is reachable. Digests are
+        # bit-identical either way (tests/test_popk.py), so this knob is
+        # purely a perf lever and the trajectory stays comparable.
+        import jax as _jax
+
+        microstep_events = 1 if _jax.default_backend() == "cpu" else 4
         host_groups = {
             f"n{i:03d}": {
                 "count": per_node,
@@ -307,6 +321,7 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
                 # the microstep pop/push pair stops paying full-capacity
                 # reductions — see tools/bench_bucketq.py for the sweep.
                 "event_queue_block": 7,
+                "microstep_events": microstep_events,
                 "sends_per_host_round": 24,
                 "rounds_per_chunk": 256,
                 # merge_rows deliberately unset: measured on this workload
@@ -365,12 +380,23 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         sim_adv = int(state.now) / 1e9
         ev_adv = int(jax.device_get(state.stats.events).sum())
     value = (ev_adv / wall) if "events_per" in metric else (sim_adv / wall)
+    # event-density telemetry (the K-way microstep's target): how many
+    # dispatches a round serializes into, and how many events each
+    # dispatch retires — tracked in the BENCH trajectory from round 6 on
+    import numpy as _np
+
+    s = jax.device_get(state.stats)
+    msteps = int(_np.asarray(s.microsteps).sum())
+    rounds = int(s.rounds)
+    events_total = int(_np.asarray(s.events).sum())
     return {
         "metric": metric,
         "value": round(value, 3),
         "unit": "events/wall_s" if "events_per" in metric else "sim_s/wall_s",
         "sim_seconds": round(sim_adv, 3),
         "events": ev_adv,
+        "microsteps_per_round": round(msteps / max(rounds, 1), 2),
+        "events_per_microstep": round(events_total / max(msteps, 1), 2),
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
     }
@@ -482,6 +508,8 @@ def main() -> int:
                 "unit": "sim_s/wall_s",
                 "vs_baseline": round(vs, 3),
                 "events": res.get("events"),
+                "microsteps_per_round": res.get("microsteps_per_round"),
+                "events_per_microstep": res.get("events_per_microstep"),
                 "phold_10k_sim_s_per_wall_s": phold,
             }
         )

@@ -89,6 +89,13 @@ def _refresh_queue_caches(state):
 
 
 def _fingerprint(engine_cfg, treedef, params) -> str:
+    """The full EngineConfig participates via asdict — so a checkpoint
+    written under one `microstep_events` (or queue layout, exchange, ...)
+    refuses to restore into a sim built with another. For K specifically
+    this is stricter than strictly necessary (K>1 histories are
+    bit-identical to K=1), but mid-simulation the PEEKED batch state is
+    never part of SimState, so cross-K restores would be safe only by an
+    argument the guard cannot check; refusing loudly is the contract."""
     blob = json.dumps(
         {
             "cfg": dataclasses.asdict(engine_cfg),

@@ -74,7 +74,9 @@ from shadow_tpu.ops import (
     check_order_limits,
     merge_flat_events,
     pack_order,
+    q_clear_popped,
     q_next_time,
+    q_pop_k,
     q_pop_min,
     q_push_many,
 )
@@ -139,6 +141,8 @@ class Stats(NamedTuple):
     a2a_shed: Array  # i64[1] all-to-all block-overflow losses (size blocks so 0)
     microsteps: Array  # i64[1] total microsteps (per shard)
     bq_rebuilds: Array  # i64[1] wholesale block-cache rebuilds (bucketed queue)
+    popk_deferred: Array  # i64[1] K-way batch events peeked but deferred
+    ici_bytes: Array  # i64[1] exchange-collective bytes moved per shard
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
 
@@ -231,6 +235,22 @@ class EngineConfig:
     # drop counters to the flat queue by construction (tests/test_bucketq.py
     # is the gate). 0 = flat queue (the B=C degenerate case).
     queue_block: int = 0
+    # K-way microstep pop (experimental.microstep_events): fold up to K
+    # events per host through the model handler per queue dispatch. The
+    # queue slab is read once per microstep (ops/events.py pop_k) and the
+    # executed prefix cleared once (clear_popped), so per-event queue cost
+    # drops up to K-fold on event-dense hosts — the density ceiling the
+    # one-event microstep hits on tgen-TCP (BENCH r5: 20-33 microsteps per
+    # round at ~0.5 ms each). Exactness guard: event j+1 of a host's batch
+    # executes only if no push emitted so far this microstep landed at an
+    # earlier (time, order) key on that host (and, under the CPU model,
+    # only while busy_until stays inside the window) — otherwise the rest
+    # of the batch stays in the queue untouched and re-pops next
+    # microstep. Execution order, digests, event counts, and drop counters
+    # are bit-identical to K=1 by construction for both queue layouts
+    # (tests/test_popk.py is the gate). 1 = today's exact single-event
+    # microstep (the default).
+    microstep_events: int = 1
     # Per-HOST send budget per round. Budget-drop decisions depend only on a
     # host's own send count, and the shard outbox is sized hosts_per_shard *
     # budget so aggregate overflow is impossible — this is what keeps drop
@@ -289,6 +309,10 @@ class EngineConfig:
                 f"queue_block={self.queue_block} must be 0 (flat) or divide "
                 f"queue_capacity={self.queue_capacity} evenly"
             )
+        if self.microstep_events < 1:
+            raise ValueError(
+                f"microstep_events={self.microstep_events} must be >= 1"
+            )
 
     @property
     def a2a_block_size(self) -> int:
@@ -303,7 +327,27 @@ class EngineConfig:
 
     @property
     def effective_microstep_limit(self) -> int:
+        """The per-round safety valve. For K=1 it bounds microsteps (and so
+        events per host per round); for K>1 the round loop carries a
+        PER-HOST executed-event vector and stops when any host's count
+        reaches this value, so the same number keeps denominating an event
+        budget — microsteps needed shrink up to K-fold when batches fold
+        fully, while a deferral-heavy microstep charges a host only what
+        it actually retired. Dividing the valve by K instead would bind
+        EARLIER than K=1 under bursty-push deferral, and a global
+        sum-of-dispatch-maxima charge would overcharge multi-host rounds;
+        the per-host vector can only bind in rounds where some host
+        genuinely retires `limit` events — exactly the K=1 livelock
+        condition — and never cuts short a round K=1 would finish (a
+        host's count before its final dispatch is at most total - 1 <
+        limit). It is a livelock valve, not a scheduler."""
         return self.microstep_limit or 2 * self.queue_capacity
+
+    @property
+    def effective_microstep_events(self) -> int:
+        """K clamped to the queue capacity (popping more than C events in
+        one batch is impossible by construction)."""
+        return min(self.microstep_events, self.queue_capacity)
 
 
 # --------------------------------------------------------------------------
@@ -344,6 +388,8 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         a2a_shed=jnp.zeros((cfg.world,), jnp.int64),
         microsteps=jnp.zeros((cfg.world,), jnp.int64),
         bq_rebuilds=jnp.zeros((cfg.world,), jnp.int64),
+        popk_deferred=jnp.zeros((cfg.world,), jnp.int64),
+        ici_bytes=jnp.zeros((cfg.world,), jnp.int64),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
         rounds=jnp.zeros((), jnp.int64),
     )
@@ -584,6 +630,8 @@ class Engine:
                 a2a_shed=sh,
                 microsteps=sh,
                 bq_rebuilds=sh,
+                popk_deferred=sh,
+                ici_bytes=sh,
                 digest=sh,
                 rounds=rep,
             ),
@@ -800,18 +848,53 @@ def _window_step(
     host_gid = shard_start + jnp.arange(h_local, dtype=jnp.int64)
 
     # ---- 3: microsteps (no collectives inside — shards proceed independently)
-    def micro_cond(carry):
-        stc, steps = carry
-        return jnp.any(_effective_next(cfg, stc) < window_end) & (
-            steps < cfg.effective_microstep_limit
+    if cfg.effective_microstep_events > 1:
+        # K-way fold: the valve is a PER-HOST executed-event vector, bound
+        # by its max — not a global sum of per-dispatch maxima, which
+        # could overcharge (dispatch 1 charges host A's fold of 8 while
+        # host B retired 1) and bind EARLIER than K=1. Per-host, a host
+        # that would finish its round under K=1's limit always finishes
+        # here too: its count before its last dispatch is at most
+        # total - 1 < limit, so the strict < never cuts a non-pathological
+        # round short — see EngineConfig.effective_microstep_limit.
+        # `steps` keeps counting real dispatches for stats. Progress is
+        # still guaranteed (batch index 0 can never defer, so every
+        # dispatch with the cond held retires >= 1 event on some host).
+        h_local = st.queue.t.shape[0]
+
+        def micro_cond(carry):
+            stc, valve, steps = carry
+            return jnp.any(_effective_next(cfg, stc) < window_end) & (
+                jnp.max(valve) < cfg.effective_microstep_limit
+            )
+
+        def micro_body(carry):
+            stc, valve, steps = carry
+            stc, executed = _microstep_k(
+                cfg, model, stc, params, host_gid, window_end
+            )
+            return stc, valve + executed.astype(jnp.int64), steps + 1
+
+        st_m, _, steps = lax.while_loop(
+            micro_cond,
+            micro_body,
+            (st, jnp.zeros((h_local,), jnp.int64), jnp.zeros((), jnp.int64)),
         )
+    else:
+        def micro_cond(carry):
+            stc, steps = carry
+            return jnp.any(_effective_next(cfg, stc) < window_end) & (
+                steps < cfg.effective_microstep_limit
+            )
 
-    def micro_body(carry):
-        stc, steps = carry
-        stc = _microstep(cfg, model, stc, params, host_gid, window_end)
-        return stc, steps + 1
+        def micro_body(carry):
+            stc, steps = carry
+            stc = _microstep(cfg, model, stc, params, host_gid, window_end)
+            return stc, steps + 1
 
-    st_m, steps = lax.while_loop(micro_cond, micro_body, (st, jnp.zeros((), jnp.int64)))
+        st_m, steps = lax.while_loop(
+            micro_cond, micro_body, (st, jnp.zeros((), jnp.int64))
+        )
 
     # ---- 4: exchange staged packets across the mesh
     st_x = _exchange(cfg, axis, st_m)
@@ -843,31 +926,31 @@ def _effective_next(cfg: EngineConfig, st: SimState):
     return nt
 
 
-def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
-    if cfg.cpu_delay_ns > 0:
-        # a host busy past the window does not pop at all; events stay in
-        # the queue so their (time, order) sequence is preserved verbatim.
-        # An event popped while the CPU is busy *within* the window executes
-        # at busy_until (host.rs:820-847): rewrite ev.t to the execution
-        # time so every downstream consumer (handler ctx, digest, pushes,
-        # egress departure) sees the delayed clock, never a stale one.
-        # Both busy_until and ev.t are < window_end here, so the execution
-        # time stays inside the window.
-        limit_h = jnp.where(
-            st.cpu_busy_until < window_end, window_end, jnp.int64(0)
-        )
-        queue, ev, active = q_pop_min(st.queue, limit_h)
-        exec_t = jnp.maximum(ev.t, st.cpu_busy_until)
-        ev = ev._replace(t=jnp.where(active, exec_t, ev.t))
-        st = st._replace(
-            cpu_busy_until=jnp.where(
-                active, exec_t + cfg.cpu_delay_ns, st.cpu_busy_until
-            )
-        )
-    else:
-        queue, ev, active = q_pop_min(st.queue, window_end)
+class _EvCarry(NamedTuple):
+    """The state threads an executed event reads/writes — everything a
+    microstep touches EXCEPT the queue and the outbox, which the two
+    microstep shapes (single-event vs K-way fold) apply differently:
+    K=1 applies pushes/appends immediately; the K-way fold accumulates
+    them across the batch and applies each in ONE fused pass."""
 
-    stats = st.stats
+    stats: Stats
+    rng: RngState
+    seq: Array
+    sent_round: Array
+    tb_egress: TBState
+    tb_ingress: TBState
+    codel: Any
+    model: Any
+
+
+def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, active):
+    """Execute one event per `active` host: digest, ingress shaping, model
+    dispatch, and egress staging. Returns (carry', push_list, ob_entries,
+    used_lats): queue pushes and outbox appends are RETURNED, not applied —
+    dataflow-identical for the K=1 caller (pure functions; application
+    order does not change any value) and the enabler for the K-way fold's
+    amortized single-pass application."""
+    stats = c.stats
     stats = stats._replace(
         events=stats.events + active,
         digest=_digest_update(stats.digest, active, ev.t, ev.kind, ev.order),
@@ -886,15 +969,15 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         size_bits = jnp.asarray(ev.payload[:, PAYLOAD_SIZE_WORD], jnp.int64) * 8
         no_mask = jnp.zeros_like(needs_ingress)
         _, depart_probe = tb_conforming_remove(
-            st.tb_ingress, params.in_tb, cfg.tb_interval_ns, ev.t, size_bits, no_mask
+            c.tb_ingress, params.in_tb, cfg.tb_interval_ns, ev.t, size_bits, no_mask
         )
         sojourn = depart_probe - ev.t
         if cfg.use_codel:
-            codel, codel_drop = codel_on_packet(st.codel, ev.t, sojourn, needs_ingress)
+            codel, codel_drop = codel_on_packet(c.codel, ev.t, sojourn, needs_ingress)
         else:
-            codel, codel_drop = st.codel, jnp.zeros_like(needs_ingress)
+            codel, codel_drop = c.codel, jnp.zeros_like(needs_ingress)
         tb_in, depart = tb_conforming_remove(
-            st.tb_ingress,
+            c.tb_ingress,
             params.in_tb,
             cfg.tb_interval_ns,
             ev.t,
@@ -916,7 +999,7 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         )
         dispatch = active & ~(needs_ingress & (codel_drop | delay))
     else:
-        codel, tb_in = st.codel, st.tb_ingress
+        codel, tb_in = c.codel, c.tb_ingress
         requeue = None
         dispatch = active
 
@@ -931,17 +1014,15 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         is_packet=is_pkt,
         src=unpack_order_src(ev.order),
         host_id=host_gid,
-        state=st.model,
+        state=c.model,
         params=params.model,
-        rng=st.rng,
+        rng=c.rng,
     )
     out = model.handle(ctx)
     rng, model_state = out.rng, out.state
-    seq = st.seq
-    sent_round = st.sent_round
-    tb_eg = st.tb_egress
-    outbox = st.outbox
-    ob_lost = jnp.zeros((), jnp.int64)
+    seq = c.seq
+    sent_round = c.sent_round
+    tb_eg = c.tb_egress
 
     # ---- local pushes (schedule_task_* analogue). All ports are applied
     # in ONE slab pass (push_many): sequential push_one calls each pay a
@@ -963,8 +1044,6 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             mask, t_push, order,
             jnp.asarray(p.kind, jnp.int32) & KIND_MASK, p.payload,
         ))
-    if push_list:
-        queue = q_push_many(queue, push_list)
 
     # ---- sends: egress pipeline (worker.rs:330-425 send_packet). Each
     # port may carry a BURST (PacketSend.count/count_max): up to count_max
@@ -1088,8 +1167,32 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
                 pkts_unreachable=stats.pkts_unreachable + unreachable,
                 pkts_budget_dropped=stats.pkts_budget_dropped + budget_dropped,
             )
-    if entries:
-        outbox, n_lost = _outbox_append_multi(outbox, entries)
+    return (
+        _EvCarry(
+            stats=stats, rng=rng, seq=seq, sent_round=sent_round,
+            tb_egress=tb_eg, tb_ingress=tb_in, codel=codel, model=model_state,
+        ),
+        push_list,
+        entries,
+        used_lats,
+    )
+
+
+def _ev_carry_of(st: SimState) -> _EvCarry:
+    return _EvCarry(
+        stats=st.stats, rng=st.rng, seq=st.seq, sent_round=st.sent_round,
+        tb_egress=st.tb_egress, tb_ingress=st.tb_ingress, codel=st.codel,
+        model=st.model,
+    )
+
+
+def _finish_microstep(st: SimState, c: _EvCarry, queue, ob_entries, used_lats):
+    """Apply a microstep's accumulated outbox appends (one fused slab pass),
+    fold the used-latency lookahead, and reassemble the SimState."""
+    outbox = st.outbox
+    ob_lost = jnp.zeros((), jnp.int64)
+    if ob_entries:
+        outbox, n_lost = _outbox_append_multi(outbox, ob_entries)
         ob_lost = ob_lost + n_lost
         st = st._replace(
             min_used_lat=jnp.minimum(
@@ -1097,20 +1200,190 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
                 jnp.min(jnp.stack([jnp.min(u) for u in used_lats])),
             )
         )
-
-    stats = stats._replace(ob_dropped=stats.ob_dropped + ob_lost[None])
+    stats = c.stats._replace(ob_dropped=c.stats.ob_dropped + ob_lost[None])
     return st._replace(
         queue=queue,
-        rng=rng,
-        seq=seq,
-        sent_round=sent_round,
-        tb_egress=tb_eg,
-        tb_ingress=tb_in,
-        codel=codel,
-        model=model_state,
+        rng=c.rng,
+        seq=c.seq,
+        sent_round=c.sent_round,
+        tb_egress=c.tb_egress,
+        tb_ingress=c.tb_ingress,
+        codel=c.codel,
+        model=c.model,
         outbox=outbox,
         stats=stats,
     )
+
+
+def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
+    """The single-event microstep (microstep_events = 1): pop each host's
+    earliest event, execute, apply pushes and appends."""
+    if cfg.cpu_delay_ns > 0:
+        # a host busy past the window does not pop at all; events stay in
+        # the queue so their (time, order) sequence is preserved verbatim.
+        # An event popped while the CPU is busy *within* the window executes
+        # at busy_until (host.rs:820-847): rewrite ev.t to the execution
+        # time so every downstream consumer (handler ctx, digest, pushes,
+        # egress departure) sees the delayed clock, never a stale one.
+        # Both busy_until and ev.t are < window_end here, so the execution
+        # time stays inside the window.
+        limit_h = jnp.where(
+            st.cpu_busy_until < window_end, window_end, jnp.int64(0)
+        )
+        queue, ev, active = q_pop_min(st.queue, limit_h)
+        exec_t = jnp.maximum(ev.t, st.cpu_busy_until)
+        ev = ev._replace(t=jnp.where(active, exec_t, ev.t))
+        st = st._replace(
+            cpu_busy_until=jnp.where(
+                active, exec_t + cfg.cpu_delay_ns, st.cpu_busy_until
+            )
+        )
+    else:
+        queue, ev, active = q_pop_min(st.queue, window_end)
+
+    c, push_list, ob_entries, used_lats = _event_body(
+        cfg, model, _ev_carry_of(st), params, host_gid, window_end, ev, active
+    )
+    if push_list:
+        queue = q_push_many(queue, push_list)
+    return _finish_microstep(st, c, queue, ob_entries, used_lats)
+
+
+def _lex_less(at, ao, bt, bo):
+    """(at, ao) < (bt, bo) on the (time, order) total key."""
+    return (at < bt) | ((at == bt) & (ao < bo))
+
+
+def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
+    """The K-way microstep (microstep_events = K > 1): peek each host's K
+    earliest in-window events in ONE slab pass (`q_pop_k`), fold them
+    through the model handler with an unrolled inner loop, then remove the
+    executed prefix and apply ALL pushes and outbox appends in one fused
+    pass each. Returns (state', executed[H]) — each host's executed count,
+    the round loop's per-host event-denominated valve charge.
+
+    Exactness guard (the reason this is bit-identical to K=1 by
+    construction): batch event j+1 of a host executes only if no push this
+    host emitted so far this microstep (model pushes AND ingress requeues)
+    landed at an earlier (time, order) key — in K=1 that pushed event would
+    pop before batch event j+1 — and, under the CPU model, only while the
+    host's busy horizon stays inside the window (K=1 would stop popping).
+    Deferral is monotone (the batch is key-sorted and push keys only
+    accumulate), so execution is always a PREFIX of the batch; deferred
+    events were only peeked, never removed, and re-pop next microstep in
+    their original order.
+
+    Drop exactness: pushes run AFTER the executed prefix is cleared, in
+    K=1 chronological order (requeue_0, pushes_0, requeue_1, ...), each
+    carrying a RESERVE equal to the number of batch events that executed
+    after it (in K=1 those still occupied queue slots when the push
+    landed) — see ops/events.py `_push_fields`. Outbox columns are cursor-
+    assigned exactly as across separate microsteps."""
+    k = cfg.effective_microstep_events
+    h = st.queue.t.shape[0]
+    if cfg.cpu_delay_ns > 0:
+        limit = jnp.where(
+            st.cpu_busy_until < window_end, window_end, jnp.int64(0)
+        )
+    else:
+        limit = window_end
+    popped = q_pop_k(st.queue, limit, k)
+
+    c = _ev_carry_of(st)
+    deferred = jnp.zeros((h,), bool)
+    pm_t = jnp.full((h,), TIME_MAX, jnp.int64)  # earliest push key so far
+    pm_o = jnp.full((h,), ORDER_MAX, jnp.int64)
+    busy = st.cpu_busy_until
+    exec_ks = []  # [H] bool per batch index
+    push_lists = []  # per batch index, K=1 chronological order
+    ob_entries = []
+    used_lats = []
+    for j in range(k):
+        ev = popped.event(j)
+        if j > 0:
+            deferred = deferred | _lex_less(pm_t, pm_o, ev.t, ev.order)
+            if cfg.cpu_delay_ns > 0:
+                deferred = deferred | (busy >= window_end)
+        exec_j = popped.active[:, j] & ~deferred
+        if cfg.cpu_delay_ns > 0:
+            exec_t = jnp.maximum(ev.t, busy)
+            ev = ev._replace(t=jnp.where(exec_j, exec_t, ev.t))
+            busy = jnp.where(exec_j, exec_t + cfg.cpu_delay_ns, busy)
+        c, push_list, entries, lats = _event_body(
+            cfg, model, c, params, host_gid, window_end, ev, exec_j
+        )
+        # accumulate this event's push keys into the guard minimum AFTER
+        # its own execution (an event's pushes cannot defer itself)
+        for push in push_list:
+            mask, p_t, p_o = push[0], jnp.asarray(push[1], jnp.int64), push[2]
+            better = mask & _lex_less(p_t, p_o, pm_t, pm_o)
+            pm_t = jnp.where(better, p_t, pm_t)
+            pm_o = jnp.where(better, p_o, pm_o)
+        exec_ks.append(exec_j)
+        push_lists.append(push_list)
+        ob_entries += entries
+        used_lats += lats
+
+    # executed prefix length per host, and the per-push reserves
+    exec_i32 = [e.astype(jnp.int32) for e in exec_ks]
+    m = functools.reduce(jnp.add, exec_i32)  # [H] i32
+    queue = q_clear_popped(st.queue, popped, m)
+    all_pushes = []
+    for j, push_list in enumerate(push_lists):
+        if not push_list:
+            continue
+        # batch events that executed AFTER event j still held their slots
+        # when event j's pushes landed in K=1
+        reserve = (
+            functools.reduce(jnp.add, exec_i32[j + 1 :])
+            if j + 1 < k
+            else jnp.zeros((h,), jnp.int32)
+        )
+        all_pushes += [p + (reserve,) for p in push_list]
+    if all_pushes:
+        queue = q_push_many(queue, all_pushes)
+
+    n_deferred = jnp.sum(
+        (popped.active & ~jnp.stack(exec_ks, axis=1)).astype(jnp.int64)
+    )
+    stats = c.stats._replace(
+        popk_deferred=c.stats.popk_deferred + n_deferred[None]
+    )
+    c = c._replace(stats=stats)
+    if cfg.cpu_delay_ns > 0:
+        st = st._replace(cpu_busy_until=busy)
+    st = _finish_microstep(st, c, queue, ob_entries, used_lats)
+    return st, m
+
+
+def exchange_ici_bytes_per_round(cfg: EngineConfig, kind: str | None = None) -> int:
+    """Per-shard ICI bytes one exchange moves — the cost model written out
+    in `_exchange_alltoall`'s docstring, as a checkable number.
+
+    gather:   every shard RECEIVES the other (W-1) shards' whole outboxes:
+              (W-1) x rows_local x row_bytes (+ the 4-byte count word),
+              with rows_local = hosts_per_shard x sends_per_host_round and
+              row_bytes = dst + t + order + kind + payload words.
+    alltoall: every shard sends/receives (W-1) fixed blocks of
+              `a2a_block_size` packed rows (1 dst word + the packed event,
+              ops/merge._pack_words) — O(global sends / world) once blocks
+              are sized to traffic instead of O(world-replicated) like the
+              gather.
+
+    The engine charges exactly these numbers into `stats.ici_bytes` every
+    round (the collectives run unconditionally, empty rounds included), so
+    the counter is the model made observable: the multichip dryrun asserts
+    counter == model x rounds, and on a real mesh the counter can be held
+    against profiler ICI traffic to validate the model itself."""
+    kind = kind or cfg.exchange
+    if cfg.world <= 1:
+        return 0
+    rows_local = cfg.hosts_per_shard * cfg.sends_per_host_round
+    row_bytes = 4 + 8 + 8 + 4 + 4 * EVENT_PAYLOAD_WORDS
+    if kind == "gather":
+        return (cfg.world - 1) * (rows_local * row_bytes + 4)
+    packed_words = 1 + (2 + 2 + 1 + EVENT_PAYLOAD_WORDS)  # dst + packed event
+    return (cfg.world - 1) * cfg.a2a_block_size * packed_words * 4
 
 
 def _exchange(cfg, axis, st: SimState):
@@ -1142,6 +1415,11 @@ def _exchange(cfg, axis, st: SimState):
     has_sends = jnp.sum(g.count) > 0
     queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
     stats = st.stats
+    if axis:
+        stats = stats._replace(
+            ici_bytes=stats.ici_bytes
+            + jnp.int64(exchange_ici_bytes_per_round(cfg, "gather"))[None]
+        )
     if isinstance(st.queue, BucketQueue):
         stats = stats._replace(
             bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]
@@ -1327,7 +1605,11 @@ def _exchange_alltoall(cfg, axis, st: SimState):
 
     has_sends = lax.psum(jnp.sum(ob.count), axis) > 0
     queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
-    stats = st.stats._replace(a2a_shed=st.stats.a2a_shed + shed[None])
+    stats = st.stats._replace(
+        a2a_shed=st.stats.a2a_shed + shed[None],
+        ici_bytes=st.stats.ici_bytes
+        + jnp.int64(exchange_ici_bytes_per_round(cfg, "alltoall"))[None],
+    )
     if isinstance(st.queue, BucketQueue):
         stats = stats._replace(
             bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]

@@ -26,6 +26,13 @@ EventQueue, ops/events.py) is invisible here BY DESIGN — the same golden
 digests and counters gate both layouts, which is what makes this module the
 oracle for the bucket-equivalence determinism tests (tests/test_bucketq.py):
 flat engine == bucketed engine == golden, or one of the three is wrong.
+
+Microstep-shape independence, same principle: golden pops and executes
+EXACTLY ONE event per host per microstep — `cfg.microstep_events` (the
+engine's K-way fold, core/engine.py `_microstep_k`) is likewise invisible
+here by design. The K-way path's contract is "bit-identical to K=1", and
+K=1 is what this loop IS, so golden is the equivalence reference for every
+K (tests/test_popk.py gates engine-K == engine-1 == golden).
 """
 
 from __future__ import annotations

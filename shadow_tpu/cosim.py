@@ -158,6 +158,13 @@ class HybridSimulation:
             # so it must be >= 1 or nothing would ever advance
             rounds_per_chunk=max(auto_rpc, 1),
             microstep_limit=ex.microstep_limit,
+            # the K-way fold and the flipped multi-device exchange default
+            # ride along on hybrid sims: both act below the bridge (the
+            # microstep loop / the cross-shard merge), so the CPU plane
+            # sees identical deliveries either way
+            microstep_events=ex.microstep_events,
+            exchange=ex.resolve_exchange(world),
+            a2a_block=ex.a2a_block,
             world=world,
             shaping=any(
                 s.bw_up_bits > 0 or s.bw_down_bits > 0 for s in self.specs

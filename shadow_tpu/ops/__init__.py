@@ -10,22 +10,27 @@ from shadow_tpu.ops.events import (
     BucketQueue,
     EventQueue,
     EVENT_PAYLOAD_WORDS,
+    PoppedK,
     as_flat,
     block_minima,
     bucket_rebuild,
     bq_next_time,
     bq_pop_min,
     bq_push_many,
+    clear_popped,
     make_bucket_queue,
     make_queue,
     next_time,
     queue_len,
+    pop_k,
     pop_min,
     push_many,
     push_one,
     pack_order,
     check_order_limits,
+    q_clear_popped,
     q_next_time,
+    q_pop_k,
     q_pop_min,
     q_push_many,
     ORDER_MAX,
@@ -37,22 +42,27 @@ __all__ = [
     "BucketQueue",
     "EventQueue",
     "EVENT_PAYLOAD_WORDS",
+    "PoppedK",
     "as_flat",
     "block_minima",
     "bucket_rebuild",
     "bq_next_time",
     "bq_pop_min",
     "bq_push_many",
+    "clear_popped",
     "make_bucket_queue",
     "make_queue",
     "next_time",
     "queue_len",
+    "pop_k",
     "pop_min",
     "push_many",
     "push_one",
     "pack_order",
     "check_order_limits",
+    "q_clear_popped",
     "q_next_time",
+    "q_pop_k",
     "q_pop_min",
     "q_push_many",
     "ORDER_MAX",

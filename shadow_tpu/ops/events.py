@@ -59,6 +59,30 @@ class Event(NamedTuple):
     payload: Array  # i32[H, P]
 
 
+class PoppedK(NamedTuple):
+    """Each host's K earliest in-window events, PEEKED (not yet removed).
+
+    The K-way microstep pops a batch, folds as many events as its exactness
+    guard allows, and then removes exactly that executed prefix with
+    `clear_popped` — deferred events never leave the slab, so no re-push
+    (and no spurious drop accounting) is ever needed. Events are sorted by
+    the (time, order) total key along axis 1; `active[h, j]` is a prefix
+    mask per host (times are sorted, so `t < limit` can only switch off)."""
+
+    t: Array  # i64[H, K] (TIME_MAX where inactive)
+    order: Array  # i64[H, K] (ORDER_MAX where inactive)
+    kind: Array  # i32[H, K]
+    payload: Array  # i32[H, K, P]
+    active: Array  # bool[H, K]
+    idx: Array  # i32[H, K] slab column holding each event (for the clear)
+
+    def event(self, j: int) -> Event:
+        return Event(
+            t=self.t[:, j], order=self.order[:, j],
+            kind=self.kind[:, j], payload=self.payload[:, j],
+        )
+
+
 def pack_order(is_local, src_host, seq) -> Array:
     """Pack the deterministic tiebreak key (event.rs:131-155 equivalent).
 
@@ -174,17 +198,158 @@ def pop_min(q: EventQueue, limit) -> tuple[EventQueue, Event, Array]:
     )
 
 
+def pop_k(q, limit, k: int, force_path: str | None = None) -> PoppedK:
+    """PEEK each host's k earliest events strictly before `limit` — the
+    K-way microstep's batch extraction (works on either queue type through
+    the flat planes).
+
+    Nothing is written: the caller removes the prefix it actually executed
+    with `clear_popped`, so a single read of the key planes plus ONE
+    kind/payload extraction and ONE clear write replace the k reads AND k
+    writes of every [H, C] plane that k successive `pop_min` calls pay —
+    the amortization the K-way microstep is built on. The j-th column
+    equals what the j-th successive `q_pop_min` would return (order keys
+    are globally unique, so ties exist only among the empty-slot
+    sentinels, which `active` masks out).
+
+    Two formulations, pinned by `force_path` ('gather' | 'onehot'), same
+    backend split as `pop_min`, identical results:
+
+      - gather (CPU default): k iterated (min-time, min-order) selections
+        over working copies of the key planes — measured ~4x faster than
+        the XLA-CPU generic-comparator row sort at H=10k, C=28, k=8 —
+        then row gathers for kind/payload (cheap on CPU);
+      - onehot (TPU default): one per-row `lax.sort` over the packed key
+        (a fused sorting network, no per-row gathers), then one-hot
+        masked-sum extraction per batch column."""
+    qf = as_flat(q)
+    h, c = qf.t.shape
+    k = min(k, c)
+    limit = jnp.broadcast_to(jnp.asarray(limit, jnp.int64), (h,))
+    cols = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[None, :], (h, c))
+    path = force_path or (
+        "gather" if jax.default_backend() == "cpu" else "onehot"
+    )
+    if path == "gather":
+        wt, wo = qf.t, qf.order
+        t_cols, o_cols, i_cols = [], [], []
+        for _ in range(k):
+            tmin = jnp.min(wt, axis=1)
+            cand = jnp.where(wt == tmin[:, None], wo, ORDER_MAX)
+            omin = jnp.min(cand, axis=1)
+            idx_j = jnp.argmax(
+                (wt == tmin[:, None]) & (wo == omin[:, None]), axis=1
+            ).astype(jnp.int32)
+            # narrow to the ONE winning slot (empty slots share the
+            # sentinel pair, so the raw match can cover several columns)
+            oh = cols == idx_j[:, None]
+            wt = jnp.where(oh, TIME_MAX, wt)
+            wo = jnp.where(oh, ORDER_MAX, wo)
+            t_cols.append(tmin)
+            o_cols.append(omin)
+            i_cols.append(idx_j)
+        ev_t = jnp.stack(t_cols, axis=1)
+        ev_o = jnp.stack(o_cols, axis=1)
+        idx = jnp.stack(i_cols, axis=1)
+        active = ev_t < limit[:, None]  # prefix per host (times ascend)
+        hh = jnp.arange(h)[:, None]
+        ev_kind = jnp.where(active, qf.kind[hh, idx], 0)
+        ev_payload = jnp.where(active[:, :, None], qf.payload[hh, idx], 0)
+    else:
+        s_t, s_o, s_i = jax.lax.sort(
+            (qf.t, qf.order, cols), dimension=1, num_keys=2
+        )
+        ev_t, ev_o, idx = s_t[:, :k], s_o[:, :k], s_i[:, :k]
+        active = ev_t < limit[:, None]
+        # one-hot masked sums, one [H, C] pass per batch column (see the
+        # pop_min one-hot rationale: per-row dynamic gathers lower to slow
+        # custom kernels on TPU). Exact: each column index hits one slot.
+        ks, ps = [], []
+        for j in range(k):
+            oh = active[:, j, None] & (cols == idx[:, j : j + 1])
+            ks.append(jnp.sum(jnp.where(oh, qf.kind, 0), axis=1, dtype=qf.kind.dtype))
+            ps.append(
+                jnp.sum(
+                    jnp.where(oh[:, :, None], qf.payload, 0),
+                    axis=1,
+                    dtype=qf.payload.dtype,
+                )
+            )
+        ev_kind = jnp.stack(ks, axis=1)
+        ev_payload = jnp.stack(ps, axis=1)
+    return PoppedK(
+        t=jnp.where(active, ev_t, TIME_MAX),
+        order=jnp.where(active, ev_o, ORDER_MAX),
+        kind=ev_kind,
+        payload=ev_payload,
+        active=active,
+        idx=idx,
+    )
+
+
+def clear_popped(q, popped: PoppedK, m):
+    """Remove the first `m[h]` ([H] i32) events of a `pop_k` batch from the
+    slab — the executed prefix; deferred events past `m` stay in place.
+
+    One write pass over the t/order planes. For a `BucketQueue` the block
+    caches are maintained by a victim-block recompute covering up to K
+    victims: only blocks that lost a slot get their (bt, bo) minimum
+    recomputed (the K-way analogue of `bq_pop_min`'s single-victim
+    recompute); untouched blocks keep their cached values bit-for-bit."""
+    qf = as_flat(q)
+    h, c = qf.t.shape
+    k = popped.idx.shape[1]
+    take = popped.active & (jnp.arange(k, dtype=jnp.int32)[None, :] < m[:, None])
+    cols = jnp.arange(c, dtype=jnp.int32)[None, :]
+    clear = jnp.zeros((h, c), bool)
+    for j in range(k):
+        clear = clear | (take[:, j, None] & (cols == popped.idx[:, j : j + 1]))
+    new_t = jnp.where(clear, TIME_MAX, qf.t)
+    new_order = jnp.where(clear, ORDER_MAX, qf.order)
+    if not isinstance(q, BucketQueue):
+        return q._replace(t=new_t, order=new_order)
+    nb = q.bt.shape[1]
+    b = c // nb
+    cleared3 = clear.reshape(h, nb, b)
+    touched = jnp.any(cleared3, axis=2)  # [H, NB] blocks that lost a slot
+    t3 = new_t.reshape(h, nb, b)
+    o3 = new_order.reshape(h, nb, b)
+    nbt = jnp.min(t3, axis=2)
+    nbo = jnp.min(jnp.where(t3 == nbt[:, :, None], o3, ORDER_MAX), axis=2)
+    return q._replace(
+        t=new_t,
+        order=new_order,
+        bt=jnp.where(touched, nbt, q.bt),
+        bo=jnp.where(touched, nbo, q.bo),
+        bfill=q.bfill - jnp.sum(cleared3.astype(jnp.int32), axis=2),
+    )
+
+
+def _push_fields(push):
+    """(mask, t, order, kind, payload, reserve|None): pushes are 5-tuples;
+    the K-way microstep appends a 6th element — a per-host i32 RESERVE of
+    free slots spoken for by already-popped batch events that executed
+    after this push's event (in K=1 they were still sitting in the queue
+    when the push landed, so the push must not be allowed to use their
+    space — that is what keeps drop decisions bit-identical to K=1)."""
+    mask, t, order, kind, payload = push[:5]
+    reserve = push[5] if len(push) > 5 else None
+    return mask, t, order, kind, payload, reserve
+
+
 def push_many(q: EventQueue, pushes) -> EventQueue:
     """Push up to len(pushes) events per host in ONE pass over the slab.
 
-    `pushes` is a sequence of (mask, t, order, kind, payload) tuples (arrays
-    as in `push_one`). Semantics are identical to calling `push_one` in
-    sequence — push k lands in the k-th free slot counting only earlier
-    pushes that fired — but the slab is read and written once: sequential
-    `push_one` calls each carry an argmax reduction that fences XLA fusion,
-    so k pushes cost k full [H, C] memory passes; here the free-rank cumsum
-    is computed once and every push is an elementwise one-hot on top of it
-    (measured as the dominant per-microstep cost at 10k hosts x capacity 64).
+    `pushes` is a sequence of (mask, t, order, kind, payload[, reserve])
+    tuples (arrays as in `push_one`; `reserve` is the K-way microstep's
+    capacity hold, see `_push_fields`). Semantics are identical to calling
+    `push_one` in sequence — push k lands in the k-th free slot counting
+    only earlier pushes that fired — but the slab is read and written once:
+    sequential `push_one` calls each carry an argmax reduction that fences
+    XLA fusion, so k pushes cost k full [H, C] memory passes; here the
+    free-rank cumsum is computed once and every push is an elementwise
+    one-hot on top of it (measured as the dominant per-microstep cost at
+    10k hosts x capacity 64).
     """
     free = q.t == TIME_MAX  # [H, C]
     free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1  # [H, C]
@@ -193,8 +358,10 @@ def push_many(q: EventQueue, pushes) -> EventQueue:
     need = jnp.zeros((h,), jnp.int32)  # free slots consumed by earlier pushes
     new_t, new_order, new_kind, new_payload = q.t, q.order, q.kind, q.payload
     dropped = q.dropped
-    for mask, t, order, kind, payload in pushes:
-        ok = mask & (need < free_count)
+    for push in pushes:
+        mask, t, order, kind, payload, reserve = _push_fields(push)
+        avail = free_count if reserve is None else free_count - reserve
+        ok = mask & (need < avail)
         oh = ok[:, None] & free & (free_rank == need[:, None])
         new_t = jnp.where(oh, jnp.asarray(t, jnp.int64)[:, None], new_t)
         new_order = jnp.where(
@@ -484,9 +651,17 @@ def bq_push_many(
     new_t, new_order, new_kind, new_payload = q.t, q.order, q.kind, q.payload
     bt, bo, bfill = q.bt, q.bo, q.bfill
     dropped = q.dropped
-    for mask, t, order, kind, payload in pushes:
+    for push in pushes:
+        mask, t, order, kind, payload, reserve = _push_fields(push)
         not_full = bfill < b  # [H, NB] running occupancy
-        ok = mask & jnp.any(not_full, axis=1)
+        if reserve is None:
+            ok = mask & jnp.any(not_full, axis=1)
+        else:
+            # reserved slots (see _push_fields) shrink the RUNNING free
+            # total; b*nb - sum(bfill) == original free - successes so far,
+            # so this is exactly the flat op's `need + reserve < free_count`
+            free_running = b * nb - jnp.sum(bfill, axis=1)
+            ok = mask & (free_running > reserve)
         if cpu:
             tb = jnp.argmax(not_full, axis=1)  # first not-full block
             blk = new_t.reshape(h, nb, b)[hh, tb]  # [H, B] current slots
@@ -543,3 +718,13 @@ def q_pop_min(q, limit):
 
 def q_push_many(q, pushes):
     return bq_push_many(q, pushes) if isinstance(q, BucketQueue) else push_many(q, pushes)
+
+
+def q_pop_k(q, limit, k: int) -> PoppedK:
+    """K-way peek for either queue type (`pop_k` reads through the flat
+    planes; the bucketed caches are maintained at `q_clear_popped`)."""
+    return pop_k(q, limit, k)
+
+
+def q_clear_popped(q, popped: PoppedK, m):
+    return clear_popped(q, popped, m)

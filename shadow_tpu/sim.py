@@ -379,6 +379,7 @@ class Simulation:
             max_round_inserts=ex.max_round_inserts or qcap,
             rounds_per_chunk=rpc,
             microstep_limit=ex.microstep_limit,
+            microstep_events=ex.microstep_events,
             world=world,
             # exact elision: with no bandwidth limits anywhere, token buckets
             # and CoDel are provable no-ops (see EngineConfig.shaping)
@@ -387,7 +388,7 @@ class Simulation:
             ),
             cheap_shed=ex.overflow_shed == "append",
             cpu_delay_ns=ex.cpu_delay,
-            exchange=ex.exchange,
+            exchange=ex.resolve_exchange(world),
             a2a_block=ex.a2a_block,
             merge_rows=ex.merge_rows,
         )
@@ -492,10 +493,18 @@ class Simulation:
             wall = time.monotonic() - t0
             if hb_ns and now_ns >= next_hb:
                 ev = int(np.asarray(self.state.stats.events).sum())
+                # event-density telemetry (the K-way microstep's target
+                # quantities): microsteps per round is how serialized the
+                # round loop is, events per microstep is how well the
+                # K-fold amortizes — the same two numbers bench.py tracks
+                msteps = int(np.asarray(self.state.stats.microsteps).sum())
+                rounds = int(self.state.stats.rounds)
                 print(
                     f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s events={ev} "
-                    f"rounds={int(self.state.stats.rounds)} "
+                    f"rounds={rounds} "
+                    f"msteps/round={msteps / max(rounds, 1):.1f} "
+                    f"ev/mstep={ev / max(msteps, 1):.2f} "
                     f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                     f"{resource_heartbeat()}",
                     file=log,
@@ -613,6 +622,8 @@ class Simulation:
             "packets_budget_dropped": int(s.pkts_budget_dropped[:n].sum()),
             "outbox_overflow_dropped": int(np.asarray(s.ob_dropped).sum()),
             "bucket_cache_rebuilds": int(np.asarray(s.bq_rebuilds).sum()),
+            "popk_deferred": int(np.asarray(s.popk_deferred).sum()),
+            "ici_bytes": int(np.asarray(s.ici_bytes).sum()),
             "monotonic_violations": int(s.monotonic_violations[:n].sum()),
             "determinism_digest": f"{int(np.bitwise_xor.reduce(s.digest[:n])):016x}",
             "model_report": self.model.report(

@@ -29,6 +29,7 @@ def build_sim(
     jitter: int = 0,
     exchange: str = "gather",
     queue_block: int = 0,
+    microstep_events: int = 1,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -49,6 +50,7 @@ def build_sim(
         cpu_delay_ns=cpu_delay_ns,
         use_jitter=jitter > 0,
         exchange=exchange,
+        microstep_events=microstep_events,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)

@@ -1,0 +1,388 @@
+"""K-way microstep pop: equivalence gate (`experimental.microstep_events`).
+
+The contract (ops/events.py `pop_k`/`clear_popped` + core/engine.py
+`_microstep_k`) is *bit-identical behavior* to the single-event microstep —
+same execution order, digests, per-host event counts, and drop counters —
+with up to K events per host folded through one queue dispatch. These tests
+are the determinism gate for that claim:
+
+  1. a per-op property test drives `pop_k` against K sequential `q_pop_min`
+     calls on randomly occupied queues (flat AND bucketed, both backend
+     formulations), including partial-prefix clears and the bucketed
+     block-min invariant after every clear;
+  2. a reserve property test: the K-way push pass's capacity holds
+     reproduce sequential push_one drop decisions exactly;
+  3. engine-level digest equality for K in {1, 4, 8} on echo, phold, and
+     tgen workloads — phold tuned so pushed jobs mature INSIDE the window
+     (bursty in-window pushes), which forces the deferral guard to fire
+     (asserted via stats.popk_deferred > 0) while histories stay identical;
+  4. a checkpoint round-trip with K > 1 resumes to the same digest, and a
+     checkpoint written under a different K refuses to restore.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.ops import (
+    as_flat,
+    block_minima,
+    bucket_rebuild,
+    clear_popped,
+    make_bucket_queue,
+    make_queue,
+    pack_order,
+    pop_k,
+    pop_min,
+    push_many,
+    bq_push_many,
+    q_pop_min,
+)
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+from shadow_tpu.simtime import TIME_MAX
+
+from tests.engine_harness import mk_hosts, build_sim
+
+
+def _random_queue(rng, hh, cc, bucket_block=0, fill_p=0.6):
+    """A queue with random occupancy, unique order keys, random times."""
+    q = make_bucket_queue(hh, cc, bucket_block) if bucket_block else make_queue(hh, cc)
+    push = bq_push_many if bucket_block else push_many
+    seq = 0
+    for _ in range(3):
+        pushes = []
+        for _ in range(3):
+            mask = jnp.asarray(rng.random(hh) < fill_p)
+            t = jnp.asarray(rng.integers(1, 1000, hh), jnp.int64)
+            order = jnp.asarray(
+                [int(pack_order(1, i, seq + 11 * i)) for i in range(hh)],
+                jnp.int64,
+            )
+            seq += 1
+            kind = jnp.asarray(rng.integers(0, 5, hh), jnp.int32)
+            payload = jnp.asarray(
+                rng.integers(0, 99, (hh, EVENT_PAYLOAD_WORDS)), jnp.int32
+            )
+            pushes.append((mask, t, order, kind, payload))
+        q = push(q, pushes)
+    return q
+
+
+# ------------------------------------------------------------------ property
+
+
+@pytest.mark.parametrize("path", ["gather", "onehot"])
+@pytest.mark.parametrize("block", [0, 2, 4])
+@pytest.mark.parametrize("k", [1, 3, 8])
+def test_pop_k_equals_sequential_pop_min(k, block, path):
+    """Column j of `pop_k` must equal the j-th successive `q_pop_min`
+    (events AND active masks), for scalar and per-host limits, and clearing
+    the full active prefix must leave the identical slab — flat and
+    bucketed, both extraction formulations, K from degenerate 1 to
+    capacity."""
+    hh, cc = 7, 8
+    rng = np.random.default_rng(1000 * k + 10 * block + (path == "onehot"))
+    for limit in (TIME_MAX, 500, jnp.asarray(rng.integers(1, 1000, hh), jnp.int64)):
+        q = _random_queue(rng, hh, cc, bucket_block=block)
+        popped = pop_k(q, limit, k, force_path=path)
+        ref = q
+        for j in range(k):
+            ref, ev, act = q_pop_min(ref, limit)
+            msg = f"k={k} block={block} path={path} col {j}"
+            np.testing.assert_array_equal(
+                np.asarray(act), np.asarray(popped.active[:, j]), err_msg=msg
+            )
+            for fa, fb, name in zip(
+                ev, (popped.t[:, j], popped.order[:, j], popped.kind[:, j],
+                     popped.payload[:, j]), ev._fields,
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(fa), np.asarray(fb), err_msg=f"ev.{name} {msg}"
+                )
+        m = jnp.sum(popped.active.astype(jnp.int32), axis=1)
+        cleared = clear_popped(q, popped, m)
+        np.testing.assert_array_equal(
+            np.asarray(as_flat(cleared).t), np.asarray(as_flat(ref).t)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(as_flat(cleared).order), np.asarray(as_flat(ref).order)
+        )
+        if block:
+            bt, bo, bfill = block_minima(
+                cleared.t, cleared.order, cleared.bt.shape[1]
+            )
+            np.testing.assert_array_equal(np.asarray(cleared.bt), np.asarray(bt))
+            np.testing.assert_array_equal(np.asarray(cleared.bo), np.asarray(bo))
+            np.testing.assert_array_equal(
+                np.asarray(cleared.bfill), np.asarray(bfill)
+            )
+
+
+@pytest.mark.parametrize("block", [0, 4])
+def test_clear_popped_partial_prefix(block):
+    """Clearing only the first m events (the K-way deferral case) must
+    equal m sequential pops — deferred events stay in the slab untouched
+    and the bucketed caches stay coherent."""
+    hh, cc, k = 5, 8, 6
+    rng = np.random.default_rng(7 + block)
+    q = _random_queue(rng, hh, cc, bucket_block=block, fill_p=0.9)
+    popped = pop_k(q, TIME_MAX, k)
+    m = jnp.asarray(rng.integers(0, k + 1, hh), jnp.int32)
+    m = jnp.minimum(m, jnp.sum(popped.active.astype(jnp.int32), axis=1))
+    cleared = clear_popped(q, popped, m)
+    ref = q
+    m_np = np.asarray(m)
+    for j in range(k):
+        refn, _, _ = q_pop_min(ref, TIME_MAX)
+        # apply the j-th pop only on hosts whose prefix reaches past j
+        take = jnp.asarray(m_np > j)
+        # the per-host where() desyncs nothing: pops are row-local, so
+        # masking whole rows keeps each row (slab AND caches) consistent
+        ref = jax.tree.map(
+            lambda new, old: jnp.where(
+                take.reshape((hh,) + (1,) * (new.ndim - 1)), new, old
+            ),
+            refn, ref,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(as_flat(cleared).t), np.asarray(as_flat(ref).t)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(as_flat(cleared).order), np.asarray(as_flat(ref).order)
+    )
+    if block:
+        bt, bo, bfill = block_minima(cleared.t, cleared.order, cleared.bt.shape[1])
+        np.testing.assert_array_equal(np.asarray(cleared.bt), np.asarray(bt))
+        np.testing.assert_array_equal(np.asarray(cleared.bo), np.asarray(bo))
+        np.testing.assert_array_equal(np.asarray(cleared.bfill), np.asarray(bfill))
+
+
+@pytest.mark.parametrize("bucket", [False, True])
+def test_push_reserve_reproduces_sequential_drops(bucket):
+    """The K-way fold's reserve (6th push-tuple element) must reproduce the
+    K=1 drop decisions: a push sees free capacity minus the batch events
+    that executed after it. Scenario: capacity 4, host holds 4 events, the
+    first executed event pushes 2 — in K=1 the second push drops (only one
+    slot was free then); a reserve-less fused pass would let it through."""
+    hh, cc = 2, 4
+    q = make_bucket_queue(hh, cc, 2) if bucket else make_queue(hh, cc)
+    push = bq_push_many if bucket else push_many
+    ones = jnp.ones((hh,), bool)
+    fills = []
+    for s in range(4):
+        fills.append((
+            ones, jnp.full((hh,), 10 * (s + 1), jnp.int64),
+            jnp.asarray([int(pack_order(1, i, s)) for i in range(hh)], jnp.int64),
+            jnp.ones((hh,), jnp.int32),
+            jnp.zeros((hh, EVENT_PAYLOAD_WORDS), jnp.int32),
+        ))
+    q = push(q, fills)  # full queue
+    popped = pop_k(q, TIME_MAX, 4)
+    # all 4 events execute; event 0 emits two pushes -> reserves are 3
+    m = jnp.full((hh,), 4, jnp.int32)
+    q = clear_popped(q, popped, m)
+    reserve = jnp.full((hh,), 3, jnp.int32)  # events 1..3 executed after 0
+    p1 = (ones, jnp.full((hh,), 100, jnp.int64),
+          jnp.asarray([int(pack_order(1, i, 10)) for i in range(hh)], jnp.int64),
+          jnp.ones((hh,), jnp.int32),
+          jnp.zeros((hh, EVENT_PAYLOAD_WORDS), jnp.int32), reserve)
+    p2 = (ones, jnp.full((hh,), 101, jnp.int64),
+          jnp.asarray([int(pack_order(1, i, 11)) for i in range(hh)], jnp.int64),
+          jnp.ones((hh,), jnp.int32),
+          jnp.zeros((hh, EVENT_PAYLOAD_WORDS), jnp.int32), reserve)
+    q2 = push(q, [p1, p2])
+    # K=1 ground truth: when event 0 pushed, events 1-3 still held slots,
+    # so exactly ONE free slot existed: p1 lands, p2 drops.
+    assert int(np.asarray(as_flat(q2).t == 100).sum()) == hh, "p1 must land"
+    assert int(np.asarray(as_flat(q2).t == 101).sum()) == 0, "p2 must drop"
+    np.testing.assert_array_equal(np.asarray(q2.dropped), np.full(hh, 1))
+    if bucket:
+        bt, bo, bfill = block_minima(q2.t, q2.order, q2.bt.shape[1])
+        np.testing.assert_array_equal(np.asarray(q2.bt), np.asarray(bt))
+        np.testing.assert_array_equal(np.asarray(q2.bfill), np.asarray(bfill))
+
+
+# ------------------------------------------------------- engine determinism
+
+
+def _run(model, hosts, stop, k, qb=0, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, queue_block=qb,
+        microstep_events=k, **kw
+    )
+    from shadow_tpu.core import Engine
+
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return jax.device_get(state.stats), np.asarray(
+        jax.device_get(state.queue.dropped)
+    )
+
+
+# phold with pushes maturing INSIDE the 50 ms window (mean_delay 20 ms):
+# the deferral guard must fire (a matured job's key precedes the next
+# batch event) and histories must stay identical anyway
+_CASES = [
+    ("phold", mk_hosts(10, {"mean_delay": "20 ms", "population": 3}),
+     400_000_000, dict(loss=0.1)),
+    ("udp_echo",
+     [dict(host_id=0, name="server", start_time=0,
+           model_args={"role": "server"})]
+     + [dict(host_id=i, name=f"c{i}", start_time=0,
+             model_args={"role": "client", "peer": "server",
+                         "interval": "4 ms", "size_bytes": 2000})
+        for i in range(1, 5)],
+     300_000_000, dict(bw_bits=2_000_000, loss=0.05, use_codel=True)),
+    ("tgen_tcp",
+     mk_hosts(6, {"flow_segs": 12, "flows": 1, "cwnd_cap": 8,
+                  "rto_min": "100 ms"}),
+     4_000_000_000, dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+]
+
+
+@pytest.mark.parametrize(
+    "model,hosts,stop,kw", _CASES, ids=["phold_bursty", "echo", "tgen_tcp"]
+)
+def test_engine_digest_k1_vs_kway(model, hosts, stop, kw):
+    """The ISSUE acceptance gate: digests, per-host event counts, and drop
+    counters bit-identical between K=1 and K in {4, 8}, flat queue."""
+    s1, d1 = _run(model, hosts, stop, 1, **kw)
+    deferred_any = 0
+    for k in (4, 8):
+        sk, dk = _run(model, hosts, stop, k, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(s1.digest), np.asarray(sk.digest),
+            err_msg=f"{model} K={k}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s1.events), np.asarray(sk.events),
+            err_msg=f"{model} K={k} per-host events",
+        )
+        np.testing.assert_array_equal(d1, dk, err_msg=f"{model} K={k} drops")
+        assert int(np.asarray(s1.pkts_budget_dropped).sum()) == int(
+            np.asarray(sk.pkts_budget_dropped).sum()
+        )
+        # the fold actually folded: fewer dispatches for the same events
+        assert int(np.asarray(sk.microsteps).sum()) <= int(
+            np.asarray(s1.microsteps).sum()
+        )
+        deferred_any += int(np.asarray(sk.popk_deferred).sum())
+    if model == "phold":  # the bursty-push case MUST exercise the guard
+        assert deferred_any > 0, "deferral guard never fired on bursty phold"
+
+
+def test_engine_digest_kway_bucketed():
+    """K-way fold on the two-level bucketed queue (victim-block cache
+    recompute path): digest-identical to flat K=1 on the tgen workload."""
+    model, hosts, stop, kw = _CASES[2]
+    s1, d1 = _run(model, hosts, stop, 1, **kw)
+    sk, dk = _run(model, hosts, stop, 4, qb=8, **kw)
+    np.testing.assert_array_equal(np.asarray(s1.digest), np.asarray(sk.digest))
+    np.testing.assert_array_equal(d1, dk)
+    assert int(np.asarray(sk.bq_rebuilds).sum()) > 0  # two-level path ran
+
+
+def test_kway_mesh_invariant():
+    """K-way folding is shard-local (no collectives inside the microstep
+    loop), so digests must stay bit-identical across mesh shapes — and
+    equal to the single-device K=1 run."""
+    from shadow_tpu.core import Engine
+    import jax as _jax
+
+    hosts = mk_hosts(16, {"mean_delay": "20 ms", "population": 2})
+
+    def run_world(world, k):
+        cfg, m, params, mstate, events = build_sim(
+            "phold", hosts, 300_000_000, world=world, loss=0.1,
+            microstep_events=k,
+        )
+        mesh = None
+        if world > 1:
+            mesh = _jax.sharding.Mesh(
+                np.array(_jax.devices()[:world]), ("hosts",)
+            )
+        eng = Engine(cfg, m, mesh)
+        state, params = eng.init_state(params, mstate, events, seed=1)
+        chunks = 0
+        while not bool(state.done):
+            state = eng.run_chunk(state, params)
+            chunks += 1
+            assert chunks < 500
+        return np.asarray(jax.device_get(state.stats.digest))
+
+    base = run_world(1, 1)
+    np.testing.assert_array_equal(base, run_world(1, 4))
+    np.testing.assert_array_equal(base, run_world(4, 4))
+
+
+def test_kway_with_cpu_model():
+    """CPU-delay deferral: a batch stops folding when the host's busy
+    horizon crosses the window (K=1 would stop popping), keeping the
+    busy-shifted execution times bit-identical."""
+    hosts = mk_hosts(8, {"mean_delay": "30 ms", "population": 3})
+    s1, d1 = _run("phold", hosts, 300_000_000, 1, cpu_delay_ns=2_000_000)
+    s4, d4 = _run("phold", hosts, 300_000_000, 4, cpu_delay_ns=2_000_000)
+    np.testing.assert_array_equal(np.asarray(s1.digest), np.asarray(s4.digest))
+    np.testing.assert_array_equal(d1, d4)
+
+
+# ----------------------------------------------------------------- restore
+
+
+def test_checkpoint_roundtrip_kway(tmp_path):
+    """A K>1 sim checkpointed mid-run resumes to the digest of an
+    uninterrupted run; a checkpoint written under a different K refuses
+    (EngineConfig participates in the fingerprint)."""
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.core.checkpoint import (
+        CheckpointError,
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from shadow_tpu.sim import Simulation
+
+    def cfg(k=4):
+        return ConfigOptions.from_dict({
+            "general": {"stop_time": "4 s", "seed": 23},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "experimental": {"event_queue_capacity": 16,
+                             "microstep_events": k},
+            "hosts": {
+                "n": {
+                    "count": 8,
+                    "network_node_id": 0,
+                    "processes": [{
+                        "model": "phold",
+                        "model_args": {"population": 2,
+                                       "mean_delay": "100 ms"},
+                    }],
+                }
+            },
+        })
+
+    a = Simulation(cfg(), world=1)
+    a.run(progress=False)
+    digest_a = a.stats_report()["determinism_digest"]
+
+    b = Simulation(cfg(), world=1)
+    b.state = b.engine.run_chunk(b.state, b.params)
+    assert not bool(b.state.done)
+    ckpt = str(tmp_path / "popk.npz")
+    save_checkpoint(ckpt, b)
+
+    c = Simulation(cfg(), world=1)
+    load_checkpoint(ckpt, c)
+    c.run(progress=False)
+    assert c.stats_report()["determinism_digest"] == digest_a
+
+    d = Simulation(cfg(k=2), world=1)  # different K: refuse loudly
+    with pytest.raises(CheckpointError):
+        load_checkpoint(ckpt, d)

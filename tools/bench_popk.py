@@ -1,0 +1,222 @@
+"""Microbench: K-way microstep pop+fold vs K single-event pop+push pairs.
+
+Two legs, same harness shape as tools/bench_bucketq.py:
+
+  1. **pop+fold pair** — the engine's per-microstep queue work. The K=1
+     unit is `q_pop_min` + `q_push_many`(1 push); the K-way unit is
+     `q_pop_k` + `clear_popped` + ONE `q_push_many` with K reserve-tagged
+     pushes (exactly the `_microstep_k` queue sequence). Both run as
+     jitted `lax.fori_loop`s processing the SAME number of events
+     (steps x K singles vs steps K-folds), so the printed ratio is the
+     pure queue-side amortization of folding K events into one slab
+     round-trip. Swept over K x queue_block.
+
+     Equivalence check: each host's final event multiset (time-sorted
+     rows) must match the K=1 reference. Slot POSITIONS legitimately
+     differ (K pushes fill freed slots in one pass instead of one at a
+     time) and are not observable — full behavioral equality (digests,
+     drops, order) is pinned at the engine level by tests/test_popk.py.
+     The bench seeds fill=K so batches never span reschedule generations
+     and the unguarded fold stays exact (the engine's deferral guard is
+     engine logic, not queue logic).
+
+  2. **small tgen end-to-end** (--e2e) — bench.py's config-6 workload at
+     the --small scale, swept over microstep_events x event_queue_block,
+     reporting sim-s/wall-s so the K that wins the microbench can be
+     sanity-checked against real engine rounds before wiring it into the
+     bench config.
+
+    python tools/bench_popk.py [--hosts 10000] [--cap 64] [--steps 16]
+                               [--reps 3] [--ks 1,2,4,8] [--blocks 0,8]
+                               [--e2e]
+"""
+
+import argparse
+import pathlib
+import sys
+import time
+
+_HERE = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(_HERE.parent))
+sys.path.insert(0, str(_HERE))
+import shadow_tpu  # noqa: F401  (enables x64)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bench_bucketq import seed_slab
+from shadow_tpu.ops import (
+    bucket_rebuild,
+    clear_popped,
+    pop_k,
+    q_pop_min,
+    q_push_many,
+)
+from shadow_tpu.ops.events import pack_order
+
+DELTA_NS = 2_000_000_000  # > the seeded time range: batches never mix
+# reschedule generations, so the unguarded fold is exact (see module doc)
+
+
+def make_single_stepper(h: int, steps: int):
+    hosts = jnp.arange(h, dtype=jnp.int64)
+
+    def body(_, carry):
+        q, seq = carry
+        q, ev, active = q_pop_min(q, jnp.int64(1) << 62)
+        order = jax.vmap(pack_order, in_axes=(None, 0, 0))(1, hosts, seq)
+        q = q_push_many(
+            q, [(active, ev.t + DELTA_NS, order, ev.kind, ev.payload)]
+        )
+        return q, seq + active.astype(jnp.int64)
+
+    return jax.jit(lambda q, seq: lax.fori_loop(0, steps, body, (q, seq)))
+
+
+def make_kway_stepper(h: int, steps: int, k: int):
+    hosts = jnp.arange(h, dtype=jnp.int64)
+
+    def body(_, carry):
+        q, seq = carry
+        popped = pop_k(q, jnp.int64(1) << 62, k)
+        m = jnp.sum(popped.active.astype(jnp.int32), axis=1)
+        q = clear_popped(q, popped, m)
+        pushes = []
+        for j in range(k):
+            act = popped.active[:, j]
+            order = jax.vmap(pack_order, in_axes=(None, 0, 0))(1, hosts, seq)
+            seq = seq + act.astype(jnp.int64)
+            # reserve = later batch events, as _microstep_k would tag it
+            reserve = jnp.sum(
+                popped.active[:, j + 1 :].astype(jnp.int32), axis=1
+            )
+            pushes.append((
+                act, popped.t[:, j] + DELTA_NS, order,
+                popped.kind[:, j], popped.payload[:, j], reserve,
+            ))
+        q = q_push_many(q, pushes)
+        return q, seq
+
+    return jax.jit(lambda q, seq: lax.fori_loop(0, steps, body, (q, seq)))
+
+
+def timed(fn, q0, seq0, reps: int):
+    out = fn(q0, seq0)  # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = fn(q0, seq0)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / reps, out
+
+
+def sweep_pair(args):
+    h, c = args.hosts, args.cap
+    ks = [int(x) for x in args.ks.split(",")]
+    blocks = [int(b) for b in args.blocks.split(",")]
+    print(
+        f"backend={jax.default_backend()} H={h} C={c} steps={args.steps} "
+        f"reps={args.reps} (events per leg = steps x K x H)"
+    )
+    for k in ks:
+        fill = min(k, c)
+        flat0 = seed_slab(h, c, fill)
+        seq0 = jnp.full((h,), fill, jnp.int64)
+        single = make_single_stepper(h, args.steps * k)
+        t_one, (q_ref, _) = timed(single, flat0, seq0, args.reps)
+        ref_sorted = np.sort(np.asarray(q_ref.t), axis=1)
+        per_one = t_one / (args.steps * k) * 1e3
+        print(f"K={k:2d} singles : {per_one:8.4f} ms/event  "
+              f"({t_one * 1e3:8.1f} ms)")
+        if k == 1:
+            continue
+        for b in blocks:
+            if b and c % b:
+                continue
+            q0 = bucket_rebuild(flat0, b) if b else flat0
+            fold = make_kway_stepper(h, args.steps, k)
+            t_k, (q_k, _) = timed(fold, q0, seq0, args.reps)
+            per_k = t_k / (args.steps * k) * 1e3
+            same = bool(
+                np.array_equal(np.sort(np.asarray(q_k.t), axis=1), ref_sorted)
+            )
+            print(
+                f"K={k:2d} fold B={b:3d}: {per_k:8.4f} ms/event  "
+                f"speedup x{t_one / t_k:5.2f}  events==K1: {same}"
+            )
+            if not same:
+                raise SystemExit(f"K={k} B={b}: fold diverged from singles")
+
+
+def sweep_e2e(args):
+    """Small tgen-TCP end-to-end (bench.py config 6, --small scale)."""
+    from bench import baseline_config
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.sim import Simulation
+
+    ks = [int(x) for x in args.ks.split(",")]
+    blocks = [int(b) for b in args.blocks.split(",")]
+    for k in ks:
+        for b in blocks:
+            cfg_dict, _, _ = baseline_config(6, small=True)
+            cfg_dict["general"]["stop_time"] = "20 s"
+            cfg_dict["experimental"]["microstep_events"] = k
+            cfg_dict["experimental"]["event_queue_block"] = b
+            cap = cfg_dict["experimental"]["event_queue_capacity"]
+            if b and cap % b:
+                continue
+            sim = Simulation(ConfigOptions.from_dict(cfg_dict), world=1)
+            state, params, engine = sim.state, sim.params, sim.engine
+            state = engine.run_chunk(state, params)  # compile chunk
+            jax.block_until_ready(state)
+            sim0 = int(state.now)
+            t0 = time.monotonic()
+            if bool(state.done):
+                # whole sim fit in the compile chunk: rebuild fresh state
+                # and drive it with the ALREADY-COMPILED engine (bench.py's
+                # clean-run pattern — a new Engine would recompile)
+                state = Simulation(
+                    ConfigOptions.from_dict(cfg_dict), world=1
+                ).state
+                sim0 = 0
+                t0 = time.monotonic()
+            while not bool(state.done):
+                state = engine.run_chunk(state, params)
+                jax.block_until_ready(state)
+                if time.monotonic() - t0 > args.e2e_budget:
+                    break
+            wall = max(time.monotonic() - t0, 1e-9)
+            s = jax.device_get(state.stats)
+            msteps = int(np.asarray(s.microsteps).sum())
+            rounds = max(int(s.rounds), 1)
+            ev = int(np.asarray(s.events).sum())
+            print(
+                f"e2e K={k:2d} B={b:3d}: "
+                f"{(int(state.now) - sim0) / 1e9 / wall:7.3f} sim_s/wall_s  "
+                f"msteps/round={msteps / rounds:5.1f} "
+                f"ev/mstep={ev / max(msteps, 1):5.2f} "
+                f"digest={int(np.bitwise_xor.reduce(s.digest)):016x}"
+            )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=10_000)
+    ap.add_argument("--cap", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--ks", default="1,2,4,8")
+    ap.add_argument("--blocks", default="0,8")
+    ap.add_argument("--e2e", action="store_true")
+    ap.add_argument("--e2e-budget", type=float, default=60.0)
+    args = ap.parse_args()
+    if args.e2e:
+        sweep_e2e(args)
+    else:
+        sweep_pair(args)
+
+
+if __name__ == "__main__":
+    main()

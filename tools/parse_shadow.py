@@ -20,6 +20,8 @@ import sys
 HEARTBEAT_RE = re.compile(
     r"\[heartbeat\] sim_time=(?P<sim>[\d.]+)s wall=(?P<wall>[\d.]+)s "
     r"(?:events=(?P<events>\d+) )?(?:rounds=(?P<rounds>\d+) |windows=(?P<windows>\d+) )?"
+    r"(?:msteps/round=(?P<msteps_per_round>[\d.]+) )?"
+    r"(?:ev/mstep=(?P<ev_per_mstep>[\d.]+) )?"
     r"ratio=(?P<ratio>[\d.]+)x"
     r"(?: rss_gib=(?P<rss_gib>[\d.]+))?"
     r"(?: utime_min=(?P<utime_min>[\d.]+))?"
