@@ -343,22 +343,39 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     from shadow_tpu.config.options import ConfigOptions
     from shadow_tpu.sim import Simulation
 
+    from shadow_tpu.obs import RoundTracer
+
     cfg_dict, metric, stop_s = baseline_config(n, small)
+    # the round tracer rides along (PR 3 observability): digests and event
+    # counts are bit-identical with it on (tests/test_tracer.py), and the
+    # drained ring hands future perf PRs the per-round decomposition the
+    # first two PRs had to reconstruct by hand (BASELINE.md r5/r6).
+    # Measurement note: tracing is now part of the measured configuration
+    # (BENCH rows from this round on include it). Its cost inside the wall
+    # window is one extra row write per round in-jit plus a per-chunk
+    # device_get of the [1, R, 12] i64 ring (~tens of KB against a
+    # multi-second 256-512-round chunk; the block_until_ready was already
+    # there) — well under the run-to-run noise floor.
+    cfg_dict.setdefault("observability", {})["trace"] = True
     cfg = ConfigOptions.from_dict(cfg_dict)
     t_build = time.monotonic()
     sim = Simulation(cfg, world=1)
     state, params, engine = sim.state, sim.params, sim.engine
+    tracer = RoundTracer(sim.engine_cfg.rounds_per_chunk)
     t0 = time.monotonic()
     build_s = t0 - t_build  # capture BEFORE t0 is reused for measurement
     state = engine.run_chunk(state, params)  # compile + first chunk
     jax.block_until_ready(state)
     compile_s = time.monotonic() - t0
+    tracer.drain(state.trace, wall_t0=t0, wall_t1=time.monotonic())
     sim0 = int(state.now)
     ev0 = int(jax.device_get(state.stats.events).sum())
     t0 = time.monotonic()
     while not bool(state.done):
+        t_c = time.monotonic()
         state = engine.run_chunk(state, params)
         jax.block_until_ready(state)
+        tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
         if time.monotonic() - t0 >= wall_budget_s:
             break
     wall = max(time.monotonic() - t0, 1e-9)
@@ -372,10 +389,13 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         # this branch exists to exclude)
         sim2 = Simulation(cfg, world=1)
         state = sim2.state
+        tracer = RoundTracer(sim.engine_cfg.rounds_per_chunk)  # fresh cursor
         t0 = time.monotonic()
         while not bool(state.done):
+            t_c = time.monotonic()
             state = engine.run_chunk(state, params)
             jax.block_until_ready(state)
+            tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
         wall = max(time.monotonic() - t0, 1e-9)
         sim_adv = int(state.now) / 1e9
         ev_adv = int(jax.device_get(state.stats.events).sum())
@@ -397,6 +417,18 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         "events": ev_adv,
         "microsteps_per_round": round(msteps / max(rounds, 1), 2),
         "events_per_microstep": round(events_total / max(msteps, 1), 2),
+        # counters snapshot (PR 3): the decomposition future perf PRs read
+        # straight from the BENCH row instead of re-deriving by hand —
+        # rounds_per_chunk comes from the drained trace ring (wall-paired
+        # chunk records), the rest from the device counters
+        "counters": {
+            "rounds": rounds,
+            "ici_bytes": int(_np.asarray(s.ici_bytes).sum()),
+            "bq_rebuilds": int(_np.asarray(s.bq_rebuilds).sum()),
+            "popk_deferred": int(_np.asarray(s.popk_deferred).sum()),
+            "queue_occupancy_hwm": int(_np.asarray(s.q_occ_hwm).max()),
+            "rounds_per_chunk": tracer.summary()["rounds_per_chunk"],
+        },
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
     }
@@ -510,6 +542,7 @@ def main() -> int:
                 "events": res.get("events"),
                 "microsteps_per_round": res.get("microsteps_per_round"),
                 "events_per_microstep": res.get("events_per_microstep"),
+                "counters": res.get("counters"),
                 "phold_10k_sim_s_per_wall_s": phold,
             }
         )

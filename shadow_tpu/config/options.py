@@ -343,6 +343,57 @@ class ExperimentalOptions:
 
 
 @dataclass
+class ObservabilityOptions:
+    """The observability plane's knobs (no reference analogue — the
+    reference's trackers/heartbeats observe host-side state; here the
+    round loop runs inside jit, so tracing needs a device-resident ring,
+    obs/tracer.py + docs/architecture.md "Observability").
+
+    Everything here is an observer: enabling any knob leaves digests,
+    event counts, and drop counters bit-identical (tests/test_tracer.py
+    is the gate)."""
+
+    # device-resident round tracer: record one ring row per scheduling
+    # round inside the jitted loop, drain at chunk boundaries, export a
+    # Chrome-trace timeline + Prometheus metrics + sim-stats extensions
+    trace: bool = False
+    # export paths, relative to general.data_directory (written by
+    # write_outputs when trace is on); null skips that export
+    trace_file: str | None = "trace.json"  # Chrome-trace/Perfetto JSON
+    metrics_file: str | None = "metrics.prom"  # Prometheus text; None = off
+    # wrap the chunk-dispatch loop in jax.profiler.trace(profile_dir):
+    # XLA-level device profiles (xplane) land there, with the engine's
+    # jax.named_scope annotations (shadow_microsteps / shadow_exchange /
+    # shadow_merge) labeling the hot regions. None = off.
+    profile_dir: str | None = None
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "ObservabilityOptions":
+        d = dict(d or {})
+        o = ObservabilityOptions(
+            trace=bool(d.pop("trace", False)),
+            trace_file=d.pop("trace_file", "trace.json"),
+            metrics_file=d.pop("metrics_file", "metrics.prom"),
+            profile_dir=d.pop("profile_dir", None),
+        )
+        # null disables an export; a non-null value must be a usable path
+        # (str(None) would silently produce a file literally named "None")
+        for f in ("trace_file", "metrics_file", "profile_dir"):
+            v = getattr(o, f)
+            if v is not None:
+                v = str(v)
+                setattr(o, f, v)
+                if not v:
+                    raise ConfigError(
+                        f"observability.{f} must be non-empty (use null "
+                        f"to disable)"
+                    )
+        if d:
+            raise ConfigError(f"unknown observability options: {sorted(d)}")
+        return o
+
+
+@dataclass
 class ProcessOptions:
     """reference: ProcessOptions (configuration.rs:643).
 
@@ -494,6 +545,9 @@ class ConfigOptions:
     general: GeneralOptions = field(default_factory=GeneralOptions)
     network: NetworkOptions = field(default_factory=NetworkOptions)
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
+    observability: ObservabilityOptions = field(
+        default_factory=ObservabilityOptions
+    )
     host_option_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: list[HostOptions] = field(default_factory=list)
 
@@ -519,6 +573,9 @@ class ConfigOptions:
             general=GeneralOptions.from_dict(d.pop("general")),
             network=NetworkOptions.from_dict(d.pop("network", None)),
             experimental=ExperimentalOptions.from_dict(d.pop("experimental", None)),
+            observability=ObservabilityOptions.from_dict(
+                d.pop("observability", None)
+            ),
             host_option_defaults=defaults,
             hosts=hosts,
         )

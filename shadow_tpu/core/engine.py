@@ -75,10 +75,28 @@ from shadow_tpu.ops import (
     merge_flat_events,
     pack_order,
     q_clear_popped,
+    q_len,
     q_next_time,
     q_pop_k,
     q_pop_min,
     q_push_many,
+)
+from shadow_tpu.obs.tracer import (
+    COL_A2A_SHED,
+    COL_BQ_REBUILDS,
+    COL_EVENTS,
+    COL_ICI_BYTES,
+    COL_MICROSTEPS,
+    COL_NEXT_TIME,
+    COL_OCC_HWM,
+    COL_POPK_DEFERRED,
+    COL_ROUND,
+    COL_SENDS,
+    COL_WINDOW_END,
+    COL_WINDOW_START,
+    TRACE_COLS,
+    TraceRing,
+    make_trace_ring,
 )
 from shadow_tpu.ops.events import unpack_order_src
 from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
@@ -143,6 +161,11 @@ class Stats(NamedTuple):
     bq_rebuilds: Array  # i64[1] wholesale block-cache rebuilds (bucketed queue)
     popk_deferred: Array  # i64[1] K-way batch events peeked but deferred
     ici_bytes: Array  # i64[1] exchange-collective bytes moved per shard
+    # per-host queue-occupancy high-water mark, sampled once per round
+    # after the exchange merge (the post-merge peak — the fullest the slab
+    # gets before the next round's pops drain it). Pure observation: reads
+    # the queue, feeds nothing back (tracker.c's per-host gauges analogue).
+    q_occ_hwm: Array  # i64[H]
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
 
@@ -162,6 +185,12 @@ class SimState(NamedTuple):
     model: Any  # model state pytree
     outbox: Outbox
     stats: Stats
+    # device-resident round tracer (obs/tracer.py): None unless
+    # cfg.trace_rounds > 0. The ring is written inside the jitted round
+    # loop and drained by the driver at chunk boundaries; it observes the
+    # round's own values and feeds nothing back, so enabling it cannot
+    # change digests, events, or drop counters.
+    trace: Any = None  # TraceRing | None
 
 
 class EngineParams(NamedTuple):
@@ -279,6 +308,14 @@ class EngineConfig:
     # sorted position and count in queue.dropped. 0 = unbounded (the full
     # worst-case outbox, num_hosts * sends_per_host_round rows).
     merge_rows: int = 0
+    # Device-resident round tracer (observability.trace): capacity of the
+    # in-scan trace ring in rounds. 0 = off (no ring in the carry, no row
+    # writes — the traced program is byte-identical to before the tracer
+    # existed). The drivers size it to rounds_per_chunk so a drain per
+    # chunk can never wrap. Rows are observations of values each round
+    # already computes; scheduling never reads them, so digests, events,
+    # and drop counters are bit-identical on or off (tests/test_tracer.py).
+    trace_rounds: int = 0
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -312,6 +349,10 @@ class EngineConfig:
         if self.microstep_events < 1:
             raise ValueError(
                 f"microstep_events={self.microstep_events} must be >= 1"
+            )
+        if self.trace_rounds < 0:
+            raise ValueError(
+                f"trace_rounds={self.trace_rounds} must be >= 0 (0 = off)"
             )
 
     @property
@@ -390,6 +431,7 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         bq_rebuilds=jnp.zeros((cfg.world,), jnp.int64),
         popk_deferred=jnp.zeros((cfg.world,), jnp.int64),
         ici_bytes=jnp.zeros((cfg.world,), jnp.int64),
+        q_occ_hwm=zi(),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
         rounds=jnp.zeros((), jnp.int64),
     )
@@ -632,8 +674,13 @@ class Engine:
                 bq_rebuilds=sh,
                 popk_deferred=sh,
                 ici_bytes=sh,
+                q_occ_hwm=sh,
                 digest=sh,
                 rounds=rep,
+            ),
+            trace=(
+                TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
+                else None
             ),
         )
 
@@ -717,6 +764,11 @@ class Engine:
                 model=model_state,
                 outbox=_init_outbox(cfg),
                 stats=_init_stats(cfg),
+                trace=(
+                    make_trace_ring(cfg.world, cfg.trace_rounds)
+                    if cfg.trace_rounds
+                    else None
+                ),
             )
         if self.mesh is not None:
             state = jax.device_put(
@@ -875,11 +927,12 @@ def _window_step(
             )
             return stc, valve + executed.astype(jnp.int64), steps + 1
 
-        st_m, _, steps = lax.while_loop(
-            micro_cond,
-            micro_body,
-            (st, jnp.zeros((h_local,), jnp.int64), jnp.zeros((), jnp.int64)),
-        )
+        with jax.named_scope("shadow_microsteps"):
+            st_m, _, steps = lax.while_loop(
+                micro_cond,
+                micro_body,
+                (st, jnp.zeros((h_local,), jnp.int64), jnp.zeros((), jnp.int64)),
+            )
     else:
         def micro_cond(carry):
             stc, steps = carry
@@ -892,16 +945,23 @@ def _window_step(
             stc = _microstep(cfg, model, stc, params, host_gid, window_end)
             return stc, steps + 1
 
-        st_m, steps = lax.while_loop(
-            micro_cond, micro_body, (st, jnp.zeros((), jnp.int64))
-        )
+        with jax.named_scope("shadow_microsteps"):
+            st_m, steps = lax.while_loop(
+                micro_cond, micro_body, (st, jnp.zeros((), jnp.int64))
+            )
 
     # ---- 4: exchange staged packets across the mesh
-    st_x = _exchange(cfg, axis, st_m)
+    with jax.named_scope("shadow_exchange"):
+        st_x = _exchange(cfg, axis, st_m)
 
+    # queue-occupancy high-water, sampled at the post-merge peak (cheap:
+    # the bucketed queue reads its bfill caches; flat pays one [H, C]
+    # compare+sum per ROUND, noise next to the microsteps it follows)
+    occ = q_len(st_x.queue).astype(jnp.int64)
     stats = st_x.stats._replace(
         rounds=st_x.stats.rounds + jnp.where(done, 0, 1),
         microsteps=st_x.stats.microsteps + steps[None],
+        q_occ_hwm=jnp.maximum(st_x.stats.q_occ_hwm, occ),
     )
     min_used = _pmin(st_x.min_used_lat, axis)
     out = st_x._replace(
@@ -910,9 +970,59 @@ def _window_step(
         min_used_lat=min_used,
         stats=stats,
     )
+    if cfg.trace_rounds:
+        out = out._replace(
+            trace=_trace_round(cfg, st, st_m, st_x, window_end, done, steps, occ)
+        )
     if capture:
         return out, st_m.outbox  # this round's sends, pre-exchange
     return out
+
+
+def _trace_round(
+    cfg: EngineConfig, st0: SimState, st_m: SimState, st_x: SimState,
+    window_end, done, steps, occ,
+):
+    """Append this round's record to the in-scan trace ring.
+
+    Strictly an observer: every value is either already computed by the
+    round (window bounds, steps, occ) or a difference of counters the
+    round maintains anyway — nothing downstream reads the ring, so the
+    scheduling dataflow is untouched and digests/events/drops stay
+    bit-identical with tracing on or off. The final done-round (which
+    does not count in stats.rounds) is skipped the same way.
+
+    `st0` is the round-entry state (for counter deltas), `st_m` the
+    post-microstep state (for the pre-exchange outbox count), `st_x` the
+    post-exchange state."""
+    ring: TraceRing = st_x.trace
+
+    def delta(get):
+        return (get(st_x.stats) - get(st0.stats))[0]
+
+    vals = [jnp.zeros((), jnp.int64)] * TRACE_COLS
+    vals[COL_ROUND] = st0.stats.rounds
+    vals[COL_WINDOW_START] = st0.now
+    vals[COL_WINDOW_END] = window_end
+    vals[COL_EVENTS] = jnp.sum(st_x.stats.events - st0.stats.events)
+    vals[COL_MICROSTEPS] = steps
+    vals[COL_POPK_DEFERRED] = delta(lambda s: s.popk_deferred)
+    vals[COL_BQ_REBUILDS] = delta(lambda s: s.bq_rebuilds)
+    vals[COL_ICI_BYTES] = delta(lambda s: s.ici_bytes)
+    vals[COL_SENDS] = st_m.outbox.count[0].astype(jnp.int64)
+    vals[COL_A2A_SHED] = delta(lambda s: s.a2a_shed)
+    vals[COL_OCC_HWM] = jnp.max(occ)
+    vals[COL_NEXT_TIME] = jnp.min(q_next_time(st_x.queue))
+    row = jnp.stack([jnp.asarray(v, jnp.int64) for v in vals])
+    idx = (ring.cursor[0] % cfg.trace_rounds).astype(jnp.int32)
+    written = lax.dynamic_update_slice(
+        ring.rows, row[None, None, :], (jnp.int32(0), idx, jnp.int32(0))
+    )
+    # the done-round is not a scheduling round: no row, no cursor bump
+    return TraceRing(
+        rows=jnp.where(done, ring.rows, written),
+        cursor=ring.cursor + jnp.where(done, 0, 1),
+    )
 
 
 def _effective_next(cfg: EngineConfig, st: SimState):
@@ -1413,7 +1523,8 @@ def _exchange(cfg, axis, st: SimState):
         g.payload.reshape(-1, g.payload.shape[-1]), valid,
     )
     has_sends = jnp.sum(g.count) > 0
-    queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
+    with jax.named_scope("shadow_merge"):
+        queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
     stats = st.stats
     if axis:
         stats = stats._replace(
@@ -1604,7 +1715,8 @@ def _exchange_alltoall(cfg, axis, st: SimState):
     flat = (local, r_t, r_order, r_kind, r_payload, r_valid)
 
     has_sends = lax.psum(jnp.sum(ob.count), axis) > 0
-    queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
+    with jax.named_scope("shadow_merge"):
+        queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
     stats = st.stats._replace(
         a2a_shed=st.stats.a2a_shed + shed[None],
         ici_bytes=st.stats.ici_bytes

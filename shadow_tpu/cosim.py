@@ -157,6 +157,11 @@ class HybridSimulation:
             # bounds the guarded round loop — the ONLY device execution path,
             # so it must be >= 1 or nothing would ever advance
             rounds_per_chunk=max(auto_rpc, 1),
+            # round tracer ring sized to the guarded chunk bound; drained
+            # after every guarded dispatch so it can never wrap
+            trace_rounds=(
+                max(auto_rpc, 1) if cfg.observability.trace else 0
+            ),
             microstep_limit=ex.microstep_limit,
             # the K-way fold and the flipped multi-device exchange default
             # ride along on hybrid sims: both act below the bridge (the
@@ -294,6 +299,11 @@ class HybridSimulation:
         # process, perf timers around the hot phases; §5.5: async
         # sim-time-stamped logger, shadow_logger.rs:17-60)
         self.perf = PerfTimers()
+        self._tracer = None
+        if self.engine_cfg.trace_rounds:
+            from shadow_tpu.obs import RoundTracer
+
+            self._tracer = RoundTracer(self.engine_cfg.trace_rounds)
         self._pcaps = []
         self._strace_files = []
         data_dir = cfg.general.data_directory
@@ -446,13 +456,51 @@ class HybridSimulation:
         cfg = self.cfg
         stop = cfg.general.stop_time
         show_progress = cfg.general.progress if progress is None else progress
+        hb_ns = cfg.general.heartbeat_interval
+        next_hb = hb_ns or 0
+        if self._tracer is not None and not (
+            self._tracer.rounds or self._tracer.lost
+        ):
+            # nothing drained yet: adopt the ring's current cursor so a
+            # state restored from a hybrid checkpoint is not replayed
+            self._tracer.sync_cursor(self.state.trace)
+        profiling = bool(cfg.observability.profile_dir)
+        if profiling:
+            os.makedirs(cfg.observability.profile_dir, exist_ok=True)
+            jax.profiler.start_trace(cfg.observability.profile_dir)
+        # wall clock starts AFTER observability setup so _wall_seconds and
+        # heartbeat ratios measure the simulation, not the trace session
+        t0 = time.monotonic()
+        try:
+            windows = self._window_loop(
+                stop, show_progress, t0, hb_ns, next_hb, log
+            )
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+        self._execute_hosts(stop)
+        if self._host_pool is not None:
+            self._host_pool.shutdown()
+            self._host_pool = None
+        # snapshot final states BEFORE reaping: a daemon alive at stop_time
+        # satisfies expected_final_state: running even though shutdown kills
+        # it (reference free_all_applications semantics, host.rs:791-807)
+        for p in self.procs:
+            p.state_at_stop = getattr(p.state, "value", p.state)
+        for h in self.hosts:  # reap live processes + native IPC resources
+            h.shutdown()
+        if show_progress:
+            print(file=log)
+        self._wall_seconds = time.monotonic() - t0
+        self._windows = windows
+        return self.stats_report()
+
+    def _window_loop(self, stop, show_progress, t0, hb_ns, next_hb, log):
+        cfg = self.cfg
         runahead = max(
             self.engine_cfg.runahead_floor, self.engine_cfg.static_min_latency, 1
         )
-        t0 = time.monotonic()
         windows = 0
-        hb_ns = cfg.general.heartbeat_interval
-        next_hb = hb_ns or 0
         while True:
             dev_min = int(jnp.min(q_next_time(self.state.queue)))
             t_next = min(self._cpu_min_next(), dev_min)
@@ -471,11 +519,25 @@ class HybridSimulation:
                 self.state = self._inject()
                 while self._staged:
                     self.state = self._inject()
+                # settle the staged merge BEFORE the timer stops: jax
+                # dispatch is async, so without the block this phase timed
+                # only the enqueue and the device work leaked into
+                # whichever phase synced first — perf.report() under-
+                # reported the device plane (the reference's perf_timers
+                # wrap the actual work, host.rs:721-729)
+                jax.block_until_ready(self.state)
             until = min(self._cpu_min_next(), stop)
+            t_rounds = time.monotonic()
             with self.perf.time("device_rounds"):
                 self.state = self._guarded(
                     self.state, self.params,
                     jnp.asarray(max(until, window_end), jnp.int64),
+                )
+                jax.block_until_ready(self.state)  # same async-timer fix
+            if self._tracer is not None:
+                self._tracer.drain(
+                    self.state.trace,
+                    wall_t0=t_rounds, wall_t1=time.monotonic(),
                 )
             with self.perf.time("drain_captures"):
                 self._drain_captures()
@@ -504,22 +566,7 @@ class HybridSimulation:
                 print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
             if self._window_idx % 256 == 0:
                 self._gc_bytes()
-        self._execute_hosts(stop)
-        if self._host_pool is not None:
-            self._host_pool.shutdown()
-            self._host_pool = None
-        # snapshot final states BEFORE reaping: a daemon alive at stop_time
-        # satisfies expected_final_state: running even though shutdown kills
-        # it (reference free_all_applications semantics, host.rs:791-807)
-        for p in self.procs:
-            p.state_at_stop = getattr(p.state, "value", p.state)
-        for h in self.hosts:  # reap live processes + native IPC resources
-            h.shutdown()
-        if show_progress:
-            print(file=log)
-        self._wall_seconds = time.monotonic() - t0
-        self._windows = windows
-        return self.stats_report()
+        return windows
 
     def _order_seq(self, gid: int) -> int:
         """Fresh per-host order counter for qdisc-reordered injections."""
@@ -719,6 +766,9 @@ class HybridSimulation:
             "queue_overflow_dropped": int(
                 np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
             ),
+            "queue_occupancy_hwm": int(np.asarray(s.q_occ_hwm)[:n].max())
+            if n
+            else 0,
             "unreachable_ips": sum(self._unreach),
             "model_pkts_unrouted": self._model_pkts_unrouted,
             "syscalls": sum(h.counters["syscalls"] for h in self.hosts),
@@ -728,6 +778,11 @@ class HybridSimulation:
             "perf": self.perf.report(),
             "model_report": self.model.report(
                 jax.device_get(self.state.model), None
+            ),
+            **(
+                {"trace": self._tracer.summary()}
+                if self._tracer is not None
+                else {}
             ),
         }
 
@@ -769,6 +824,10 @@ class HybridSimulation:
                     },
                     f,
                 )
+        if self._tracer is not None:
+            self._tracer.write_artifacts(
+                data_dir, self.cfg.observability, report
+            )
         return data_dir
 
 

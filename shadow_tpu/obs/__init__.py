@@ -10,12 +10,15 @@ from shadow_tpu.obs.pcap import PcapWriter, packet_bytes
 from shadow_tpu.obs.strace import StraceLogger
 from shadow_tpu.obs.perf import PerfTimers
 from shadow_tpu.obs.simlog import SimLogger, format_sim_time
+from shadow_tpu.obs.tracer import RoundTracer, TraceRing
 
 __all__ = [
     "PcapWriter",
     "PerfTimers",
+    "RoundTracer",
     "SimLogger",
     "StraceLogger",
+    "TraceRing",
     "format_sim_time",
     "packet_bytes",
 ]

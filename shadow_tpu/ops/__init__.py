@@ -29,6 +29,7 @@ from shadow_tpu.ops.events import (
     pack_order,
     check_order_limits,
     q_clear_popped,
+    q_len,
     q_next_time,
     q_pop_k,
     q_pop_min,
@@ -61,6 +62,7 @@ __all__ = [
     "pack_order",
     "check_order_limits",
     "q_clear_popped",
+    "q_len",
     "q_next_time",
     "q_pop_k",
     "q_pop_min",

@@ -716,6 +716,16 @@ def q_pop_min(q, limit):
     return bq_pop_min(q, limit) if isinstance(q, BucketQueue) else pop_min(q, limit)
 
 
+def q_len(q) -> Array:
+    """Per-host live-slot count (occupancy) for either queue type. The
+    bucketed queue sums its [H, C/B] `bfill` caches instead of scanning
+    the [H, C] slab — the cheap read the occupancy high-water tracking
+    relies on (one call per round, core/engine.py)."""
+    if isinstance(q, BucketQueue):
+        return jnp.sum(q.bfill, axis=1)
+    return queue_len(q)
+
+
 def q_push_many(q, pushes):
     return bq_push_many(q, pushes) if isinstance(q, BucketQueue) else push_many(q, pushes)
 

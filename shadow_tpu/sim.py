@@ -391,6 +391,9 @@ class Simulation:
             exchange=ex.resolve_exchange(world),
             a2a_block=ex.a2a_block,
             merge_rows=ex.merge_rows,
+            # round tracer ring sized to the chunk length: the run loop
+            # drains at every chunk boundary, so the ring can never wrap
+            trace_rounds=rpc if cfg.observability.trace else 0,
         )
         mesh = None
         if world > 1:
@@ -481,44 +484,74 @@ class Simulation:
                 path = os.path.join(cfg.general.data_directory, path)
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             simlog = SimLogger(path, level=cfg.general.log_level)
+        tracer = None
+        if self.engine_cfg.trace_rounds:
+            from shadow_tpu.obs import RoundTracer
+
+            tracer = RoundTracer(self.engine_cfg.trace_rounds)
+            # a restored checkpoint (or a prior run()) leaves rows in the
+            # ring; start draining from the current cursor, not zero
+            tracer.sync_cursor(self.state.trace)
+            self._tracer = tracer
+        profiling = bool(cfg.observability.profile_dir)
+        if profiling:
+            os.makedirs(cfg.observability.profile_dir, exist_ok=True)
+            jax.profiler.start_trace(cfg.observability.profile_dir)
         chunks = 0
-        while not bool(self.state.done):
-            if capture is not None:
-                self.state, sent = capture.step(self.state, self.params)
-                capture.write_round(sent)
-            else:
-                self.state = self.engine.run_chunk(self.state, self.params)
-            chunks += 1
-            now_ns = int(self.state.now)
-            wall = time.monotonic() - t0
-            if hb_ns and now_ns >= next_hb:
-                ev = int(np.asarray(self.state.stats.events).sum())
-                # event-density telemetry (the K-way microstep's target
-                # quantities): microsteps per round is how serialized the
-                # round loop is, events per microstep is how well the
-                # K-fold amortizes — the same two numbers bench.py tracks
-                msteps = int(np.asarray(self.state.stats.microsteps).sum())
-                rounds = int(self.state.stats.rounds)
-                print(
-                    f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
-                    f"wall={wall:.2f}s events={ev} "
-                    f"rounds={rounds} "
-                    f"msteps/round={msteps / max(rounds, 1):.1f} "
-                    f"ev/mstep={ev / max(msteps, 1):.2f} "
-                    f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
-                    f"{resource_heartbeat()}",
-                    file=log,
-                )
-                if simlog is not None:
-                    simlog.info(
-                        now_ns, "manager",
-                        f"heartbeat events={ev} "
-                        f"rounds={int(self.state.stats.rounds)}",
+        try:
+            while not bool(self.state.done):
+                t_chunk = time.monotonic()
+                if capture is not None:
+                    self.state, sent = capture.step(self.state, self.params)
+                    capture.write_round(sent)
+                else:
+                    self.state = self.engine.run_chunk(self.state, self.params)
+                if tracer is not None:
+                    # pair the drained rounds with the true wall span of
+                    # this dispatch (block: async dispatch would pin the
+                    # span to enqueue time, not device time)
+                    jax.block_until_ready(self.state)
+                    tracer.drain(
+                        self.state.trace,
+                        wall_t0=t_chunk, wall_t1=time.monotonic(),
                     )
-                next_hb = (now_ns // hb_ns + 1) * hb_ns
-            if show_progress:
-                pct = min(100.0, 100.0 * now_ns / max(cfg.general.stop_time, 1))
-                print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
+                chunks += 1
+                now_ns = int(self.state.now)
+                wall = time.monotonic() - t0
+                if hb_ns and now_ns >= next_hb:
+                    ev = int(np.asarray(self.state.stats.events).sum())
+                    # event-density telemetry (the K-way microstep's target
+                    # quantities): microsteps per round is how serialized the
+                    # round loop is, events per microstep is how well the
+                    # K-fold amortizes — the same two numbers bench.py tracks
+                    msteps = int(np.asarray(self.state.stats.microsteps).sum())
+                    rounds = int(self.state.stats.rounds)
+                    ici = int(np.asarray(self.state.stats.ici_bytes).sum())
+                    qhwm = int(np.asarray(self.state.stats.q_occ_hwm).max())
+                    print(
+                        f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
+                        f"wall={wall:.2f}s events={ev} "
+                        f"rounds={rounds} "
+                        f"msteps/round={msteps / max(rounds, 1):.1f} "
+                        f"ev/mstep={ev / max(msteps, 1):.2f} "
+                        f"ici_bytes={ici} q_hwm={qhwm} "
+                        f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
+                        f"{resource_heartbeat()}",
+                        file=log,
+                    )
+                    if simlog is not None:
+                        simlog.info(
+                            now_ns, "manager",
+                            f"heartbeat events={ev} "
+                            f"rounds={int(self.state.stats.rounds)}",
+                        )
+                    next_hb = (now_ns // hb_ns + 1) * hb_ns
+                if show_progress:
+                    pct = min(100.0, 100.0 * now_ns / max(cfg.general.stop_time, 1))
+                    print(f"\rprogress: {pct:5.1f}% ", end="", file=log, flush=True)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
         if show_progress:
             print(file=log)
         if capture is not None:
@@ -624,6 +657,7 @@ class Simulation:
             "bucket_cache_rebuilds": int(np.asarray(s.bq_rebuilds).sum()),
             "popk_deferred": int(np.asarray(s.popk_deferred).sum()),
             "ici_bytes": int(np.asarray(s.ici_bytes).sum()),
+            "queue_occupancy_hwm": int(s.q_occ_hwm[:n].max()) if n else 0,
             "monotonic_violations": int(s.monotonic_violations[:n].sum()),
             "determinism_digest": f"{int(np.bitwise_xor.reduce(s.digest[:n])):016x}",
             "model_report": self.model.report(
@@ -631,6 +665,16 @@ class Simulation:
                 self._model_hosts(),
             ),
         }
+        tracer = getattr(self, "_tracer", None)
+        if tracer is not None:
+            # tracing opted in: the per-host planes are cheap relative to
+            # the trace itself, so the full vectors ride along (gated —
+            # a 1M-host untraced sim must not grow MB-scale JSON)
+            report["trace"] = tracer.summary()
+            report["per_host"] = {
+                "events_processed": [int(x) for x in s.events[:n]],
+                "queue_occupancy_hwm": [int(x) for x in s.q_occ_hwm[:n]],
+            }
         return report
 
     def host_digests(self) -> np.ndarray:
@@ -655,11 +699,13 @@ class Simulation:
             events_c, sent_c = gold.stats["events"], gold.stats["pkts_sent"]
             deliv_c, lost_c = gold.stats["pkts_delivered"], gold.stats["pkts_lost"]
             digests = gold.digests
+            occ_c = None  # the golden oracle does not track occupancy
         else:
             s = jax.device_get(self.state.stats)
             events_c, sent_c = s.events, s.pkts_sent
             deliv_c, lost_c = s.pkts_delivered, s.pkts_lost
             digests = self.host_digests()
+            occ_c = s.q_occ_hwm
         for h in self.hosts:
             hd = os.path.join(data_dir, "hosts", h.name)
             os.makedirs(hd, exist_ok=True)
@@ -672,12 +718,25 @@ class Simulation:
                         "packets_sent": int(sent_c[h.host_id]),
                         "packets_delivered": int(deliv_c[h.host_id]),
                         "packets_lost": int(lost_c[h.host_id]),
+                        **(
+                            {"queue_occupancy_hwm": int(occ_c[h.host_id])}
+                            if occ_c is not None
+                            else {}
+                        ),
                         "determinism_digest": f"{int(digests[h.host_id]):016x}",
                     },
                     f,
                     indent=2,
                 )
+        self._write_trace_outputs(data_dir, report)
         return data_dir
+
+    def _write_trace_outputs(self, data_dir: str, report: dict | None):
+        """Export the round tracer's artifacts (Chrome trace + Prometheus
+        metrics) into the data dir. No-op unless `observability.trace` ran."""
+        tracer = getattr(self, "_tracer", None)
+        if tracer is not None:
+            tracer.write_artifacts(data_dir, self.cfg.observability, report)
 
 
 def resource_heartbeat() -> str:

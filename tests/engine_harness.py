@@ -30,6 +30,7 @@ def build_sim(
     exchange: str = "gather",
     queue_block: int = 0,
     microstep_events: int = 1,
+    trace_rounds: int = 0,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -51,6 +52,7 @@ def build_sim(
         use_jitter=jitter > 0,
         exchange=exchange,
         microstep_events=microstep_events,
+        trace_rounds=trace_rounds,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)

@@ -140,6 +140,103 @@ def test_sim_logger_format_and_backpressure():
     assert log.records == 2
 
 
+def test_sim_logger_level_filtering_all_levels():
+    """Level filtering across the whole LEVELS ladder: records strictly
+    below the configured level never reach the queue (records counter
+    included), records at/above always do."""
+    import io
+
+    from shadow_tpu.obs.simlog import LEVELS, SimLogger
+
+    for i, lvl in enumerate(LEVELS):
+        buf = io.StringIO()
+        log = SimLogger(buf, level=lvl)
+        for rec_lvl in LEVELS:
+            log.log(1_000_000_000, "h", rec_lvl, f"m-{rec_lvl}")
+        log.close()
+        lines = buf.getvalue().splitlines()
+        expect = LEVELS[i:]
+        assert [ln.split()[1].strip("[]") for ln in lines] == list(expect)
+        assert log.records == len(expect)
+    # unknown levels default to info on both sides
+    buf = io.StringIO()
+    log = SimLogger(buf, level="bogus")
+    log.log(0, "h", "debug", "filtered")
+    log.log(0, "h", "mystery", "kept")  # unknown record level -> info
+    log.close()
+    assert log.records == 1
+
+
+def test_sim_logger_backpressure_bounds_queue():
+    """The back-pressure bound: with a slow writer the producer BLOCKS at
+    BACKPRESSURE queued records instead of growing without bound
+    (shadow_logger.rs's 1M-line cap recast) — observable as
+    dropped_backpressure_waits > 0 — and no record is ever lost."""
+    import time as _time
+
+    class SlowSink:
+        def __init__(self):
+            self.lines = []
+
+        def writelines(self, batch):
+            self.lines.extend(batch)
+
+        def flush(self):
+            _time.sleep(0.02)  # producer outruns the flush thread
+
+    from shadow_tpu.obs.simlog import SimLogger
+
+    sink = SlowSink()
+    log = SimLogger(sink, level="info")
+    log.BACKPRESSURE = 8  # instance override: tiny bound, fast test
+    n = 200
+    max_seen = 0
+    for i in range(n):
+        log.info(i, "h", f"r{i}")
+        max_seen = max(max_seen, len(log._q))
+    log.close()
+    assert log.records == n
+    assert len(sink.lines) == n  # blocked, not dropped
+    assert log.dropped_backpressure_waits > 0, "back-pressure never engaged"
+    # the producer-side queue never exceeded the bound (+1 for the racing
+    # append the flush thread may not have collected yet)
+    assert max_seen <= log.BACKPRESSURE + 1
+
+
+def test_perf_timers_report_shape():
+    """PerfTimers: phase totals/counts in a stable report shape, nesting
+    accumulates per phase, and a disabled timer reports nothing."""
+    import time as _time
+
+    from shadow_tpu.obs.perf import PerfTimers
+
+    p = PerfTimers()
+    for _ in range(3):
+        with p.time("device_rounds"):
+            _time.sleep(0.001)
+    with p.time("host_plane"):
+        with p.time("device_rounds"):  # nesting: distinct phases accumulate
+            pass
+    rep = p.report()
+    assert sorted(rep) == ["device_rounds", "host_plane"]
+    assert rep["device_rounds"]["calls"] == 4
+    assert rep["host_plane"]["calls"] == 1
+    assert rep["device_rounds"]["total_s"] >= 0.003
+    assert set(rep["device_rounds"]) == {"total_s", "calls"}
+    # exceptions still charge the phase (the finally path)
+    try:
+        with p.time("host_plane"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert p.report()["host_plane"]["calls"] == 2
+
+    off = PerfTimers(enabled=False)
+    with off.time("x"):
+        pass
+    assert off.report() == {}
+
+
 def test_shadow_log_written_and_parsed(tmp_path):
     """general.log_file: the co-sim writes a shadow.log with per-host
     process-exit records consumable by tools/parse_shadow.py."""

@@ -1,0 +1,325 @@
+"""Device-resident round tracer (`observability.trace`, PR 3): the
+zero-interference gate plus export validity.
+
+The tracer's contract mirrors the queue/pop PRs' bit-identity contracts:
+  1. enabling the trace ring changes NOTHING observable — digests,
+     per-host event counts, and every drop counter are bit-identical to
+     the untraced run, across echo/phold/tgen, flat and bucketed queue
+     layouts, K in {1, 4} (the ISSUE acceptance matrix);
+  2. the ring records exactly `stats.rounds` rows with monotone round
+     indices and strictly increasing window starts, and its per-round
+     counters reconcile with the engine's cumulative counters;
+  3. the exported Chrome trace is valid JSON with one canonical round
+     record per completed round, and `tools/trace_summary.py` (stdlib-
+     only) consumes it;
+  4. a ring smaller than the inter-drain round count loses the OLDEST
+     rows and counts them — never silently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import Engine
+from shadow_tpu.obs.tracer import (
+    COL_EVENTS,
+    COL_MICROSTEPS,
+    COL_NEXT_TIME,
+    COL_OCC_HWM,
+    COL_ROUND,
+    COL_WINDOW_END,
+    COL_WINDOW_START,
+    RoundTracer,
+    TRACE_FIELDS,
+)
+from tests.engine_harness import build_sim, mk_hosts
+
+RING = 64  # matches the harness rounds_per_chunk: a drain per chunk never wraps
+
+
+def _run(model, hosts, stop, *, k=1, qb=0, trace=False, ring=RING, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, queue_block=qb, microstep_events=k,
+        trace_rounds=(ring if trace else 0), **kw
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    tracer = RoundTracer(ring) if trace else None
+    chunks = 0
+    while not bool(state.done):
+        t0 = time.monotonic()
+        state = eng.run_chunk(state, params)
+        if tracer is not None:
+            jax.block_until_ready(state)
+            tracer.drain(state.trace, wall_t0=t0, wall_t1=time.monotonic())
+        chunks += 1
+        assert chunks < 500
+    return state, tracer
+
+
+# short-horizon variants of test_popk's workload trio: enough rounds to
+# exercise exchange/merge/defer paths, small enough for 24 jit builds
+_CASES = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 5)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(5, {"flow_segs": 8, "flows": 1, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             1_500_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+
+@pytest.mark.parametrize("qb", [0, 8], ids=["flat", "bucketed"])
+@pytest.mark.parametrize("k", [1, 4], ids=["k1", "k4"])
+@pytest.mark.parametrize("case", sorted(_CASES), ids=sorted(_CASES))
+def test_tracing_is_bit_identical_and_complete(case, k, qb):
+    """The ISSUE acceptance gate: tracing on vs off across the full
+    model x layout x K matrix, plus ring completeness/monotonicity."""
+    model, hosts, stop, kw = _CASES[case]
+    s_off, _ = _run(model, hosts, stop, k=k, qb=qb, trace=False, **kw)
+    s_on, tracer = _run(model, hosts, stop, k=k, qb=qb, trace=True, **kw)
+    off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+
+    np.testing.assert_array_equal(np.asarray(off.digest), np.asarray(on.digest))
+    np.testing.assert_array_equal(np.asarray(off.events), np.asarray(on.events))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(s_off.queue.dropped)),
+        np.asarray(jax.device_get(s_on.queue.dropped)),
+    )
+    for field in ("pkts_sent", "pkts_lost", "pkts_codel_dropped",
+                  "pkts_budget_dropped", "pkts_delivered", "q_occ_hwm"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(off, field)), np.asarray(getattr(on, field)),
+            err_msg=field,
+        )
+
+    rounds = int(on.rounds)
+    assert tracer.rounds == rounds and tracer.lost == 0
+    rows = tracer.rows()
+    assert rows.shape == (1, rounds, len(TRACE_FIELDS))
+    r = rows[0]
+    # monotone round indices starting at 0, strictly increasing windows
+    np.testing.assert_array_equal(r[:, COL_ROUND], np.arange(rounds))
+    assert (np.diff(r[:, COL_WINDOW_START]) > 0).all()
+    assert (r[:, COL_WINDOW_END] > r[:, COL_WINDOW_START]).all()
+    # per-round counters reconcile with the cumulative device counters
+    assert r[:, COL_EVENTS].sum() == int(np.asarray(on.events).sum())
+    assert r[:, COL_MICROSTEPS].sum() == int(np.asarray(on.microsteps).sum())
+    # ring's per-round occupancy max == stats' per-host high-water max
+    assert r[:, COL_OCC_HWM].max() == int(np.asarray(on.q_occ_hwm).max())
+
+
+def test_trace_ring_wrap_counts_lost_rows():
+    """A ring smaller than the rounds between drains drops the OLDEST
+    rows and counts them in `lost` — the newest rows stay intact."""
+    model, hosts, stop, kw = _CASES["phold"]
+    state, tracer = _run(model, hosts, stop, trace=True, ring=4, **kw)
+    rounds = int(jax.device_get(state.stats.rounds))
+    # the harness drains once per chunk; this workload finishes inside one
+    # 64-round chunk, so a 4-slot ring must have wrapped
+    assert rounds > 4
+    assert tracer.lost == rounds - 4
+    assert tracer.rounds == 4
+    r = tracer.rows()[0]
+    np.testing.assert_array_equal(
+        r[:, COL_ROUND], np.arange(rounds - 4, rounds)
+    )
+    assert (r[:, COL_NEXT_TIME] > 0).all()
+
+
+def test_fresh_tracer_adopts_ring_cursor():
+    """The checkpoint-resume shape: a FRESH tracer handed a state whose
+    ring already holds rows (device cursor > 0) must sync to the current
+    cursor — not replay pre-existing rows as new rounds or count them as
+    ring losses."""
+    model, hosts, stop, kw = _CASES["phold"]
+    state, _ = _run(model, hosts, stop, trace=True, **kw)
+    assert int(jax.device_get(state.trace.cursor).max()) > 0
+    b = RoundTracer(RING)
+    b.sync_cursor(state.trace)
+    assert b.drain(state.trace) == 0
+    assert b.rounds == 0 and b.lost == 0
+    assert b.rows().shape[1] == 0
+
+
+# the two Simulation legs of the smoke test, run in a SUBPROCESS: compiled
+# `Simulation` runs intermittently hit this box's documented jaxlib-0.4.37
+# heap corruption (malloc_consolidate/SIGABRT — see CHANGES.md PR 1/2 env
+# notes, same signature as the seed tier-1), and an in-process abort would
+# kill the whole pytest run. The engine-harness matrix above is the primary
+# gate and is stable in-process; this leg gates the DRIVER wiring.
+_SMOKE_SCRIPT = """
+import json, sys
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+def cfg(tmp, trace):
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": "1 s", "seed": 7, "data_directory": tmp,
+                    "heartbeat_interval": None},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_queue_capacity": 16, "rounds_per_chunk": 8},
+        "observability": {"trace": trace},
+        "hosts": {
+            "server": {"network_node_id": 0,
+                       "processes": [{"model": "udp_echo",
+                                      "model_args": {"role": "server"}}]},
+            "cli": {"count": 3, "network_node_id": 0,
+                    "processes": [{
+                        "model": "udp_echo",
+                        "model_args": {"role": "client", "peer": "server",
+                                       "interval": "100 ms",
+                                       "size_bytes": 256}}]},
+        },
+    })
+
+off_dir, on_dir = sys.argv[1], sys.argv[2]
+sim_off = Simulation(cfg(off_dir, False), world=1)
+rep_off = sim_off.run()
+sim_on = Simulation(cfg(on_dir, True), world=1)
+rep_on = sim_on.run()
+sim_on.write_outputs(report=rep_on)
+print(json.dumps({"off": rep_off, "on": rep_on}))
+"""
+
+
+def test_simulation_trace_smoke(tmp_path):
+    """Tier-1 smoke (the ISSUE's CI satellite): a tiny echo sim with
+    tracing on exports a valid Chrome trace with one round record per
+    completed round, digests match the untraced run, and
+    tools/trace_summary.py consumes the file."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [repo, os.environ.get("PYTHONPATH", "")]))
+    proc = subprocess.run(
+        [sys.executable, "-c", _SMOKE_SCRIPT,
+         str(tmp_path / "off"), str(tmp_path / "on")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+    )
+    if proc.returncode in (134, 139, -6, -11) and not proc.stdout.strip():
+        pytest.skip(
+            "known jaxlib-0.4.37 heap corruption in compiled Simulation "
+            "runs on this box (malloc_consolidate SIGABRT/SIGSEGV, "
+            f"CHANGES.md env notes): {proc.stderr[-200:]}"
+        )
+    assert proc.returncode == 0, proc.stderr
+    reps = json.loads(proc.stdout.strip().splitlines()[-1])
+    rep_off, rep_on = reps["off"], reps["on"]
+
+    assert rep_on["determinism_digest"] == rep_off["determinism_digest"]
+    assert rep_on["events_processed"] == rep_off["events_processed"]
+    assert rep_on["rounds"] == rep_off["rounds"]
+    assert rep_on["trace"]["rounds_traced"] == rep_on["rounds"]
+    assert rep_on["trace"]["rounds_lost"] == 0
+    assert rep_on["queue_occupancy_hwm"] >= 1
+    assert len(rep_on["per_host"]["events_processed"]) == 4
+
+    trace_path = tmp_path / "on" / "trace.json"
+    with open(trace_path) as f:
+        trace = json.load(f)  # valid JSON or this raises
+    rounds = [e for e in trace["traceEvents"] if e.get("cat") == "round"]
+    assert len(rounds) == rep_on["rounds"]
+    idx = [e["args"]["round"] for e in rounds]
+    assert idx == sorted(idx) == list(range(rep_on["rounds"]))
+    starts = [e["args"]["window_start"] for e in rounds]
+    assert all(b > a for a, b in zip(starts, starts[1:]))
+
+    metrics = (tmp_path / "on" / "metrics.prom").read_text()
+    assert f"shadow_tpu_rounds_total {rep_on['rounds']}" in metrics
+    assert "shadow_tpu_queue_occupancy_hwm" in metrics
+    # exposition validity: one HELP/TYPE block per metric name even though
+    # the report's extra gauges collide with built-ins (q_occ_hwm etc.)
+    names = [ln.split()[2] for ln in metrics.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(names) == len(set(names))
+
+    # per-host occupancy high-water rides in host-stats.json (tracked
+    # unconditionally; sanity-check on the traced run's output dir)
+    hs = json.load(open(tmp_path / "on" / "hosts" / "server" /
+                        "host-stats.json"))
+    assert hs["queue_occupancy_hwm"] >= 1
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "trace_summary.py"),
+         str(trace_path), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout)
+    assert summary["rounds"] == rep_on["rounds"]
+    assert summary["phases"]["all"]["events"]["sum"] \
+        == rep_on["events_processed"]
+
+
+def test_metrics_text_deduplicates_colliding_extras():
+    """Report fields passed as extra gauges can collide with built-in
+    metric names (queue_occupancy_hwm does); the exporter must keep one
+    HELP/TYPE block per name or the exposition file is unscrapeable."""
+    t = RoundTracer(4)
+    text = t.to_metrics_text(
+        extra={"queue_occupancy_hwm": 5, "rounds": 1, "skipped": "str"}
+    )
+    names = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(names) == len(set(names))
+    assert "shadow_tpu_rounds" in names  # non-colliding extras still land
+    assert not any("skipped" in n for n in names)  # non-numerics filtered
+
+
+def test_observability_options_parse():
+    from shadow_tpu.config.options import ConfigError, ObservabilityOptions
+
+    o = ObservabilityOptions.from_dict(None)
+    assert not o.trace and o.trace_file == "trace.json"
+    assert o.metrics_file == "metrics.prom" and o.profile_dir is None
+    o = ObservabilityOptions.from_dict(
+        {"trace": True, "trace_file": "t.json", "metrics_file": None,
+         "profile_dir": "/tmp/prof"}
+    )
+    assert o.trace and o.metrics_file is None and o.profile_dir == "/tmp/prof"
+    # null disables an export (it must NOT coerce to a file named "None")
+    o = ObservabilityOptions.from_dict({"trace": True, "trace_file": None})
+    assert o.trace_file is None
+    with pytest.raises(ConfigError, match="unknown observability"):
+        ObservabilityOptions.from_dict({"nope": 1})
+    with pytest.raises(ConfigError, match="trace_file"):
+        ObservabilityOptions.from_dict({"trace_file": ""})
+
+
+def test_heartbeat_regex_old_and_new():
+    """tools/parse_shadow.py must parse both the extended heartbeat line
+    (ici_bytes / q_hwm) and pre-PR-3 lines without them."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    new = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+           "msteps/round=3.0 ev/mstep=3.33 ici_bytes=4096 q_hwm=7 "
+           "ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(new)
+    assert m and m.group("ici_bytes") == "4096" and m.group("q_hwm") == "7"
+    assert m.group("ratio") == "0.40"
+    old = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+           "msteps/round=3.0 ev/mstep=3.33 ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(old)
+    assert m and m.group("ici_bytes") is None
+    assert m.group("ratio") == "0.40"

@@ -22,6 +22,9 @@ HEARTBEAT_RE = re.compile(
     r"(?:events=(?P<events>\d+) )?(?:rounds=(?P<rounds>\d+) |windows=(?P<windows>\d+) )?"
     r"(?:msteps/round=(?P<msteps_per_round>[\d.]+) )?"
     r"(?:ev/mstep=(?P<ev_per_mstep>[\d.]+) )?"
+    # PR 3 observability fields; optional so pre-PR-3 logs still parse
+    r"(?:ici_bytes=(?P<ici_bytes>\d+) )?"
+    r"(?:q_hwm=(?P<q_hwm>\d+) )?"
     r"ratio=(?P<ratio>[\d.]+)x"
     r"(?: rss_gib=(?P<rss_gib>[\d.]+))?"
     r"(?: utime_min=(?P<utime_min>[\d.]+))?"

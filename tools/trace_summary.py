@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Print a per-phase round breakdown from an exported Chrome trace.
+
+Input: the `trace.json` written by `observability.trace` (the
+`obs/tracer.py` Chrome-trace exporter). Stdlib-only on purpose — this
+reads the exported artifact, not the simulation, so it runs anywhere
+(a laptop holding a trace scp'd off the TPU box included).
+
+The breakdown groups rounds into behavioral phases:
+  - exchange-active rounds (staged sends > 0) vs quiet rounds: how much
+    of the run pays the merge sort;
+  - deferral rounds (popk_deferred > 0): where the K-way guard bit;
+  - shed/overflow rounds: loud-loss visibility.
+plus wall-clock chunk statistics (rounds per dispatch, dispatch spans).
+
+Usage: trace_summary.py TRACE_JSON [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _stats(vals: list[int]) -> dict:
+    if not vals:
+        return {"n": 0, "sum": 0, "mean": 0.0, "max": 0}
+    return {
+        "n": len(vals),
+        "sum": sum(vals),
+        "mean": round(sum(vals) / len(vals), 2),
+        "max": max(vals),
+    }
+
+
+def summarize(trace: dict) -> dict:
+    rounds = [
+        e["args"]
+        for e in trace.get("traceEvents", [])
+        if e.get("cat") == "round"
+    ]
+    chunks = [
+        e
+        for e in trace.get("traceEvents", [])
+        if e.get("cat") == "chunk"
+    ]
+    phases = {
+        "all": rounds,
+        "exchange_active": [r for r in rounds if r.get("sends", 0) > 0],
+        "quiet": [r for r in rounds if r.get("sends", 0) == 0],
+        "popk_deferral": [r for r in rounds if r.get("popk_deferred", 0) > 0],
+        "a2a_shed": [r for r in rounds if r.get("a2a_shed", 0) > 0],
+    }
+    out: dict = {"rounds": len(rounds), "phases": {}}
+    for name, rs in phases.items():
+        if not rs and name != "all":
+            continue
+        sim_ns = sum(
+            r.get("window_end", 0) - r.get("window_start", 0) for r in rs
+        )
+        out["phases"][name] = {
+            "rounds": len(rs),
+            "sim_seconds": round(sim_ns / 1e9, 6),
+            "events": _stats([r.get("events", 0) for r in rs]),
+            "microsteps": _stats([r.get("microsteps", 0) for r in rs]),
+            "sends": _stats([r.get("sends", 0) for r in rs]),
+            "ici_bytes": sum(r.get("ici_bytes", 0) for r in rs),
+            "occ_hwm": max((r.get("occ_hwm", 0) for r in rs), default=0),
+        }
+    if chunks:
+        spans_ms = [c.get("dur", 0) / 1e3 for c in chunks]
+        per_chunk = [c.get("args", {}).get("rounds", 0) for c in chunks]
+        out["chunks"] = {
+            "n": len(chunks),
+            "wall_seconds": round(sum(spans_ms) / 1e3, 4),
+            "rounds_per_chunk": _stats(per_chunk),
+            "ms_per_chunk_mean": round(sum(spans_ms) / len(spans_ms), 2),
+        }
+    other = trace.get("otherData", {})
+    if other:
+        out["rounds_lost"] = other.get("rounds_lost", 0)
+    return out
+
+
+def _print_table(s: dict, out=sys.stdout):
+    print(f"rounds traced: {s['rounds']}  (lost: {s.get('rounds_lost', 0)})",
+          file=out)
+    hdr = (f"{'phase':<16} {'rounds':>7} {'sim_s':>10} {'events':>9} "
+           f"{'ev/round':>9} {'msteps':>8} {'sends':>8} {'occ_hwm':>8}")
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    for name, p in s["phases"].items():
+        print(
+            f"{name:<16} {p['rounds']:>7} {p['sim_seconds']:>10.3f} "
+            f"{p['events']['sum']:>9} {p['events']['mean']:>9.2f} "
+            f"{p['microsteps']['sum']:>8} {p['sends']['sum']:>8} "
+            f"{p['occ_hwm']:>8}",
+            file=out,
+        )
+    c = s.get("chunks")
+    if c:
+        print(
+            f"chunks: {c['n']}  wall={c['wall_seconds']}s  "
+            f"rounds/chunk mean={c['rounds_per_chunk']['mean']} "
+            f"max={c['rounds_per_chunk']['max']}  "
+            f"ms/chunk={c['ms_per_chunk_mean']}",
+            file=out,
+        )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace", help="Chrome trace JSON from observability.trace")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as JSON instead of a table")
+    args = p.parse_args(argv)
+    with open(args.trace) as f:
+        trace = json.load(f)
+    s = summarize(trace)
+    if args.json:
+        print(json.dumps(s, indent=2))
+    else:
+        _print_table(s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
