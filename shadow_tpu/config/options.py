@@ -170,6 +170,18 @@ class ExperimentalOptions:
     # a traffic burst). Overflow sheds loudly into queue_overflow_dropped.
     # See EngineConfig.merge_rows and docs/usage.md.
     merge_rows: int = 0
+    # Occupancy-adaptive merge gears (core/gears.py + docs/architecture.md
+    # "Adaptive exchange"): compile the round body at a ladder of outbox
+    # column widths and let the driver pick next chunk's gear from the
+    # outbox-send high-water, so the exchange sort tracks ACTUAL per-round
+    # traffic instead of the static worst case. 0/off = disabled (full
+    # width always, today's exact program); "auto" = a ~{B/8, B/4, B/2, B}
+    # ladder from the send budget; a list of ints = explicit widths (the
+    # full budget is appended automatically). Exact on every workload: a
+    # gear that would shed aborts the chunk and replays one gear up from a
+    # pre-chunk snapshot — digests, event counts, and drop counters are
+    # bit-identical to full width (tests/test_gears.py is the gate).
+    merge_gears: Any = 0
     # packet delivery breadcrumbs on the CPU host planes (reference
     # packet.rs:16-39), debug-only: drops land in host-stats.json with
     # their full hop trail
@@ -284,6 +296,30 @@ class ExperimentalOptions:
             )
         if "cpu_delay" in d:
             e.cpu_delay = parse_time_ns(d.pop("cpu_delay"), TimeUnit.MS)
+        if "merge_gears" in d:
+            mg = d.pop("merge_gears")
+            # shape-validate here (loud config errors); the ladder itself
+            # resolves against the send budget at build time
+            # (core.gears.resolve_gear_ladder — the budget may be auto-sized)
+            if isinstance(mg, str):
+                if mg.lower() not in ("auto", "off"):
+                    raise ConfigError(
+                        f"experimental.merge_gears must be off|auto|int|"
+                        f"[ints], got {mg!r}"
+                    )
+                mg = 0 if mg.lower() == "off" else "auto"
+            elif isinstance(mg, list):
+                if not all(isinstance(g, int) and g > 0 for g in mg):
+                    raise ConfigError(
+                        f"experimental.merge_gears list entries must be "
+                        f"positive ints, got {mg!r}"
+                    )
+            elif mg is not None and not isinstance(mg, (int, bool)):
+                raise ConfigError(
+                    f"experimental.merge_gears must be off|auto|int|[ints], "
+                    f"got {mg!r}"
+                )
+            e.merge_gears = mg or 0
         if e.strace_logging_mode not in ("off", "standard", "deterministic"):
             raise ConfigError(
                 f"experimental.strace_logging_mode must be off|standard|"
@@ -630,6 +666,8 @@ def merge_cli_overrides(cfg: ConfigOptions, overrides: dict[str, str]) -> Config
                 val = parse_time_ns(val, TimeUnit.MS)
             elif leaf.startswith("bandwidth_"):
                 val = parse_bits_per_sec(val)
+            elif leaf == "merge_gears":
+                pass  # polymorphic (off|auto|int|[ints]); validated at build
             elif isinstance(cur, bool):
                 val = bool(val)
             elif isinstance(cur, int):

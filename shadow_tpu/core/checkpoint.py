@@ -27,6 +27,26 @@ class CheckpointError(Exception):
     pass
 
 
+def snapshot_state(state):
+    """Cheap in-memory pre-chunk snapshot: a device-resident copy of every
+    leaf. The full .npz checkpoint path (below) is for durability; this is
+    the gear replay loop's working copy — the jitted chunk DONATES its
+    input buffers, so a plain reference to the pre-chunk pytree would be
+    invalidated by the dispatch. `jnp.copy` stays on device (no host
+    round-trip) and copies only HBM-to-HBM, microseconds against a
+    multi-round chunk; no guard record is needed because the snapshot
+    never leaves this process or this engine build."""
+    return jax.tree.map(jnp.copy, state)
+
+
+def restore_snapshot(snap):
+    """A fresh donation-safe copy of a `snapshot_state` result. The copy
+    (rather than the snapshot itself) is handed to the replay dispatch so
+    the snapshot survives — a replay at a mid-ladder gear can shed again
+    and need yet another restore."""
+    return snapshot_state(snap)
+
+
 def _params_digest(params) -> str:
     """Digest of the model/routing parameter leaves: same-shaped states
     driven by DIFFERENT params (model_args, graph latencies) must not

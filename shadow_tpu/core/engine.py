@@ -85,9 +85,11 @@ from shadow_tpu.obs.tracer import (
     COL_A2A_SHED,
     COL_BQ_REBUILDS,
     COL_EVENTS,
+    COL_GEAR,
     COL_ICI_BYTES,
     COL_MICROSTEPS,
     COL_NEXT_TIME,
+    COL_OB_HWM,
     COL_OCC_HWM,
     COL_POPK_DEFERRED,
     COL_ROUND,
@@ -166,6 +168,22 @@ class Stats(NamedTuple):
     # gets before the next round's pops drain it). Pure observation: reads
     # the queue, feeds nothing back (tracker.c's per-host gauges analogue).
     q_occ_hwm: Array  # i64[H]
+    # outbox-send high-water: the most sends any ONE host staged in a
+    # single round (the [H, B] outbox's column high-water), sampled
+    # pre-exchange every round. Always on; the gear controller reads it
+    # between chunks to pick the next merge gear (and resets it per chunk
+    # so the signal tracks recent rounds, not the whole run).
+    outbox_hwm: Array  # i64[world]
+    # gear-shed detector: cumulative count of sends beyond the active
+    # merge gear's column width (psum'd across the mesh inside the
+    # exchange, so every shard carries the GLOBAL count and the chunk
+    # loop's abort condition stays uniform). Structurally zero at full
+    # width. A nonzero per-chunk delta means the sliced merge lost
+    # entries: the driver discards the chunk, restores the pre-chunk
+    # snapshot, and replays one gear up — accepted chunks always carry a
+    # zero delta, which is what keeps gear-ladder runs bit-identical to
+    # the full-width engine.
+    gear_shed: Array  # i64[world]
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
 
@@ -308,6 +326,22 @@ class EngineConfig:
     # sorted position and count in queue.dropped. 0 = unbounded (the full
     # worst-case outbox, num_hosts * sends_per_host_round rows).
     merge_rows: int = 0
+    # Active merge gear (experimental.merge_gears): the number of outbox
+    # LANE COLUMNS the exchange flattens, sorts, and merges. The outbox is
+    # [H, B] with host h's k-th send of the round in column k, so when no
+    # host stages more than `gear_cols` sends in a round the first
+    # `gear_cols` columns hold EVERY valid entry and the truncation is
+    # exact — the (dst, t, order) sort runs over H x gear_cols rows
+    # instead of the worst-case H x B. Sends beyond the width are counted
+    # (globally) into stats.gear_shed and the chunk loop aborts; the
+    # driver then restores its pre-chunk snapshot and replays one gear up
+    # (core/gears.py), so results stay bit-identical to full width on
+    # every workload. 0 = full width (byte-identical program to before
+    # gears existed). The driver's EngineConfig always carries 0 here —
+    # geared chunk programs are built via Engine.run_chunk_gear with a
+    # dataclasses.replace'd copy, so checkpoint fingerprints never vary
+    # with the transient gear choice.
+    gear_cols: int = 0
     # Device-resident round tracer (observability.trace): capacity of the
     # in-scan trace ring in rounds. 0 = off (no ring in the carry, no row
     # writes — the traced program is byte-identical to before the tracer
@@ -354,6 +388,12 @@ class EngineConfig:
             raise ValueError(
                 f"trace_rounds={self.trace_rounds} must be >= 0 (0 = off)"
             )
+        if self.gear_cols < 0 or self.gear_cols > self.sends_per_host_round:
+            raise ValueError(
+                f"gear_cols={self.gear_cols} must be in "
+                f"[0, sends_per_host_round={self.sends_per_host_round}] "
+                f"(0 = full width)"
+            )
 
     @property
     def a2a_block_size(self) -> int:
@@ -389,6 +429,18 @@ class EngineConfig:
         """K clamped to the queue capacity (popping more than C events in
         one batch is impossible by construction)."""
         return min(self.microstep_events, self.queue_capacity)
+
+    @property
+    def effective_gear_cols(self) -> int:
+        """The merge width actually in force (0 resolves to full width)."""
+        return self.gear_cols or self.sends_per_host_round
+
+    @property
+    def gear_active(self) -> bool:
+        """True iff this program runs a TRUNCATED merge (shed detection,
+        gear-abort chunk condition, and the sliced exchange are traced in
+        only then — the full-width program stays byte-identical)."""
+        return 0 < self.gear_cols < self.sends_per_host_round
 
 
 # --------------------------------------------------------------------------
@@ -432,6 +484,8 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         popk_deferred=jnp.zeros((cfg.world,), jnp.int64),
         ici_bytes=jnp.zeros((cfg.world,), jnp.int64),
         q_occ_hwm=zi(),
+        outbox_hwm=jnp.zeros((cfg.world,), jnp.int64),
+        gear_shed=jnp.zeros((cfg.world,), jnp.int64),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
         rounds=jnp.zeros((), jnp.int64),
     )
@@ -590,17 +644,45 @@ class Engine:
         self.model = model
         self.mesh = mesh
         self.run_chunk = None  # built by init_state (needs model pytree shapes)
+        self._gear_chunks: dict[int, Any] = {}  # gear_cols -> jitted chunk
 
-    def _build_run_chunk(self):
+    def _jit_chunk(self, cfg: EngineConfig):
+        """Build one jitted chunk program for `cfg` — shared by the
+        full-width build and every gear variant so specs/donation can
+        never diverge between them."""
         axis = AXIS if self.mesh is not None else None
-        chunk = functools.partial(_run_chunk, self.cfg, self.model, axis)
+        chunk = functools.partial(_run_chunk, cfg, self.model, axis)
         if self.mesh is not None:
             state_spec = self.state_specs()
-            param_spec = self.param_specs()
             chunk = _shard_map(
-                chunk, self.mesh, (state_spec, param_spec), state_spec
+                chunk, self.mesh, (state_spec, self.param_specs()), state_spec
             )
-        self.run_chunk = jax.jit(chunk, donate_argnums=0)
+        return jax.jit(chunk, donate_argnums=0)
+
+    def _build_run_chunk(self):
+        self.run_chunk = self._jit_chunk(self.cfg)
+
+    def run_chunk_gear(self, state: SimState, params: EngineParams, gear_cols: int):
+        """Run one chunk at a merge gear (`gear_cols` outbox columns in the
+        exchange sort). Gear programs are jitted lazily and cached per
+        width — the ladder is small (<= 4 gears), so at most a handful of
+        compiles per run. `gear_cols` of 0 or the full send budget routes
+        to the ordinary `run_chunk` (the byte-identical full-width
+        program). Callable only after `init_state` (like `run_chunk`).
+
+        State shapes are IDENTICAL across gears (the outbox stays [H, B];
+        only the slice the exchange sorts changes), so the pre-chunk
+        snapshot/replay loop in the drivers can hand the same pytree to
+        any gear."""
+        if gear_cols <= 0 or gear_cols >= self.cfg.sends_per_host_round:
+            return self.run_chunk(state, params)
+        fn = self._gear_chunks.get(gear_cols)
+        if fn is None:
+            fn = self._jit_chunk(
+                dataclasses.replace(self.cfg, gear_cols=gear_cols)
+            )
+            self._gear_chunks[gear_cols] = fn
+        return fn(state, params)
 
     def build_capture_step(self):
         """Jitted single round returning (state, sent-outbox) for pcap
@@ -675,6 +757,8 @@ class Engine:
                 popk_deferred=sh,
                 ici_bytes=sh,
                 q_occ_hwm=sh,
+                outbox_hwm=sh,
+                gear_shed=sh,
                 digest=sh,
                 rounds=rep,
             ),
@@ -800,9 +884,19 @@ def _pmin(x, axis):
 
 
 def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EngineParams):
+    # gear-abort: once a round's sliced exchange sheds, every further round
+    # of this chunk is wasted work (the driver will discard the result and
+    # replay from its snapshot one gear up), so the loop stops at the first
+    # shed. gear_shed carries the psum'd GLOBAL count, so the condition is
+    # uniform across shards and the mesh exits together.
+    shed0 = state.stats.gear_shed[0] if cfg.gear_active else None
+
     def cond(carry):
         st, i = carry
-        return (~st.done) & (i < cfg.rounds_per_chunk)
+        ok = (~st.done) & (i < cfg.rounds_per_chunk)
+        if shed0 is not None:
+            ok = ok & (st.stats.gear_shed[0] <= shed0)
+        return ok
 
     def body(carry):
         st, i = carry
@@ -823,7 +917,12 @@ def _run_guarded_chunk(
     idle, exiting as soon as a round produces host-bound deliveries (the
     probe) so the CPU plane can react — conservative lookahead stays exact
     because the CPU plane's earliest possible influence is `until` +
-    min-latency (SURVEY.md §7 hard parts 5-6)."""
+    min-latency (SURVEY.md §7 hard parts 5-6).
+
+    Runs at whatever merge gear `cfg.gear_cols` selects, with the same
+    first-shed abort as `_run_chunk` (the hybrid driver snapshots before
+    the dispatch and replays one gear up on a shed)."""
+    shed0 = st.stats.gear_shed[0] if cfg.gear_active else None
 
     def cond(carry):
         stc, i = carry
@@ -834,12 +933,15 @@ def _run_guarded_chunk(
             # decision must be global or shards exit at different rounds and
             # the survivors deadlock in the next round's collectives
             probe = lax.pmax(probe.astype(jnp.int32), axis) > 0
-        return (
+        ok = (
             (~stc.done)
             & (i < cfg.rounds_per_chunk)
             & (gmin < until)
             & (~probe)
         )
+        if shed0 is not None:
+            ok = ok & (stc.stats.gear_shed[0] <= shed0)
+        return ok
 
     def body(carry):
         stc, i = carry
@@ -958,10 +1060,15 @@ def _window_step(
     # the bucketed queue reads its bfill caches; flat pays one [H, C]
     # compare+sum per ROUND, noise next to the microsteps it follows)
     occ = q_len(st_x.queue).astype(jnp.int64)
+    # outbox-send high-water: the most sends any one host staged THIS
+    # round (pre-exchange cursor max — the gear controller's signal).
+    # Always on: one [H] max per round, noise next to the occ pass above.
+    ob_hwm = jnp.max(st_m.sent_round).astype(jnp.int64)
     stats = st_x.stats._replace(
         rounds=st_x.stats.rounds + jnp.where(done, 0, 1),
         microsteps=st_x.stats.microsteps + steps[None],
         q_occ_hwm=jnp.maximum(st_x.stats.q_occ_hwm, occ),
+        outbox_hwm=jnp.maximum(st_x.stats.outbox_hwm, ob_hwm[None]),
     )
     min_used = _pmin(st_x.min_used_lat, axis)
     out = st_x._replace(
@@ -972,7 +1079,9 @@ def _window_step(
     )
     if cfg.trace_rounds:
         out = out._replace(
-            trace=_trace_round(cfg, st, st_m, st_x, window_end, done, steps, occ)
+            trace=_trace_round(
+                cfg, st, st_m, st_x, window_end, done, steps, occ, ob_hwm
+            )
         )
     if capture:
         return out, st_m.outbox  # this round's sends, pre-exchange
@@ -981,7 +1090,7 @@ def _window_step(
 
 def _trace_round(
     cfg: EngineConfig, st0: SimState, st_m: SimState, st_x: SimState,
-    window_end, done, steps, occ,
+    window_end, done, steps, occ, ob_hwm,
 ):
     """Append this round's record to the in-scan trace ring.
 
@@ -1013,6 +1122,8 @@ def _trace_round(
     vals[COL_A2A_SHED] = delta(lambda s: s.a2a_shed)
     vals[COL_OCC_HWM] = jnp.max(occ)
     vals[COL_NEXT_TIME] = jnp.min(q_next_time(st_x.queue))
+    vals[COL_OB_HWM] = ob_hwm
+    vals[COL_GEAR] = jnp.asarray(cfg.effective_gear_cols, jnp.int64)
     row = jnp.stack([jnp.asarray(v, jnp.int64) for v in vals])
     idx = (ring.cursor[0] % cfg.trace_rounds).astype(jnp.int32)
     written = lax.dynamic_update_slice(
@@ -1488,7 +1599,10 @@ def exchange_ici_bytes_per_round(cfg: EngineConfig, kind: str | None = None) -> 
     kind = kind or cfg.exchange
     if cfg.world <= 1:
         return 0
-    rows_local = cfg.hosts_per_shard * cfg.sends_per_host_round
+    # the gather collective moves the SLICED outbox, so a lower merge gear
+    # shrinks ICI bytes too; the alltoall's fixed blocks are gear-invariant
+    # (the gear trims its local sort input, not the wire format)
+    rows_local = cfg.hosts_per_shard * cfg.effective_gear_cols
     row_bytes = 4 + 8 + 8 + 4 + 4 * EVENT_PAYLOAD_WORDS
     if kind == "gather":
         return (cfg.world - 1) * (rows_local * row_bytes + 4)
@@ -1496,10 +1610,45 @@ def exchange_ici_bytes_per_round(cfg: EngineConfig, kind: str | None = None) -> 
     return (cfg.world - 1) * cfg.a2a_block_size * packed_words * 4
 
 
+def _gear_sliced_outbox(cfg, axis, ob: Outbox, sent_round):
+    """Truncate the outbox to the active merge gear's column width.
+
+    Host h's k-th send of a round lands in lane column k (`_outbox_append`
+    cursor layout), so when no host staged more than `gear_cols` sends the
+    first `gear_cols` columns hold EVERY valid entry and the slice is
+    exact — the downstream (dst, t, order) sort sees the same entry set in
+    a host-major order that is monotone in the full-width flattening
+    (identical selection even on the cheap-shed index-tiebreak path).
+    Sends beyond the width are counted into the returned shed, psum'd so
+    every shard carries the global value; the chunk loop aborts on the
+    first nonzero delta and the driver replays from its pre-chunk snapshot
+    one gear up, so a shed never reaches accepted results.
+
+    Returns (outbox-view, shed | None); None means the full-width program
+    (no slicing traced in at all)."""
+    if not cfg.gear_active:
+        return ob, None
+    from shadow_tpu.ops.merge import gear_shed_count
+
+    gc = cfg.gear_cols
+    local = gear_shed_count(sent_round, gc)
+    shed = lax.psum(local, axis) if axis else local
+    sliced = Outbox(
+        dst=ob.dst[:, :gc],
+        t=ob.t[:, :gc],
+        order=ob.order[:, :gc],
+        kind=ob.kind[:, :gc],
+        payload=ob.payload[:, :gc, :],
+        count=ob.count,
+    )
+    return sliced, shed
+
+
 def _exchange(cfg, axis, st: SimState):
     if axis and cfg.exchange == "alltoall":
         return _exchange_alltoall(cfg, axis, st)
-    ob = st.outbox
+    ob_full = st.outbox
+    ob, gear_shed = _gear_sliced_outbox(cfg, axis, ob_full, st.sent_round)
     if axis:
         g = jax.tree.map(
             lambda a: lax.all_gather(a, axis, tiled=True),
@@ -1526,6 +1675,8 @@ def _exchange(cfg, axis, st: SimState):
     with jax.named_scope("shadow_merge"):
         queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
     stats = st.stats
+    if gear_shed is not None:
+        stats = stats._replace(gear_shed=stats.gear_shed + gear_shed[None])
     if axis:
         stats = stats._replace(
             ici_bytes=stats.ici_bytes
@@ -1537,7 +1688,7 @@ def _exchange(cfg, axis, st: SimState):
         )
     return st._replace(
         queue=queue,
-        outbox=_fresh_outbox(ob),
+        outbox=_fresh_outbox(ob_full),
         sent_round=jnp.zeros_like(st.sent_round),
         stats=stats,
     )
@@ -1645,8 +1796,14 @@ def _exchange_alltoall(cfg, axis, st: SimState):
     the same contract as the merge — and the final per-queue insertion
     order is re-derived by the merge sort from (dst, t, order), identical
     to the gather path whenever nothing sheds (`stats.a2a_shed` counts
-    sheds; size `a2a_block` so it stays zero)."""
-    ob = st.outbox
+    sheds; size `a2a_block` so it stays zero).
+
+    Merge gears trim the LOCAL dst-shard sort input (the [H, B] lanes
+    sliced to gear_cols columns) exactly like the gather path; the
+    alltoall blocks themselves stay full width, so the wire format and
+    `a2a_shed` semantics are gear-invariant."""
+    ob_full = st.outbox
+    ob, gear_shed = _gear_sliced_outbox(cfg, axis, ob_full, st.sent_round)
     h_local = st.queue.t.shape[0]
     world = cfg.world
     k = cfg.a2a_block_size
@@ -1722,13 +1879,15 @@ def _exchange_alltoall(cfg, axis, st: SimState):
         ici_bytes=st.stats.ici_bytes
         + jnp.int64(exchange_ici_bytes_per_round(cfg, "alltoall"))[None],
     )
+    if gear_shed is not None:
+        stats = stats._replace(gear_shed=stats.gear_shed + gear_shed[None])
     if isinstance(st.queue, BucketQueue):
         stats = stats._replace(
             bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]
         )
     return st._replace(
         queue=queue,
-        outbox=_fresh_outbox(ob),
+        outbox=_fresh_outbox(ob_full),
         sent_round=jnp.zeros_like(st.sent_round),
         stats=stats,
     )

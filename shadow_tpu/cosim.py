@@ -372,30 +372,62 @@ class HybridSimulation:
         prepare = functools.partial(
             _prepare_window, self.engine_cfg, self.model, axis
         )
-        guarded = functools.partial(
-            eng._run_guarded_chunk,
-            self.engine_cfg,
-            self.model,
-            axis,
-            lambda ms: jnp.any(ms["cap_n"] > 0),
-        )
         if self.mesh is not None:
             from jax.sharding import PartitionSpec as P
 
-            state_spec = self.engine.state_specs()
-            param_spec = self.engine.param_specs()
             rep = P()
             from shadow_tpu.core.engine import _shard_map
 
             prepare = _shard_map(
                 prepare, self.mesh,
-                (state_spec, rep, rep, rep, rep, rep, rep), state_spec,
-            )
-            guarded = _shard_map(
-                guarded, self.mesh, (state_spec, param_spec, rep), state_spec
+                (self.engine.state_specs(), rep, rep, rep, rep, rep, rep),
+                self.engine.state_specs(),
             )
         self._prepare = jax.jit(prepare, donate_argnums=0)
-        self._guarded = jax.jit(guarded, donate_argnums=0)
+
+        def _mk_guarded(ecfg):
+            """The guarded round loop jitted at one engine config —
+            called once for the full-width program and lazily per merge
+            gear (`dataclasses.replace(cfg, gear_cols=g)`: same state
+            shapes, truncated exchange sort + first-shed abort)."""
+            g = functools.partial(
+                eng._run_guarded_chunk,
+                ecfg,
+                self.model,
+                axis,
+                lambda ms: jnp.any(ms["cap_n"] > 0),
+            )
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
+
+                from shadow_tpu.core.engine import _shard_map
+
+                state_spec = self.engine.state_specs()
+                g = _shard_map(
+                    g, self.mesh,
+                    (state_spec, self.engine.param_specs(), P()), state_spec,
+                )
+            return jax.jit(g, donate_argnums=0)
+
+        self._mk_guarded = _mk_guarded
+        self._guarded = _mk_guarded(self.engine_cfg)
+        self._guarded_gears: dict[int, Any] = {}
+        # occupancy-adaptive merge gears on the device plane (core/gears.py;
+        # the bridge's CPU plane is unaffected — gears act below it, in the
+        # exchange merge, and accepted chunks are bit-identical to full
+        # width by the shed-exact replay)
+        from shadow_tpu.core.gears import GearController, resolve_gear_ladder
+
+        try:
+            ladder = resolve_gear_ladder(
+                cfg.experimental.merge_gears,
+                self.engine_cfg.sends_per_host_round,
+            )
+        except ValueError as e:
+            raise ConfigError(f"experimental.merge_gears: {e}") from e
+        self._gearctl = GearController(ladder) if ladder else None
+        self._last_gear = None
+        self._ob_hwm_run = 0
         self._clear_caps = jax.jit(_clear_caps, donate_argnums=0)
 
     # ---- egress staging ----------------------------------------------------
@@ -529,11 +561,9 @@ class HybridSimulation:
             until = min(self._cpu_min_next(), stop)
             t_rounds = time.monotonic()
             with self.perf.time("device_rounds"):
-                self.state = self._guarded(
-                    self.state, self.params,
-                    jnp.asarray(max(until, window_end), jnp.int64),
+                self._device_rounds(
+                    jnp.asarray(max(until, window_end), jnp.int64)
                 )
-                jax.block_until_ready(self.state)  # same async-timer fix
             if self._tracer is not None:
                 self._tracer.drain(
                     self.state.trace,
@@ -549,9 +579,14 @@ class HybridSimulation:
                 )
             if hb_ns and window_end >= next_hb:
                 wall = time.monotonic() - t0
+                gear_f = (
+                    f"gear={self._last_gear} "
+                    if self._last_gear is not None else ""
+                )
                 print(
                     f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s windows={windows} "
+                    f"{gear_f}"
                     f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                     f"{simmod.resource_heartbeat()}",
                     file=log,
@@ -567,6 +602,53 @@ class HybridSimulation:
             if self._window_idx % 256 == 0:
                 self._gc_bytes()
         return windows
+
+    def _guarded_at(self, gear: int):
+        """The guarded-chunk program for a merge gear (lazily jitted and
+        cached, exactly like Engine.run_chunk_gear)."""
+        if gear <= 0 or gear >= self.engine_cfg.sends_per_host_round:
+            return self._guarded
+        fn = self._guarded_gears.get(gear)
+        if fn is None:
+            import dataclasses
+
+            fn = self._mk_guarded(
+                dataclasses.replace(self.engine_cfg, gear_cols=gear)
+            )
+            self._guarded_gears[gear] = fn
+        return fn
+
+    def _device_rounds(self, until_arr):
+        """One guarded device dispatch — at the adaptive merge gear with
+        shed-exact replay when gears are on, the plain full-width program
+        otherwise. The block_until_ready keeps the perf phase honest (jax
+        dispatch is async; see the device_inject comment above).
+
+        Cost note: below the top gear every window pays a device-side
+        SimState copy (the replay snapshot). Guarded windows can be a
+        handful of rounds, so on CPU-plane-chatty workloads at large H
+        the copy can eat the narrower sort's savings — merge gears on
+        hybrid sims are for device-dominant phases; leave the knob off
+        when the CPU plane sets the pace."""
+        if self._gearctl is None:
+            self.state = self._guarded(self.state, self.params, until_arr)
+            jax.block_until_ready(self.state)
+            return
+        from shadow_tpu.core.gears import run_adaptive_chunk
+
+        def dispatch(st, gear):
+            st = self._guarded_at(gear)(st, self.params, until_arr)
+            jax.block_until_ready(st)
+            return st
+
+        # rounds0: a guarded window can legitimately retire ZERO rounds
+        # (probe fires immediately / device already at the horizon) — such
+        # windows must not feed the controller an hwm of 0
+        self.state, self._last_gear, hwm = run_adaptive_chunk(
+            self._gearctl, self.state, dispatch,
+            rounds0=int(self.state.stats.rounds),
+        )
+        self._ob_hwm_run = max(self._ob_hwm_run, hwm)
 
     def _order_seq(self, gid: int) -> int:
         """Fresh per-host order counter for qdisc-reordered injections."""
@@ -769,6 +851,9 @@ class HybridSimulation:
             "queue_occupancy_hwm": int(np.asarray(s.q_occ_hwm)[:n].max())
             if n
             else 0,
+            "outbox_send_hwm": max(
+                int(np.asarray(s.outbox_hwm).max()), self._ob_hwm_run
+            ),
             "unreachable_ips": sum(self._unreach),
             "model_pkts_unrouted": self._model_pkts_unrouted,
             "syscalls": sum(h.counters["syscalls"] for h in self.hosts),
@@ -778,6 +863,11 @@ class HybridSimulation:
             "perf": self.perf.report(),
             "model_report": self.model.report(
                 jax.device_get(self.state.model), None
+            ),
+            **(
+                {"gears": self._gearctl.report()}
+                if self._gearctl is not None
+                else {}
             ),
             **(
                 {"trace": self._tracer.summary()}

@@ -51,6 +51,8 @@ TRACE_FIELDS = (
     "a2a_shed",       # all-to-all block-overflow sheds (delta)
     "occ_hwm",        # max per-host queue occupancy after the exchange
     "next_time",      # min queue head after the round (TIME_MAX if empty)
+    "ob_hwm",         # max sends any ONE host staged this round (gear signal)
+    "gear",           # active merge gear (outbox columns sorted; B = full)
 )
 TRACE_COLS = len(TRACE_FIELDS)
 (
@@ -66,6 +68,8 @@ TRACE_COLS = len(TRACE_FIELDS)
     COL_A2A_SHED,
     COL_OCC_HWM,
     COL_NEXT_TIME,
+    COL_OB_HWM,
+    COL_GEAR,
 ) = range(TRACE_COLS)
 
 
@@ -259,7 +263,18 @@ class RoundTracer:
             "a2a_shed": int(flat[:, COL_A2A_SHED].sum()),
             "occ_hwm": int(flat[:, COL_OCC_HWM].max()),
             "next_time": int(flat[:, COL_NEXT_TIME].max()),
+            "ob_hwm": int(flat[:, COL_OB_HWM].max()),
         }
+
+    def gear_histogram(self) -> dict:
+        """Rounds traced per active merge gear, {gear_cols: rounds}.
+        Shard 0's rows are the canonical record (the gear is a chunk-wide
+        static, identical on every shard). Empty when nothing is traced."""
+        rows = self.rows()
+        if rows.shape[1] == 0:
+            return {}
+        gears, counts = np.unique(rows[0, :, COL_GEAR], return_counts=True)
+        return {int(g): int(c) for g, c in zip(gears, counts)}
 
     def summary(self) -> dict:
         """Compact digest for sim-stats.json embedding."""

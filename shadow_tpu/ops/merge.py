@@ -35,6 +35,16 @@ positions, so only that prefix is materialized — the permute cost tracks the
 REAL per-round traffic instead of the worst-case outbox (H x send budget).
 Rows past the bound shed by sorted position and are counted, never silent.
 
+Merge gears (round 7) shrink the SORT itself the same way `merge_rows`
+shrank the gather: every entry point here is width-parameterized (N is just
+the length of the flat arrays handed in), so the engine compiles the round
+body at a ladder of outbox column widths and feeds the sort H x gear_cols
+rows instead of H x B. The truncation is positional on the [H, B] lane
+layout (host h's k-th send sits in column k), so it is exact whenever no
+host staged more than gear_cols sends that round — `gear_shed_count` is the
+exact detector, and the driver replays a shedding chunk one gear up from a
+pre-chunk snapshot (core/engine.py `_gear_sliced_outbox`, core/gears.py).
+
 Formulations tried and rejected in round 5 (measured on the v5e, kept for
 the record — all three looked faster in isolated microbenches and were not):
   - fully-SoA element gathers per field: in-context element gathers are
@@ -110,6 +120,17 @@ def _merge_scatter(q, s_dst, s_idx, t, order, kind, payload, r_cap,
         payload=q.payload.at[h_scatter, s_scatter].set(s_payload, mode="drop"),
         dropped=dropped,
     )
+
+
+def gear_shed_count(sent_round, gear_cols: int):
+    """Exact count of outbox entries a gear-truncated merge would lose:
+    host h's sends occupy lane columns 0..sent_round[h]-1, so exactly
+    max(sent_round[h] - gear_cols, 0) of its entries sit in the trimmed
+    columns. Zero iff the truncation is lossless — the gear-shed detector
+    (fed into stats.gear_shed; a nonzero delta aborts the chunk for a
+    snapshot replay one gear up, so results stay bit-identical to the
+    full-width merge)."""
+    return jnp.sum(jnp.maximum(sent_round.astype(jnp.int64) - gear_cols, 0))
 
 
 def _pack_words(t, order, kind, payload):

@@ -395,6 +395,17 @@ class Simulation:
             # drains at every chunk boundary, so the ring can never wrap
             trace_rounds=rpc if cfg.observability.trace else 0,
         )
+        # occupancy-adaptive merge gears (core/gears.py): resolved against
+        # the (possibly auto-sized) send budget; [] = disabled
+        from shadow_tpu.core.gears import resolve_gear_ladder
+
+        try:
+            self._gear_ladder = resolve_gear_ladder(ex.merge_gears, send_budget)
+        except ValueError as e:
+            raise ConfigError(f"experimental.merge_gears: {e}") from e
+        self._gearctl = None  # built per run()
+        self._ob_hwm_run = 0  # run-wide outbox high-water (gear runs reset
+        # the device counter per chunk, so the run max is tracked here)
         mesh = None
         if world > 1:
             mesh = jax.sharding.Mesh(np.array(jax.devices()[:world]), ("hosts",))
@@ -497,6 +508,21 @@ class Simulation:
         if profiling:
             os.makedirs(cfg.observability.profile_dir, exist_ok=True)
             jax.profiler.start_trace(cfg.observability.profile_dir)
+        gearctl = None
+        if self._gear_ladder and capture is None:
+            # adaptive merge gears: each chunk dispatches at the width the
+            # controller picked from last chunk's outbox-send high-water;
+            # a shed (exact, in-jit) discards the chunk and replays it one
+            # gear up from a pre-chunk snapshot — results are bit-identical
+            # to full width by construction (core/gears.py). The capture
+            # path stays full-width: its single-round dispatches re-sync
+            # every round anyway, so there is no sort to amortize.
+            from shadow_tpu.core.gears import GearController, run_adaptive_chunk
+
+            gearctl = GearController(self._gear_ladder)
+            self._gearctl = gearctl
+            self._run_adaptive_chunk = run_adaptive_chunk
+        last_gear = None
         chunks = 0
         try:
             while not bool(self.state.done):
@@ -504,6 +530,14 @@ class Simulation:
                 if capture is not None:
                     self.state, sent = capture.step(self.state, self.params)
                     capture.write_round(sent)
+                elif gearctl is not None:
+                    self.state, last_gear, hwm = self._run_adaptive_chunk(
+                        gearctl, self.state,
+                        lambda st, g: self.engine.run_chunk_gear(
+                            st, self.params, g
+                        ),
+                    )
+                    self._ob_hwm_run = max(self._ob_hwm_run, hwm)
                 else:
                     self.state = self.engine.run_chunk(self.state, self.params)
                 if tracer is not None:
@@ -528,6 +562,9 @@ class Simulation:
                     rounds = int(self.state.stats.rounds)
                     ici = int(np.asarray(self.state.stats.ici_bytes).sum())
                     qhwm = int(np.asarray(self.state.stats.q_occ_hwm).max())
+                    # gear= rides along only on adaptive runs (old-format
+                    # lines stay byte-identical; parse_shadow reads both)
+                    gear_f = f"gear={last_gear} " if last_gear is not None else ""
                     print(
                         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
                         f"wall={wall:.2f}s events={ev} "
@@ -535,6 +572,7 @@ class Simulation:
                         f"msteps/round={msteps / max(rounds, 1):.1f} "
                         f"ev/mstep={ev / max(msteps, 1):.2f} "
                         f"ici_bytes={ici} q_hwm={qhwm} "
+                        f"{gear_f}"
                         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                         f"{resource_heartbeat()}",
                         file=log,
@@ -658,6 +696,12 @@ class Simulation:
             "popk_deferred": int(np.asarray(s.popk_deferred).sum()),
             "ici_bytes": int(np.asarray(s.ici_bytes).sum()),
             "queue_occupancy_hwm": int(s.q_occ_hwm[:n].max()) if n else 0,
+            # always-on: the most sends any one host staged in a round.
+            # Gear runs reset the device counter per chunk (the controller
+            # needs a fresh signal), so fold in the Python-tracked run max.
+            "outbox_send_hwm": max(
+                int(np.asarray(s.outbox_hwm).max()), self._ob_hwm_run
+            ),
             "monotonic_violations": int(s.monotonic_violations[:n].sum()),
             "determinism_digest": f"{int(np.bitwise_xor.reduce(s.digest[:n])):016x}",
             "model_report": self.model.report(
@@ -665,6 +709,8 @@ class Simulation:
                 self._model_hosts(),
             ),
         }
+        if self._gearctl is not None:
+            report["gears"] = self._gearctl.report()
         tracer = getattr(self, "_tracer", None)
         if tracer is not None:
             # tracing opted in: the per-host planes are cheap relative to

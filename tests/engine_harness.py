@@ -31,6 +31,7 @@ def build_sim(
     queue_block: int = 0,
     microstep_events: int = 1,
     trace_rounds: int = 0,
+    merge_rows: int = 0,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -53,6 +54,7 @@ def build_sim(
         exchange=exchange,
         microstep_events=microstep_events,
         trace_rounds=trace_rounds,
+        merge_rows=merge_rows,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)

@@ -1,0 +1,92 @@
+"""Subprocess isolation for compiled-`Simulation` test legs.
+
+This box's jaxlib 0.4.37, under the 8-virtual-device conftest, intermittently
+heap-corrupts (glibc `malloc_consolidate` SIGABRT, or a SIGSEGV — often at
+interpreter teardown) in compiled `Simulation`/`HybridSimulation` runs; the
+seed tier-1 shows the same DOTS_PASSED=0 / rc=134 signature, and CHANGES.md
+PR 1-3 env notes re-verified it on unmodified HEAD. An in-process abort
+kills the whole pytest run, so every test that drives a compiled Simulation
+runs its device legs in a SUBPROCESS through this helper and SKIPS (never
+silently passes) when the corruption signature appears. Engine-harness
+tests stay in-process — those paths are stable here and remain the primary
+gates.
+
+Usage:
+    from tests.subproc import run_isolated, run_isolated_json
+
+    proc = run_isolated(SCRIPT, arg1, arg2)      # skips on the signature,
+    assert proc.returncode == 0, proc.stderr     # else a normal proc
+    data = run_isolated_json(SCRIPT, arg1)       # + parses last stdout line
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+# SIGABRT/SIGSEGV as seen through shell (128+N) and Python (-N) conventions
+HEAP_CORRUPTION_RCS = (134, 139, -6, -11)
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# this box's sitecustomize registers the axon TPU plugin and forces
+# jax_platforms="axon,cpu", overriding the JAX_PLATFORMS env var — the
+# prelude pins the backend back the way tests/conftest.py does
+_PRELUDE = "import jax\njax.config.update('jax_platforms', 'cpu')\n"
+
+
+def run_isolated(
+    script: str, *argv: str, timeout: int = 600, prelude: bool = True
+) -> subprocess.CompletedProcess:
+    """Run `script` via `python -c` in a clean subprocess (repo on
+    PYTHONPATH, CPU backend pinned, the conftest's 8-virtual-device
+    XLA_FLAGS inherited so `world > 1` legs still see a mesh). Calls
+    `pytest.skip` when the run dies with the known heap-corruption
+    signature AND produced no stdout — a real assertion failure (rc 1,
+    stdout present) is never masked."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join([_REPO, os.environ.get("PYTHONPATH", "")]),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", (_PRELUDE if prelude else "") + script,
+         *[str(a) for a in argv]],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO,
+    )
+    if proc.returncode in HEAP_CORRUPTION_RCS and not proc.stdout.strip():
+        pytest.skip(
+            "known jaxlib-0.4.37 heap corruption in compiled Simulation "
+            "runs on this box (malloc_consolidate SIGABRT/SIGSEGV, "
+            f"CHANGES.md env notes): {proc.stderr[-200:]}"
+        )
+    return proc
+
+
+def run_isolated_json(
+    script: str, *argv: str, timeout: int = 600
+) -> dict:
+    """`run_isolated` + assert rc == 0 + parse the LAST stdout line as
+    JSON (scripts print their result dict last; progress chatter above is
+    fine). A crash AFTER the result line — the teardown-time flavor of
+    the corruption — still yields the result: the run itself completed."""
+    proc = run_isolated(script, *argv, timeout=timeout)
+    lines = proc.stdout.strip().splitlines()
+    if proc.returncode in HEAP_CORRUPTION_RCS and lines:
+        try:
+            # completed-then-crashed-at-exit: the printed result is valid
+            return json.loads(lines[-1])
+        except ValueError:
+            # crashed MID-print: a truncated result line is still the
+            # corruption signature, not a test failure
+            pytest.skip(
+                "known heap corruption truncated the subprocess result "
+                f"(rc={proc.returncode}): {proc.stderr[-200:]}"
+            )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert lines, f"script printed no result line; stderr: {proc.stderr}"
+    return json.loads(lines[-1])

@@ -266,50 +266,75 @@ def test_checkpoint_roundtrip_bucketed(tmp_path):
     """Checkpoint restore is a cache-rebuild point: a bucketed sim resumed
     from a snapshot must finish with the same digest as an uninterrupted
     run, and a flat-queue checkpoint must not restore into a bucketed sim
-    (different engine config => guard refuses)."""
-    from shadow_tpu.config.options import ConfigOptions
-    from shadow_tpu.core.checkpoint import (
-        CheckpointError,
-        load_checkpoint,
-        save_checkpoint,
-    )
-    from shadow_tpu.sim import Simulation
+    (different engine config => guard refuses). Runs in a subprocess
+    (tests/subproc.py): this is a compiled-Simulation leg, the shape that
+    intermittently heap-corrupts in-process on this box."""
+    from tests.subproc import run_isolated_json
 
-    def cfg(block=4):
-        return ConfigOptions.from_dict({
-            "general": {"stop_time": "4 s", "seed": 17},
-            "network": {"graph": {"type": "1_gbit_switch"}},
-            "experimental": {"event_queue_capacity": 16,
-                             "event_queue_block": block},
-            "hosts": {
-                "n": {
-                    "count": 8,
-                    "network_node_id": 0,
-                    "processes": [{
-                        "model": "phold",
-                        "model_args": {"population": 2,
-                                       "mean_delay": "100 ms"},
-                    }],
-                }
-            },
-        })
+    out = run_isolated_json('''
+import json, sys
+import numpy as np
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.core.checkpoint import (
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from shadow_tpu.ops import as_flat, block_minima
+from shadow_tpu.sim import Simulation
+from shadow_tpu.simtime import TIME_MAX
 
-    a = Simulation(cfg(), world=1)
-    a.run(progress=False)
-    digest_a = a.stats_report()["determinism_digest"]
 
-    b = Simulation(cfg(), world=1)
-    b.state = b.engine.run_chunk(b.state, b.params)
-    assert not bool(b.state.done)
-    ckpt = str(tmp_path / "bq.npz")
-    save_checkpoint(ckpt, b)
+def cfg(block=4):
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": "4 s", "seed": 17},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_queue_capacity": 16,
+                         "event_queue_block": block},
+        "hosts": {
+            "n": {
+                "count": 8,
+                "network_node_id": 0,
+                "processes": [{
+                    "model": "phold",
+                    "model_args": {"population": 2,
+                                   "mean_delay": "100 ms"},
+                }],
+            }
+        },
+    })
 
-    c = Simulation(cfg(), world=1)
-    load_checkpoint(ckpt, c)
-    assert_caches_coherent(c.state.queue, "after restore")
-    c.run(progress=False)
-    assert c.stats_report()["determinism_digest"] == digest_a
 
-    d = Simulation(cfg(block=8), world=1)  # different layout: refuse loudly
-    with pytest.raises(CheckpointError):
-        load_checkpoint(ckpt, d)
+a = Simulation(cfg(), world=1)
+a.run(progress=False)
+digest_a = a.stats_report()["determinism_digest"]
+
+b = Simulation(cfg(), world=1)
+b.state = b.engine.run_chunk(b.state, b.params)
+assert not bool(b.state.done)
+ckpt = sys.argv[1]
+save_checkpoint(ckpt, b)
+
+c = Simulation(cfg(), world=1)
+load_checkpoint(ckpt, c)
+# restored caches must match a from-scratch rebuild (the in-process
+# assert_caches_coherent helper, inlined here)
+q = c.state.queue
+bt, bo, bfill = block_minima(q.t, q.order, q.bt.shape[1])
+assert (np.asarray(q.bt) == np.asarray(bt)).all()
+assert (np.asarray(q.bo) == np.asarray(bo)).all()
+assert (np.asarray(q.bfill) == np.asarray(bfill)).all()
+c.run(progress=False)
+digest_c = c.stats_report()["determinism_digest"]
+
+d = Simulation(cfg(block=8), world=1)  # different layout: refuse loudly
+refused = False
+try:
+    load_checkpoint(ckpt, d)
+except CheckpointError:
+    refused = True
+print(json.dumps({"digest_a": digest_a, "digest_c": digest_c,
+                  "refused": refused}))
+''', str(tmp_path / "bq.npz"))
+    assert out["digest_c"] == out["digest_a"]
+    assert out["refused"]

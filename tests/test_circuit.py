@@ -1,15 +1,22 @@
 """Circuit model (Tor-like 3-hop relay chains, BASELINE config 4 workload;
-reference: src/test/tor/minimal)."""
+reference: src/test/tor/minimal).
+
+The compiled-`Simulation` legs run in subprocesses via tests/subproc.py:
+this box's jaxlib intermittently heap-corrupts inside compiled Simulation
+runs (rc 134/139 with no output), and an in-process abort would take the
+whole pytest run down with it."""
 
 from __future__ import annotations
 
 import pytest
 
-from shadow_tpu.config.options import ConfigOptions
 from shadow_tpu.sim import Simulation
+from tests.subproc import run_isolated_json
 
-
+_CFG_SRC = '''
 def _cfg(n_relays=6, n_clients=4, stop="5 s", seed=11, sched="tpu"):
+    from shadow_tpu.config.options import ConfigOptions
+
     return ConfigOptions.from_dict(
         {
             "general": {"stop_time": stop, "seed": seed},
@@ -41,15 +48,27 @@ graph [ directed 0
             },
         }
     )
+'''
+
+
+def _cfg(n_relays=6, n_clients=4, stop="5 s", seed=11, sched="tpu"):
+    ns: dict = {}
+    exec(_CFG_SRC, ns)  # one config source for in- and out-of-process legs
+    return ns["_cfg"](n_relays, n_clients, stop, seed, sched)
 
 
 def test_cells_complete_round_trips():
-    sim = Simulation(_cfg(), world=1)
-    r = sim.run(progress=False)
-    m = r["model_report"]
+    out = run_isolated_json(_CFG_SRC + '''
+import json
+from shadow_tpu.sim import Simulation
+
+r = Simulation(_cfg(), world=1).run(progress=False)
+print(json.dumps(r))
+''')
+    m = out["model_report"]
     assert m["cells_completed"] > 0
     # 6 wire hops per completed cell (3 out + 3 back)
-    assert r["packets_delivered"] >= m["cells_completed"] * 6
+    assert out["packets_delivered"] >= m["cells_completed"] * 6
     # RTT >= 6 x 20 ms wire + 5 relay processing delays (2 ms each)
     assert m["mean_rtt_ms"] >= 6 * 20 + 5 * 2 - 1
     # every forward was charged a processing delay first
@@ -57,21 +76,36 @@ def test_cells_complete_round_trips():
 
 
 def test_matches_golden_oracle():
-    dev = Simulation(_cfg(seed=3), world=1).run(progress=False)
-    gold = Simulation(_cfg(seed=3, sched="cpu-reference"), world=1).run(
-        progress=False
-    )
+    out = run_isolated_json(_CFG_SRC + '''
+import json
+from shadow_tpu.sim import Simulation
+
+dev = Simulation(_cfg(seed=3), world=1).run(progress=False)
+gold = Simulation(_cfg(seed=3, sched="cpu-reference"), world=1).run(
+    progress=False
+)
+print(json.dumps({"dev": dev, "gold": gold}))
+''')
+    dev, gold = out["dev"], out["gold"]
     assert dev["determinism_digest"] == gold["determinism_digest"]
     assert dev["model_report"] == gold["model_report"]
 
 
 def test_mesh_invariant():
-    a = Simulation(_cfg(seed=5), world=1).run(progress=False)
-    b = Simulation(_cfg(seed=5), world=8).run(progress=False)
+    out = run_isolated_json(_CFG_SRC + '''
+import json
+from shadow_tpu.sim import Simulation
+
+a = Simulation(_cfg(seed=5), world=1).run(progress=False)
+b = Simulation(_cfg(seed=5), world=8).run(progress=False)
+print(json.dumps({"a": a, "b": b}))
+''')
+    a, b = out["a"], out["b"]
     assert a["determinism_digest"] == b["determinism_digest"]
     assert a["model_report"] == b["model_report"]
 
 
 def test_needs_three_relays():
+    # config validation raises before any compiled chunk runs: in-process
     with pytest.raises(Exception, match="3 relay"):
         Simulation(_cfg(n_relays=2), world=1)

@@ -159,12 +159,11 @@ def test_fresh_tracer_adopts_ring_cursor():
     assert b.rows().shape[1] == 0
 
 
-# the two Simulation legs of the smoke test, run in a SUBPROCESS: compiled
-# `Simulation` runs intermittently hit this box's documented jaxlib-0.4.37
-# heap corruption (malloc_consolidate/SIGABRT — see CHANGES.md PR 1/2 env
-# notes, same signature as the seed tier-1), and an in-process abort would
-# kill the whole pytest run. The engine-harness matrix above is the primary
-# gate and is stable in-process; this leg gates the DRIVER wiring.
+# the two Simulation legs of the smoke test run in a SUBPROCESS via
+# tests/subproc.py (the shared isolation helper for this box's documented
+# jaxlib-0.4.37 heap corruption in compiled Simulation runs). The
+# engine-harness matrix above is the primary gate and is stable in-process;
+# this leg gates the DRIVER wiring.
 _SMOKE_SCRIPT = """
 import json, sys
 from shadow_tpu.config.options import ConfigOptions
@@ -205,23 +204,11 @@ def test_simulation_trace_smoke(tmp_path):
     tracing on exports a valid Chrome trace with one round record per
     completed round, digests match the untraced run, and
     tools/trace_summary.py consumes the file."""
-    repo = os.path.join(os.path.dirname(__file__), "..")
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=os.pathsep.join(
-                   [repo, os.environ.get("PYTHONPATH", "")]))
-    proc = subprocess.run(
-        [sys.executable, "-c", _SMOKE_SCRIPT,
-         str(tmp_path / "off"), str(tmp_path / "on")],
-        capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+    from tests.subproc import run_isolated_json
+
+    reps = run_isolated_json(
+        _SMOKE_SCRIPT, str(tmp_path / "off"), str(tmp_path / "on")
     )
-    if proc.returncode in (134, 139, -6, -11) and not proc.stdout.strip():
-        pytest.skip(
-            "known jaxlib-0.4.37 heap corruption in compiled Simulation "
-            "runs on this box (malloc_consolidate SIGABRT/SIGSEGV, "
-            f"CHANGES.md env notes): {proc.stderr[-200:]}"
-        )
-    assert proc.returncode == 0, proc.stderr
-    reps = json.loads(proc.stdout.strip().splitlines()[-1])
     rep_off, rep_on = reps["off"], reps["on"]
 
     assert rep_on["determinism_digest"] == rep_off["determinism_digest"]
@@ -322,4 +309,17 @@ def test_heartbeat_regex_old_and_new():
            "msteps/round=3.0 ev/mstep=3.33 ratio=0.40x rss_gib=1.00")
     m = HEARTBEAT_RE.search(old)
     assert m and m.group("ici_bytes") is None
+    assert m.group("gear") is None
     assert m.group("ratio") == "0.40"
+    # PR 4 adaptive-exchange field: gear= rides between q_hwm and ratio on
+    # merge_gears runs; lines without it (above) must keep parsing
+    geared = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+              "msteps/round=3.0 ev/mstep=3.33 ici_bytes=4096 q_hwm=7 "
+              "gear=2 ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(geared)
+    assert m and m.group("gear") == "2" and m.group("q_hwm") == "7"
+    # the hybrid driver's windows= form carries gear= too
+    hybrid = ("[heartbeat] sim_time=1.000s wall=2.50s windows=10 "
+              "gear=4 ratio=0.40x")
+    m = HEARTBEAT_RE.search(hybrid)
+    assert m and m.group("gear") == "4" and m.group("windows") == "10"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# One-command tier-1 runner — the EXACT verify line from ROADMAP.md, so
+# builders and CI invoke the gate identically (pipefail, the CPU backend
+# pin, the plugin opt-outs, and the DOTS_PASSED count that survives the
+# known jaxlib heap-corruption aborts on some boxes: a corrupted worker
+# can kill pytest's summary, but the dot lines it already streamed still
+# count what passed).
+#
+# Usage: tools/check_tier1.sh [extra pytest args...]
+#   e.g. tools/check_tier1.sh -k gears
+# Exit code is pytest's; DOTS_PASSED=<n> is printed last either way.
+set -o pipefail
+cd "$(dirname "$0")/.."
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 "${TIER1_TIMEOUT:-870}" \
+  env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+  -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+exit $rc

@@ -25,6 +25,8 @@ HEARTBEAT_RE = re.compile(
     # PR 3 observability fields; optional so pre-PR-3 logs still parse
     r"(?:ici_bytes=(?P<ici_bytes>\d+) )?"
     r"(?:q_hwm=(?P<q_hwm>\d+) )?"
+    # PR 4 adaptive-exchange field (only emitted on merge_gears runs)
+    r"(?:gear=(?P<gear>\d+) )?"
     r"ratio=(?P<ratio>[\d.]+)x"
     r"(?: rss_gib=(?P<rss_gib>[\d.]+))?"
     r"(?: utime_min=(?P<utime_min>[\d.]+))?"
