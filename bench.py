@@ -13,7 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "events",
 tracked since round 1 as a secondary continuity metric.
 
 Usage: python bench.py                    (full: TPU + CPU-subprocess baseline)
-       python bench.py --config N [--cpu] (one BASELINE config, 1-6)
+       python bench.py --config N [--cpu] (one BASELINE config, 1-7)
        python bench.py --self [--cpu]     (bare PHOLD ratio, prints a float)
 """
 
@@ -133,6 +133,9 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
     5: 1M-host timer-only                         (sort + barrier stress)
     6: 10k-host tgen-TCP all-to-all on the torus  (THE north-star workload:
        bulk Reno TCP flows between every host pair, BASELINE.json target)
+    7: PHOLD under host churn + a lossy window    (fault-plane robustness:
+       crash/restart masks, fault loss draws, and the run supervisor's
+       periodic snapshots all inside the measured loop)
     """
     if n == 1:
         hosts = 64 if small else 1000
@@ -357,7 +360,45 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
             "hosts": host_groups,
         }
         return cfg, "tgen_tcp_10k_torus_sim_seconds_per_wall_second", 120
-    raise SystemExit(f"unknown --config {n} (1-6 supported)")
+    if n == 7:
+        # fault-plane bench (PR 5): the PHOLD workload with ~30% of hosts
+        # crash-restarting mid-run (queue-hold), a mid-run lossy/slow
+        # window, and the crash-resilient supervisor snapshotting every 4
+        # chunks. Measures what robustness costs on the steady-state round
+        # loop: the up/down mask adds one [H, W] pass per microstep, the
+        # fault window one draw per send, the supervisor one device copy
+        # per 4 chunks. BENCH counters carry faults_dropped/faults_delayed
+        # + the supervisor's snapshot/retry counts.
+        hosts = 256 if small else 4096
+        cfg = {
+            "general": {"stop_time": "30 s", "seed": 1},
+            "network": {"graph": {"type": "gml", "inline": PHOLD_GML}},
+            "experimental": {"event_queue_capacity": 16,
+                             "sends_per_host_round": 6,
+                             "rounds_per_chunk": 128},
+            "faults": {
+                "seed": 7,
+                "restart_queue": "hold",
+                "host_churn": {"prob": 0.3, "mean_downtime": "2 s"},
+                "loss_windows": [{"start": "10 s", "end": "15 s",
+                                  "loss": 0.2, "latency_factor": 1.5}],
+                "supervisor": {"snapshot_every_chunks": 4},
+            },
+            "hosts": {
+                "node": {
+                    "count": hosts,
+                    "network_node_id": 0,
+                    "processes": [{
+                        "model": "phold",
+                        "model_args": {"population": 2,
+                                       "mean_delay": "200 ms",
+                                       "size_bytes": 64},
+                    }],
+                }
+            },
+        }
+        return cfg, "phold_churn_sim_seconds_per_wall_second", 30
+    raise SystemExit(f"unknown --config {n} (1-7 supported)")
 
 
 def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
@@ -396,8 +437,22 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     gearctl = GearController(sim._gear_ladder) if sim._gear_ladder else None
     ob_hwm_run = 0  # run-wide outbox high-water (gear runs reset the
     # device counter per chunk, so the run max is folded host-side)
+    # crash-resilient supervisor (PR 5): when the config opts in, chunks
+    # dispatch through the same snapshot/retry loop the Simulation driver
+    # uses, so the BENCH row measures robustness-on (and carries the
+    # snapshot/retry counts in `counters.supervisor`)
+    sup = None
+    if cfg.faults.supervisor.enabled:
+        from shadow_tpu.core.supervisor import ChunkSupervisor, SupervisorAbort
 
-    def step(state):
+        sup = ChunkSupervisor(
+            snapshot_every_chunks=cfg.faults.supervisor.snapshot_every_chunks,
+            max_retries=cfg.faults.supervisor.max_retries,
+            backoff_base_s=cfg.faults.supervisor.backoff_base_ms / 1000.0,
+        )
+        sup.note_state(state)
+
+    def _step_raw(state):
         nonlocal ob_hwm_run
         if gearctl is None:
             state = engine.run_chunk(state, params)
@@ -412,6 +467,24 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         state, _, hwm = run_adaptive_chunk(gearctl, state, dispatch)
         ob_hwm_run = max(ob_hwm_run, hwm)
         return state
+
+    sup_aborted = False
+
+    def step(state):
+        nonlocal sup_aborted
+        if sup is None:
+            return _step_raw(state)
+        try:
+            return sup.run_chunk(state, _step_raw)
+        except SupervisorAbort as e:
+            # same graceful-abort contract as the drivers: the BENCH row
+            # carries the completed prefix's counters, exported from the
+            # supervisor's snapshot (abort_export_state docs the
+            # poisoned/donation rationale; supervisor.aborted flags it)
+            print(f"[supervisor] aborting bench run: {e}", file=sys.stderr)
+            sup_aborted = True
+            good = sup.abort_export_state()
+            return good if good is not None else state
 
     t0 = time.monotonic()
     build_s = t0 - t_build  # capture BEFORE t0 is reused for measurement
@@ -433,7 +506,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     sim0 = int(state.now)
     ev0 = int(jax.device_get(state.stats.events).sum())
     t0 = time.monotonic()
-    while not bool(state.done):
+    while not bool(state.done) and not sup_aborted:
         t_c = time.monotonic()
         state = step(state)
         tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
@@ -442,7 +515,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     wall = max(time.monotonic() - t0, 1e-9)
     sim_adv = (int(state.now) - sim0) / 1e9
     ev_adv = int(jax.device_get(state.stats.events).sum()) - ev0
-    if sim_adv <= 0 and ev_adv <= 0:
+    if sim_adv <= 0 and ev_adv <= 0 and not sup_aborted:
         # whole sim fit inside the compile chunk: rebuild FRESH STATE but
         # drive it with the ALREADY-COMPILED engine (a new Engine would
         # build a new jit closure and silently recompile — the "clean"
@@ -451,14 +524,26 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         sim2 = Simulation(cfg, world=1)
         state = sim2.state
         tracer = RoundTracer(sim.engine_cfg.rounds_per_chunk)  # fresh cursor
+        if sup is not None:
+            # re-arm on the FRESH state: without this, a dispatch failure
+            # in the rerun loop would restore the finished first run's
+            # near-done snapshot and the row would report its totals over
+            # the rerun's tiny wall time
+            sup.note_state(state)
         t0 = time.monotonic()
-        while not bool(state.done):
+        while not bool(state.done) and not sup_aborted:
             t_c = time.monotonic()
             state = step(state)
             tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
         wall = max(time.monotonic() - t0, 1e-9)
         sim_adv = int(state.now) / 1e9
         ev_adv = int(jax.device_get(state.stats.events).sum())
+    if sup_aborted:
+        # chunks that succeeded after the supervisor's snapshot were
+        # already drained, but the exported state rewound past them —
+        # drop their rows so the row's trace-derived numbers cover
+        # exactly the rewound prefix (truncate_to_round docs this)
+        tracer.truncate_to_round(int(state.stats.rounds))
     value = (ev_adv / wall) if "events_per" in metric else (sim_adv / wall)
     # event-density telemetry (the K-way microstep's target): how many
     # dispatches a round serializes into, and how many events each
@@ -491,6 +576,13 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
                 int(_np.asarray(s.outbox_hwm).max()), ob_hwm_run
             ),
             "rounds_per_chunk": tracer.summary()["rounds_per_chunk"],
+            # fault-plane counters (PR 5): zero on fault-free configs,
+            # the robustness evidence on config 7
+            "faults_dropped": int(_np.asarray(s.faults_dropped).sum()),
+            "faults_delayed": int(_np.asarray(s.faults_delayed).sum()),
+            **(
+                {"supervisor": sup.report()} if sup is not None else {}
+            ),
             # gear histogram (adaptive-exchange runs): accepted chunks per
             # gear from the controller, rounds per gear from the trace
             # ring — the low-occupancy acceptance evidence
@@ -503,6 +595,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         },
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
+        **({"aborted": True} if sup_aborted else {}),
     }
 
 

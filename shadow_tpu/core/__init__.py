@@ -15,12 +15,19 @@ from shadow_tpu.core.engine import (
     Stats,
     Outbox,
 )
+from shadow_tpu.core.faults import FaultParams, FaultSchedule, compile_faults
+from shadow_tpu.core.supervisor import ChunkSupervisor, SupervisorAbort
 
 __all__ = [
+    "ChunkSupervisor",
     "Engine",
     "EngineConfig",
     "EngineParams",
+    "FaultParams",
+    "FaultSchedule",
+    "Outbox",
     "SimState",
     "Stats",
-    "Outbox",
+    "SupervisorAbort",
+    "compile_faults",
 ]

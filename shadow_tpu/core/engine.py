@@ -100,6 +100,17 @@ from shadow_tpu.obs.tracer import (
     TraceRing,
     make_trace_ring,
 )
+from shadow_tpu.obs.tracer import (
+    COL_FAULTS_DELAYED,
+    COL_FAULTS_DROPPED,
+    COL_HOSTS_DOWN,
+)
+from shadow_tpu.core.faults import (
+    FaultParams,
+    LAT_SCALE,
+    down_and_resume,
+    window_effects,
+)
 from shadow_tpu.ops.events import unpack_order_src
 from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
 from shadow_tpu.ops.rng import RngState, rng_init, rng_uniform
@@ -157,6 +168,14 @@ class Stats(NamedTuple):
     pkts_delivered: Array  # i64[H]
     monotonic_violations: Array  # i64[H] pushes scheduled in the past
     pkts_budget_dropped: Array  # i64[H] over the per-host round send budget
+    # fault plane (core/faults.py): events/packets discarded by an injected
+    # fault — queue-clear crash drops (charged to the down host) plus
+    # link-fault-window packet loss (charged to the sender). Distinct from
+    # pkts_lost so a faulty run's excess loss is attributable.
+    faults_dropped: Array  # i64[H]
+    # events deferred to a crash restart (queue-hold) plus packets whose
+    # latency a fault window inflated (charged to the sender)
+    faults_delayed: Array  # i64[H]
     ob_dropped: Array  # i64[1] outbox-overflow losses (invariant check: always 0)
     a2a_shed: Array  # i64[1] all-to-all block-overflow losses (size blocks so 0)
     microsteps: Array  # i64[1] total microsteps (per shard)
@@ -239,6 +258,11 @@ class EngineParams(NamedTuple):
     lat_rows: Any = None  # i64[H_total, N] | None
     loss_rows: Any = None  # f32[H_total, N] | None
     jit_rows: Any = None  # i64[H_total, N] | None
+    # compiled fault schedule (core/faults.py FaultParams): per-host crash
+    # windows sharded over the mesh, link-fault windows replicated. None
+    # when the `faults:` block is absent — the engine then traces no fault
+    # code at all and the program is bit-identical to the fault-free build.
+    faults: Any = None  # FaultParams | None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -350,6 +374,17 @@ class EngineConfig:
     # already computes; scheduling never reads them, so digests, events,
     # and drop counters are bit-identical on or off (tests/test_tracer.py).
     trace_rounds: int = 0
+    # Fault plane statics (core/faults.py; config `faults:`). The ARRAYS
+    # live in EngineParams.faults; these are the trace-time shape/policy
+    # knobs the round body specializes on. All 0/False = no fault code
+    # traced in (the program is bit-identical to the fault-free engine).
+    fault_crash_windows: int = 0  # W: max up/down windows per host
+    fault_loss_windows: int = 0  # L: link-fault (loss/latency) windows
+    # crashed-host queue policy: False = "hold" (pending events defer to
+    # the restart time, the CPU-model busy-floor mechanics), True =
+    # "clear" (events whose execution time falls in a down window are
+    # dropped and counted in stats.faults_dropped)
+    fault_queue_clear: bool = False
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -394,6 +429,11 @@ class EngineConfig:
                 f"[0, sends_per_host_round={self.sends_per_host_round}] "
                 f"(0 = full width)"
             )
+        if self.fault_crash_windows < 0 or self.fault_loss_windows < 0:
+            raise ValueError(
+                f"fault window counts must be >= 0, got crash="
+                f"{self.fault_crash_windows} loss={self.fault_loss_windows}"
+            )
 
     @property
     def a2a_block_size(self) -> int:
@@ -434,6 +474,23 @@ class EngineConfig:
     def effective_gear_cols(self) -> int:
         """The merge width actually in force (0 resolves to full width)."""
         return self.gear_cols or self.sends_per_host_round
+
+    @property
+    def faults_active(self) -> bool:
+        """True iff any fault plumbing is traced into the round body."""
+        return self.fault_crash_windows > 0 or self.fault_loss_windows > 0
+
+    @property
+    def fault_hold(self) -> bool:
+        """Crash windows with queue-HOLD semantics: down hosts' events
+        defer to the restart time (execution-time floor)."""
+        return self.fault_crash_windows > 0 and not self.fault_queue_clear
+
+    @property
+    def fault_clear(self) -> bool:
+        """Crash windows with queue-CLEAR semantics: events executing
+        while down are popped and dropped (stats.faults_dropped)."""
+        return self.fault_crash_windows > 0 and self.fault_queue_clear
 
     @property
     def gear_active(self) -> bool:
@@ -477,6 +534,8 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         pkts_delivered=zi(),
         monotonic_violations=zi(),
         pkts_budget_dropped=zi(),
+        faults_dropped=zi(),
+        faults_delayed=zi(),
         ob_dropped=jnp.zeros((cfg.world,), jnp.int64),
         a2a_shed=jnp.zeros((cfg.world,), jnp.int64),
         microsteps=jnp.zeros((cfg.world,), jnp.int64),
@@ -750,6 +809,8 @@ class Engine:
                 pkts_delivered=sh,
                 monotonic_violations=sh,
                 pkts_budget_dropped=sh,
+                faults_dropped=sh,
+                faults_delayed=sh,
                 ob_dropped=sh,
                 a2a_shed=sh,
                 microsteps=sh,
@@ -771,6 +832,21 @@ class Engine:
     def param_specs(self):
         sh, rep = P(AXIS), P()
         rows = sh if getattr(self, "_has_rows", False) else None
+        # fault schedule: crash windows are per-host (sharded), the
+        # link-fault windows are global (replicated). Mirrors the None
+        # structure of EngineParams.faults exactly.
+        faults = None
+        if self.cfg.faults_active:
+            cw = self.cfg.fault_crash_windows > 0
+            lw = self.cfg.fault_loss_windows > 0
+            faults = FaultParams(
+                down_t=sh if cw else None,
+                up_t=sh if cw else None,
+                win_start=rep if lw else None,
+                win_end=rep if lw else None,
+                win_loss=rep if lw else None,
+                win_lat=rep if lw else None,
+            )
         return EngineParams(
             node_of=rep,
             lat_ns=rep,
@@ -782,6 +858,7 @@ class Engine:
             lat_rows=rows,
             loss_rows=rows,
             jit_rows=rows,
+            faults=faults,
         )
 
     # ---- initialization ----------------------------------------------------
@@ -796,6 +873,13 @@ class Engine:
         """Returns (state, params) — params come back re-device_put with the
         mesh sharding when running multi-device; always use the returned pair."""
         cfg = self.cfg
+        if (params.faults is not None) != cfg.faults_active:
+            raise ValueError(
+                "EngineParams.faults must be provided iff the EngineConfig "
+                "declares fault windows (fault_crash_windows/"
+                "fault_loss_windows) — build both from one FaultSchedule "
+                "(core/faults.compile_faults)"
+            )
         self._model_state_spec_tree = self._model_specs(model_state)
         self._model_param_spec_tree = self._model_specs(params.model)
         n_nodes = params.lat_ns.shape[0]
@@ -926,7 +1010,9 @@ def _run_guarded_chunk(
 
     def cond(carry):
         stc, i = carry
-        gmin = _pmin(jnp.min(_effective_next(cfg, stc)), axis)
+        gmin = _pmin(
+            jnp.min(_effective_next(cfg, stc, _hold_faults(cfg, params))), axis
+        )
         probe = stop_probe(stc.model)
         if axis:
             # the probe sees only the LOCAL shard's model state; the loop
@@ -952,9 +1038,9 @@ def _run_guarded_chunk(
     return state
 
 
-def _compute_window(cfg: EngineConfig, axis, st: SimState):
+def _compute_window(cfg: EngineConfig, axis, st: SimState, faults=None):
     """Barrier + window (controller.rs:88-112): (window_end, done)."""
-    lmin = jnp.min(_effective_next(cfg, st))
+    lmin = jnp.min(_effective_next(cfg, st, faults))
     gmin = _pmin(lmin, axis)
     done = gmin >= cfg.stop_time  # TIME_MAX (empty everywhere) implies done
     gmin_safe = jnp.minimum(gmin, cfg.stop_time)
@@ -968,7 +1054,7 @@ def _compute_window(cfg: EngineConfig, axis, st: SimState):
 
 
 def _round_step(cfg: EngineConfig, model, axis, st: SimState, params: EngineParams):
-    window_end, done = _compute_window(cfg, axis, st)
+    window_end, done = _compute_window(cfg, axis, st, _hold_faults(cfg, params))
     return _window_step(cfg, model, axis, st, params, window_end, done)
 
 
@@ -979,7 +1065,7 @@ def _round_step_capture(
     sent this round, for host-side pcap synthesis (the modeled-sim analogue
     of the reference's per-interface capture, network_interface.c). One
     dispatch per round: capture runs trade throughput for observability."""
-    window_end, done = _compute_window(cfg, axis, st)
+    window_end, done = _compute_window(cfg, axis, st, _hold_faults(cfg, params))
     return _window_step(
         cfg, model, axis, st, params, window_end, done, capture=True
     )
@@ -1018,9 +1104,10 @@ def _window_step(
 
         def micro_cond(carry):
             stc, valve, steps = carry
-            return jnp.any(_effective_next(cfg, stc) < window_end) & (
-                jnp.max(valve) < cfg.effective_microstep_limit
-            )
+            return jnp.any(
+                _effective_next(cfg, stc, _hold_faults(cfg, params))
+                < window_end
+            ) & (jnp.max(valve) < cfg.effective_microstep_limit)
 
         def micro_body(carry):
             stc, valve, steps = carry
@@ -1038,9 +1125,10 @@ def _window_step(
     else:
         def micro_cond(carry):
             stc, steps = carry
-            return jnp.any(_effective_next(cfg, stc) < window_end) & (
-                steps < cfg.effective_microstep_limit
-            )
+            return jnp.any(
+                _effective_next(cfg, stc, _hold_faults(cfg, params))
+                < window_end
+            ) & (steps < cfg.effective_microstep_limit)
 
         def micro_body(carry):
             stc, steps = carry
@@ -1080,7 +1168,8 @@ def _window_step(
     if cfg.trace_rounds:
         out = out._replace(
             trace=_trace_round(
-                cfg, st, st_m, st_x, window_end, done, steps, occ, ob_hwm
+                cfg, st, st_m, st_x, window_end, done, steps, occ, ob_hwm,
+                params.faults,
             )
         )
     if capture:
@@ -1090,7 +1179,7 @@ def _window_step(
 
 def _trace_round(
     cfg: EngineConfig, st0: SimState, st_m: SimState, st_x: SimState,
-    window_end, done, steps, occ, ob_hwm,
+    window_end, done, steps, occ, ob_hwm, faults=None,
 ):
     """Append this round's record to the in-scan trace ring.
 
@@ -1124,6 +1213,19 @@ def _trace_round(
     vals[COL_NEXT_TIME] = jnp.min(q_next_time(st_x.queue))
     vals[COL_OB_HWM] = ob_hwm
     vals[COL_GEAR] = jnp.asarray(cfg.effective_gear_cols, jnp.int64)
+    if cfg.faults_active:
+        vals[COL_FAULTS_DROPPED] = jnp.sum(
+            st_x.stats.faults_dropped - st0.stats.faults_dropped
+        )
+        vals[COL_FAULTS_DELAYED] = jnp.sum(
+            st_x.stats.faults_delayed - st0.stats.faults_delayed
+        )
+    if cfg.fault_crash_windows and faults is not None:
+        h = st_x.queue.t.shape[0]
+        down, _ = down_and_resume(
+            faults, jnp.broadcast_to(window_end, (h,))
+        )
+        vals[COL_HOSTS_DOWN] = jnp.sum(down, dtype=jnp.int64)
     row = jnp.stack([jnp.asarray(v, jnp.int64) for v in vals])
     idx = (ring.cursor[0] % cfg.trace_rounds).astype(jnp.int32)
     written = lax.dynamic_update_slice(
@@ -1136,14 +1238,26 @@ def _trace_round(
     )
 
 
-def _effective_next(cfg: EngineConfig, st: SimState):
+def _hold_faults(cfg: EngineConfig, params: EngineParams):
+    """The fault schedule iff queue-HOLD crash semantics are in force —
+    the only fault mode that floors next-event times (clear mode drops at
+    pop and never defers)."""
+    return params.faults if cfg.fault_hold else None
+
+
+def _effective_next(cfg: EngineConfig, st: SimState, faults=None):
     """Per-host next *executable* time: queue head, floored by the CPU
     model's busy horizon (a busy host keeps its events queued — order
     intact — and resumes at busy_until, exactly the reference's CPU-delay
-    rescheduling, host.rs:820-847)."""
+    rescheduling, host.rs:820-847) and, under queue-hold crash faults, by
+    the host's restart time (a down host's events defer to its up_t —
+    same mechanics, different clock)."""
     nt = q_next_time(st.queue)
     if cfg.cpu_delay_ns > 0:
         nt = jnp.where(nt == TIME_MAX, nt, jnp.maximum(nt, st.cpu_busy_until))
+    if faults is not None:
+        _, resume = down_and_resume(faults, nt)
+        nt = jnp.where(nt == TIME_MAX, nt, jnp.maximum(nt, resume))
     return nt
 
 
@@ -1275,6 +1389,20 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
     # are deferred and applied in one slab pass after the loop.
     entries = []  # (send_ok, col, dst, arrive, order, kind, payload)
     used_lats = []
+    if cfg.fault_loss_windows:
+        # link-fault windows active at this event's time: one [H, L] pass
+        # per event, shared by every port/segment below. Loss draws come
+        # from the per-host masked-advance RNG lanes (mesh-shape
+        # invariant); inflation is integer x1000 math so the arrive time
+        # is bit-reproducible. Inflation can only GROW latency
+        # (latency_factor >= 1.0 is validated at config parse), so the
+        # conservative-lookahead bound — which uses the pre-inflation
+        # minimum — stays valid.
+        f_loss, f_lat = window_effects(params.faults, ev.t)
+        # inflation honors bootstrap_end_time like the loss side of the
+        # same window: bootstrap-phase traffic stays undisturbed (and
+        # uncounted in faults_delayed)
+        f_inflate = (f_lat > LAT_SCALE) & (ev.t >= cfg.bootstrap_end_time)
     for s in out.sends:
         cmax = int(getattr(s, "count_max", 1) or 1)
         mask0 = s.mask & dispatch
@@ -1365,8 +1493,31 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
             unreachable = mask & ((lat_bound0 < 0) | bad_dst)
             rng, u = rng_uniform(rng, mask)
             lost = mask & (u < lossp) & (ev.t >= cfg.bootstrap_end_time)
+            if cfg.fault_loss_windows:
+                # fault loss draws AFTER the path-loss draw (stable
+                # position in the per-host stream) and honors the same
+                # bootstrap gate; precedence: path loss > unreachable >
+                # fault loss > budget, each counted exactly once
+                rng, uf = rng_uniform(rng, mask)
+                flost = (
+                    mask & ~lost & ~unreachable & (uf < f_loss)
+                    & (ev.t >= cfg.bootstrap_end_time)
+                )
+                lat_j = jnp.where(
+                    f_inflate, (lat_j * f_lat) // LAT_SCALE, lat_j
+                )
+            else:
+                flost = None
             send_ok = mask & ~lost & ~unreachable & ~over_budget
             budget_dropped = mask & ~lost & ~unreachable & over_budget
+            if flost is not None:
+                send_ok = send_ok & ~flost
+                budget_dropped = budget_dropped & ~flost
+                stats = stats._replace(
+                    faults_dropped=stats.faults_dropped + flost,
+                    faults_delayed=stats.faults_delayed
+                    + (send_ok & f_inflate),
+                )
             ob_col = sent_round  # lane column (cursor pre-increment)
             sent_round = sent_round + send_ok.astype(jnp.int32)
             # conservative-PDES clamp (worker.rs:411-414): never before
@@ -1439,28 +1590,65 @@ def _finish_microstep(st: SimState, c: _EvCarry, queue, ob_entries, used_lats):
 def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     """The single-event microstep (microstep_events = 1): pop each host's
     earliest event, execute, apply pushes and appends."""
+    # execution-time floor: the CPU model's busy horizon and/or the fault
+    # plane's queue-hold restart time. A host floored past the window does
+    # not pop at all; events stay in the queue so their (time, order)
+    # sequence is preserved verbatim. An event popped while the floor is
+    # *within* the window executes at the floor (host.rs:820-847 for the
+    # CPU case; a crash restart is the same mechanics on a different
+    # clock): rewrite ev.t to the execution time so every downstream
+    # consumer (handler ctx, digest, pushes, egress departure) sees the
+    # delayed clock, never a stale one. Both the floor and ev.t are
+    # < window_end here, so the execution time stays inside the window.
+    floor = None
+    down_h = None
     if cfg.cpu_delay_ns > 0:
-        # a host busy past the window does not pop at all; events stay in
-        # the queue so their (time, order) sequence is preserved verbatim.
-        # An event popped while the CPU is busy *within* the window executes
-        # at busy_until (host.rs:820-847): rewrite ev.t to the execution
-        # time so every downstream consumer (handler ctx, digest, pushes,
-        # egress departure) sees the delayed clock, never a stale one.
-        # Both busy_until and ev.t are < window_end here, so the execution
-        # time stays inside the window.
-        limit_h = jnp.where(
-            st.cpu_busy_until < window_end, window_end, jnp.int64(0)
-        )
+        floor = st.cpu_busy_until
+    if cfg.fault_hold:
+        # the down check reads the BUSY-FLOORED head time — the candidate
+        # execution time — not the raw queue head: a CPU-delayed event
+        # whose busy horizon lands inside a down window must defer to the
+        # restart exactly as _effective_next (the barrier's view) says it
+        # will. TIME_MAX heads stay TIME_MAX through the maximum.
+        ht = q_next_time(st.queue)
+        if floor is not None:
+            ht = jnp.maximum(ht, floor)
+        down_h, resume_h = down_and_resume(params.faults, ht)
+        floor = resume_h if floor is None else jnp.maximum(floor, resume_h)
+    if floor is not None:
+        limit_h = jnp.where(floor < window_end, window_end, jnp.int64(0))
         queue, ev, active = q_pop_min(st.queue, limit_h)
-        exec_t = jnp.maximum(ev.t, st.cpu_busy_until)
+        exec_t = jnp.maximum(ev.t, floor)
         ev = ev._replace(t=jnp.where(active, exec_t, ev.t))
-        st = st._replace(
-            cpu_busy_until=jnp.where(
-                active, exec_t + cfg.cpu_delay_ns, st.cpu_busy_until
+        if cfg.cpu_delay_ns > 0:
+            st = st._replace(
+                cpu_busy_until=jnp.where(
+                    active, exec_t + cfg.cpu_delay_ns, st.cpu_busy_until
+                )
             )
-        )
+        if cfg.fault_hold:
+            # events executing at a crash restart (the head was inside a
+            # down window) count as fault-delayed, charged to the host
+            st = st._replace(
+                stats=st.stats._replace(
+                    faults_delayed=st.stats.faults_delayed + (active & down_h)
+                )
+            )
     else:
         queue, ev, active = q_pop_min(st.queue, window_end)
+
+    if cfg.fault_clear:
+        # queue-clear crash semantics: an event whose execution time falls
+        # inside a down window is consumed (popped) but never dispatched —
+        # no digest, no pushes, no sends; counted, never silent
+        down_e, _ = down_and_resume(params.faults, ev.t)
+        fdrop = active & down_e
+        active = active & ~fdrop
+        st = st._replace(
+            stats=st.stats._replace(
+                faults_dropped=st.stats.faults_dropped + fdrop
+            )
+        )
 
     c, push_list, ob_entries, used_lats = _event_body(
         cfg, model, _ev_carry_of(st), params, host_gid, window_end, ev, active
@@ -1502,10 +1690,25 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
     assigned exactly as across separate microsteps."""
     k = cfg.effective_microstep_events
     h = st.queue.t.shape[0]
-    if cfg.cpu_delay_ns > 0:
-        limit = jnp.where(
-            st.cpu_busy_until < window_end, window_end, jnp.int64(0)
-        )
+    if cfg.cpu_delay_ns > 0 or cfg.fault_hold:
+        # combined execution floor at the HEAD event: CPU busy horizon
+        # and/or crash-restart time (every peeked in-window event of a
+        # down host shares the head's down window — the window extends to
+        # >= window_end whenever the head is blocked — so head-time
+        # gating is exact; within-window restarts are handled per batch
+        # event below)
+        floor0 = jnp.zeros((h,), jnp.int64)
+        if cfg.cpu_delay_ns > 0:
+            floor0 = jnp.maximum(floor0, st.cpu_busy_until)
+        if cfg.fault_hold:
+            # down check at the busy-floored head (the candidate execution
+            # time) — same rule as _microstep and _effective_next
+            _, resume0 = down_and_resume(
+                params.faults,
+                jnp.maximum(q_next_time(st.queue), floor0),
+            )
+            floor0 = jnp.maximum(floor0, resume0)
+        limit = jnp.where(floor0 < window_end, window_end, jnp.int64(0))
     else:
         limit = window_end
     popped = q_pop_k(st.queue, limit, k)
@@ -1515,21 +1718,51 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
     pm_t = jnp.full((h,), TIME_MAX, jnp.int64)  # earliest push key so far
     pm_o = jnp.full((h,), ORDER_MAX, jnp.int64)
     busy = st.cpu_busy_until
-    exec_ks = []  # [H] bool per batch index
+    fault_held = jnp.zeros((h,), jnp.int64)  # hold: events run at restart
+    fault_drop = jnp.zeros((h,), jnp.int64)  # clear: events consumed+dropped
+    cons_ks = []  # [H] bool per batch index: CONSUMED (cleared from queue)
     push_lists = []  # per batch index, K=1 chronological order
     ob_entries = []
     used_lats = []
     for j in range(k):
         ev = popped.event(j)
+        down_j = resume_j = None
+        if cfg.fault_hold:
+            # evaluated at the busy-floored event time (the candidate
+            # execution time) so a mid-batch busy horizon that lands in a
+            # down window defers exactly where K=1 would
+            t_cand = (
+                jnp.maximum(ev.t, busy) if cfg.cpu_delay_ns > 0 else ev.t
+            )
+            down_j, resume_j = down_and_resume(params.faults, t_cand)
         if j > 0:
             deferred = deferred | _lex_less(pm_t, pm_o, ev.t, ev.order)
             if cfg.cpu_delay_ns > 0:
                 deferred = deferred | (busy >= window_end)
-        exec_j = popped.active[:, j] & ~deferred
-        if cfg.cpu_delay_ns > 0:
-            exec_t = jnp.maximum(ev.t, busy)
-            ev = ev._replace(t=jnp.where(exec_j, exec_t, ev.t))
-            busy = jnp.where(exec_j, exec_t + cfg.cpu_delay_ns, busy)
+            if cfg.fault_hold:
+                # a later batch event entering a down window whose restart
+                # is past the horizon: K=1 would stop popping this host
+                deferred = deferred | (down_j & (resume_j >= window_end))
+        cons_j = popped.active[:, j] & ~deferred
+        if cfg.cpu_delay_ns > 0 or cfg.fault_hold:
+            fl = busy if cfg.cpu_delay_ns > 0 else jnp.zeros((h,), jnp.int64)
+            if cfg.fault_hold:
+                fl = jnp.maximum(fl, jnp.where(down_j, resume_j, 0))
+            exec_t = jnp.maximum(ev.t, fl)
+            ev = ev._replace(t=jnp.where(cons_j, exec_t, ev.t))
+            if cfg.cpu_delay_ns > 0:
+                busy = jnp.where(cons_j, exec_t + cfg.cpu_delay_ns, busy)
+            if cfg.fault_hold:
+                fault_held = fault_held + (cons_j & down_j)
+        exec_j = cons_j
+        if cfg.fault_clear:
+            # consumed but never dispatched — same contract as K=1. The
+            # down check reads ev.t AFTER the CPU-busy rewrite above (the
+            # EXECUTION time), exactly where the K=1 path evaluates it.
+            down_x, _ = down_and_resume(params.faults, ev.t)
+            fd = cons_j & down_x
+            fault_drop = fault_drop + fd
+            exec_j = cons_j & ~fd
         c, push_list, entries, lats = _event_body(
             cfg, model, c, params, host_gid, window_end, ev, exec_j
         )
@@ -1540,23 +1773,23 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
             better = mask & _lex_less(p_t, p_o, pm_t, pm_o)
             pm_t = jnp.where(better, p_t, pm_t)
             pm_o = jnp.where(better, p_o, pm_o)
-        exec_ks.append(exec_j)
+        cons_ks.append(cons_j)
         push_lists.append(push_list)
         ob_entries += entries
         used_lats += lats
 
-    # executed prefix length per host, and the per-push reserves
-    exec_i32 = [e.astype(jnp.int32) for e in exec_ks]
-    m = functools.reduce(jnp.add, exec_i32)  # [H] i32
+    # consumed prefix length per host, and the per-push reserves
+    cons_i32 = [e.astype(jnp.int32) for e in cons_ks]
+    m = functools.reduce(jnp.add, cons_i32)  # [H] i32
     queue = q_clear_popped(st.queue, popped, m)
     all_pushes = []
     for j, push_list in enumerate(push_lists):
         if not push_list:
             continue
-        # batch events that executed AFTER event j still held their slots
+        # batch events consumed AFTER event j still held their slots
         # when event j's pushes landed in K=1
         reserve = (
-            functools.reduce(jnp.add, exec_i32[j + 1 :])
+            functools.reduce(jnp.add, cons_i32[j + 1 :])
             if j + 1 < k
             else jnp.zeros((h,), jnp.int32)
         )
@@ -1565,11 +1798,19 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
         queue = q_push_many(queue, all_pushes)
 
     n_deferred = jnp.sum(
-        (popped.active & ~jnp.stack(exec_ks, axis=1)).astype(jnp.int64)
+        (popped.active & ~jnp.stack(cons_ks, axis=1)).astype(jnp.int64)
     )
     stats = c.stats._replace(
         popk_deferred=c.stats.popk_deferred + n_deferred[None]
     )
+    if cfg.fault_hold:
+        stats = stats._replace(
+            faults_delayed=stats.faults_delayed + fault_held
+        )
+    if cfg.fault_clear:
+        stats = stats._replace(
+            faults_dropped=stats.faults_dropped + fault_drop
+        )
     c = c._replace(stats=stats)
     if cfg.cpu_delay_ns > 0:
         st = st._replace(cpu_busy_until=busy)

@@ -1,0 +1,299 @@
+"""Deterministic in-sim fault injection: schedule compilation + jit helpers.
+
+The reference simulates a *healthy* network; adversarial conditions (host
+crashes, lossy windows, latency spikes) had to be baked into the graph or
+the workload. This module adds a first-class fault plane (ISSUE 5; COREC in
+PAPERS.md makes the same robustness-as-design-axis argument for receive
+drivers): a `faults:` config block compiles — at build time, on the host —
+into a small set of device arrays (`FaultParams`) that the jitted round
+body consults:
+
+  * per-host up/down windows (`down_t`/`up_t`, i64[H, W]): a host is DOWN
+    while any window contains the current event time. Down hosts execute
+    nothing; what happens to their pending events is the static
+    `restart_queue` policy — "hold" defers them to the restart time
+    (exactly the CPU-model busy-horizon mechanics, host.rs:820-847),
+    "clear" discards every event whose execution time falls inside a down
+    window (counted in `stats.faults_dropped`, never silent). Events
+    scheduled past the restart survive either way — a full queue wipe
+    would leave self-timed models (phold, timers) permanently silent,
+    which is a dead lane, not a crash-restart.
+  * link-fault windows (`win_start`/`win_end`, i64[L] + per-window loss
+    probability and latency multiplier): while a window is active, every
+    send draws one extra per-host loss uniform from the engine's
+    counter-based RNG lanes (`ops/rng.py`, masked advance — so the draw
+    sequence depends only on the sending host's own history and results
+    are bit-identical across mesh shapes) and surviving packets have
+    their path latency multiplied by `latency_factor` (>= 1.0: inflation
+    can only grow latency, so the conservative-lookahead bound — which
+    uses the pre-inflation minimum — stays valid). Fault loss and
+    latency inflation both honor `general.bootstrap_end_time` exactly
+    like path loss: disabled before it. Drops count into
+    `stats.faults_dropped`, delays into `stats.faults_delayed`.
+
+Determinism: the schedule itself is a pure function of (fault seed,
+host id, draw counter) through the same splitmix64 recipe `ops/rng.py`
+seeds with, evaluated host-side in numpy at build time — two runs with the
+same seed get byte-identical `FaultParams`, and the in-jit draws use the
+per-host masked-advance lanes, so the digest contract is: same fault seed
+=> same digest, across reruns AND across mesh shapes AND across a mid-run
+snapshot/restore (tests/test_faults.py is the gate). With the block absent
+the engine traces none of this in and stays bit-identical to the
+fault-free program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import numpy as np
+
+from shadow_tpu.simtime import TIME_MAX
+
+# latency multipliers are carried as parts-per-thousand integers so the
+# inflation is pure i64 math in-jit (float scaling could round differently
+# across backends and break the cross-platform determinism scope note)
+LAT_SCALE = 1000
+
+
+class FaultParams(NamedTuple):
+    """Device-side fault schedule (EngineParams.faults). Crash fields are
+    None when no host ever crashes (W = 0); window fields are None when no
+    link-fault window exists (L = 0) — the engine gates each feature on
+    the matching static dim so absent features trace to nothing."""
+
+    down_t: Any  # i64[H, W] crash times (TIME_MAX = unused slot) | None
+    up_t: Any  # i64[H, W] restart times | None
+    win_start: Any  # i64[L] link-fault window starts | None
+    win_end: Any  # i64[L] | None
+    win_loss: Any  # f32[L] extra loss probability while active | None
+    win_lat: Any  # i64[L] latency multiplier x1000 (1000 = 1.0x) | None
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSchedule:
+    """compile_faults result: the static dims the EngineConfig needs plus
+    the compiled arrays."""
+
+    crash_windows: int  # W (0 = no crash plumbing traced in)
+    loss_windows: int  # L (0 = no link-fault plumbing traced in)
+    queue_clear: bool  # restart_queue == "clear"
+    params: FaultParams | None  # None when nothing is scheduled
+
+    @property
+    def active(self) -> bool:
+        return self.crash_windows > 0 or self.loss_windows > 0
+
+
+# ---------------------------------------------------------------- RNG
+# Counter-based draws, numpy mirror of ops/rng.py's splitmix64 seeding:
+# u64(seed, host, ctr) is a pure function of its inputs — no sequential
+# state — so the compiled schedule cannot depend on iteration order.
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_HOST_STRIDE = np.uint64(0xD1342543DE82EF95)  # same stride rng_init uses
+_CTR_STRIDE = np.uint64(0xA0761D6478BD642F)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    x = (x + _GOLDEN).astype(np.uint64)
+    z = x
+    z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)).astype(
+        np.uint64
+    )
+    z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)).astype(
+        np.uint64
+    )
+    return (z ^ (z >> np.uint64(31))).astype(np.uint64)
+
+
+def fault_u64(seed: int, host, ctr) -> np.ndarray:
+    """Counter-based u64 draw: pure in (seed, host, ctr)."""
+    host = np.asarray(host, np.uint64)
+    ctr = np.asarray(ctr, np.uint64)
+    x = (np.uint64(seed & (2**64 - 1)) + host * _HOST_STRIDE
+         + ctr * _CTR_STRIDE).astype(np.uint64)
+    return _splitmix64(_splitmix64(x))
+
+
+def fault_uniform(seed: int, host, ctr) -> np.ndarray:
+    """float64 in [0, 1): top 53 bits of the counter draw."""
+    return (fault_u64(seed, host, ctr) >> np.uint64(11)).astype(
+        np.float64
+    ) * (1.0 / (1 << 53))
+
+
+# ---------------------------------------------------------------- compile
+
+
+def _merge_windows(wins: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Sort + coalesce overlapping/touching [down, up) windows per host —
+    the in-jit containment test assumes disjoint windows (the resume time
+    is the up of THE window containing t)."""
+    out: list[tuple[int, int]] = []
+    for d, u in sorted(wins):
+        if out and d <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], u))
+        else:
+            out.append((d, u))
+    return out
+
+
+def compile_faults(
+    fopts,
+    *,
+    num_hosts: int,
+    num_real: int | None = None,
+    stop_time: int,
+    bootstrap_end: int = 0,
+    default_seed: int = 1,
+    name_to_id: dict[str, int] | None = None,
+) -> FaultSchedule:
+    """FaultOptions -> FaultSchedule. Host-side, numpy, deterministic in
+    the fault seed. `num_hosts` is the engine's (possibly mesh-padded)
+    lane count; churn draws run over the `num_real` prefix only, so the
+    schedule is invariant to mesh padding (like the model builders)."""
+    import jax.numpy as jnp
+
+    num_real = num_hosts if num_real is None else num_real
+    if fopts.restart_queue not in ("hold", "clear"):
+        # FaultOptions.from_dict validates the YAML path; this catches the
+        # CLI-override path (merge_cli_overrides setattr's fields raw) —
+        # an unknown policy must not silently degrade to "hold"
+        raise ValueError(
+            f"restart_queue must be hold|clear, got {fopts.restart_queue!r}"
+        )
+    seed = default_seed if fopts.seed is None else fopts.seed
+    per_host: list[list[tuple[int, int]]] = [[] for _ in range(num_hosts)]
+
+    # explicit crash entries (host by id or name)
+    for c in fopts.crashes:
+        hid = c.host
+        if isinstance(hid, str):
+            if name_to_id is None or hid not in (name_to_id or {}):
+                raise ValueError(f"faults.crashes: unknown host {hid!r}")
+            hid = name_to_id[hid]
+        hid = int(hid)
+        if not 0 <= hid < num_real:
+            raise ValueError(
+                f"faults.crashes: host id {hid} out of range [0, {num_real})"
+            )
+        if c.up_at <= c.down_at:
+            raise ValueError(
+                f"faults.crashes: up_at {c.up_at} <= down_at {c.down_at}"
+            )
+        per_host[hid].append((int(c.down_at), int(c.up_at)))
+
+    # seeded churn: each real host crashes once with probability `prob`,
+    # at a uniform time in [bootstrap_end, stop), down for an exponential
+    # draw around mean_downtime (floored at 1 ms so a restart is distinct
+    # from the crash)
+    ch = fopts.host_churn
+    if ch is not None and ch.prob > 0 and num_real > 0:
+        hosts = np.arange(num_real)
+        hit = fault_uniform(seed, hosts, 0) < ch.prob
+        span = max(stop_time - bootstrap_end, 1)
+        down_at = bootstrap_end + (
+            fault_uniform(seed, hosts, 1) * span
+        ).astype(np.int64)
+        # inverse-CDF exponential; u is bounded away from 1 so log is finite
+        u = np.minimum(fault_uniform(seed, hosts, 2), 1.0 - 2**-53)
+        downtime = np.maximum(
+            (-np.log1p(-u) * ch.mean_downtime).astype(np.int64), 1_000_000
+        )
+        for h in np.nonzero(hit)[0]:
+            per_host[int(h)].append(
+                (int(down_at[h]), int(down_at[h] + downtime[h]))
+            )
+
+    merged = [_merge_windows(w) for w in per_host]
+    w_max = max((len(w) for w in merged), default=0)
+
+    lws = list(fopts.loss_windows)
+    for lw in lws:
+        if not 0.0 <= lw.loss <= 1.0:
+            raise ValueError(f"faults.loss_windows: loss {lw.loss} not in [0, 1]")
+        if lw.latency_factor < 1.0:
+            raise ValueError(
+                f"faults.loss_windows: latency_factor {lw.latency_factor} < 1.0 "
+                f"(deflation would break the conservative-lookahead bound)"
+            )
+        if lw.end <= lw.start:
+            raise ValueError(
+                f"faults.loss_windows: end {lw.end} <= start {lw.start}"
+            )
+
+    if w_max == 0 and not lws:
+        return FaultSchedule(0, 0, fopts.restart_queue == "clear", None)
+
+    if w_max:
+        down = np.full((num_hosts, w_max), TIME_MAX, np.int64)
+        up = np.full((num_hosts, w_max), TIME_MAX, np.int64)
+        for h, wins in enumerate(merged):
+            for i, (d, u_) in enumerate(wins):
+                down[h, i] = d
+                up[h, i] = u_
+        down_t, up_t = jnp.asarray(down), jnp.asarray(up)
+    else:
+        down_t = up_t = None
+
+    if lws:
+        win_start = jnp.asarray([int(w.start) for w in lws], jnp.int64)
+        win_end = jnp.asarray([int(w.end) for w in lws], jnp.int64)
+        win_loss = jnp.asarray([float(w.loss) for w in lws], jnp.float32)
+        win_lat = jnp.asarray(
+            [int(round(w.latency_factor * LAT_SCALE)) for w in lws], jnp.int64
+        )
+    else:
+        win_start = win_end = win_loss = win_lat = None
+
+    return FaultSchedule(
+        crash_windows=w_max,
+        loss_windows=len(lws),
+        queue_clear=fopts.restart_queue == "clear",
+        params=FaultParams(
+            down_t=down_t, up_t=up_t,
+            win_start=win_start, win_end=win_end,
+            win_loss=win_loss, win_lat=win_lat,
+        ),
+    )
+
+
+# ---------------------------------------------------------------- jit side
+
+
+def down_and_resume(fp: FaultParams, t):
+    """Per-host down mask + restart floor at times `t` (i64[H]).
+
+    Returns (down[H] bool, resume[H] i64) with resume = the containing
+    window's up time where down, 0 elsewhere — so callers can fold it into
+    an execution-time floor with a plain `maximum` (the same shape the CPU
+    model's busy_until floor takes)."""
+    import jax.numpy as jnp
+
+    in_w = (fp.down_t <= t[:, None]) & (t[:, None] < fp.up_t)  # [H, W]
+    down = jnp.any(in_w, axis=1)
+    resume = jnp.min(jnp.where(in_w, fp.up_t, TIME_MAX), axis=1)
+    return down, jnp.where(down, resume, jnp.int64(0))
+
+
+def window_effects(fp: FaultParams, t):
+    """Link-fault effects active at per-host times `t` (i64[H]).
+
+    Returns (loss[H] f32, lat_x1000[H] i64): the max loss probability and
+    max latency multiplier over active windows (max, not product — the
+    windows model alternative severities of one underlying fault, and max
+    keeps the draw count at exactly one per send)."""
+    import jax.numpy as jnp
+
+    act = (fp.win_start[None, :] <= t[:, None]) & (
+        t[:, None] < fp.win_end[None, :]
+    )  # [H, L]
+    loss = jnp.max(
+        jnp.where(act, fp.win_loss[None, :], jnp.float32(0.0)), axis=1
+    )
+    lat = jnp.max(
+        jnp.where(act, fp.win_lat[None, :], jnp.int64(LAT_SCALE)), axis=1
+    )
+    return loss, jnp.maximum(lat, LAT_SCALE)

@@ -1,0 +1,276 @@
+"""Crash-resilient run supervisor: retry chunk dispatch from device snapshots.
+
+The simulator must survive its own infrastructure failing mid-run — this
+box's documented jaxlib-0.4.37 heap corruption aborts whole runs (CHANGES.md
+PR 1/2 env notes), and a production mesh adds preemptions, XLA runtime
+errors, and transient dispatch failures on top. The reference has no story
+here at all (a dead manager is a dead simulation, SURVEY.md §5.4); PR 4's
+snapshot machinery (`core/checkpoint.snapshot_state`/`restore_snapshot` —
+donation-safe device copies built for the gear replay loop) already gives
+us exact chunk-granular recovery, so the supervisor is a thin, driver-shared
+state machine on top:
+
+  RUNNING --dispatch ok--> RUNNING (periodic snapshot + optional on-disk
+                                    checkpoint every `snapshot_every_chunks`)
+  RUNNING --dispatch raises--> BACKOFF (exponential: base * 2^attempt)
+  BACKOFF --> RESTORE (fresh copy of the last good snapshot; a digest
+              cross-check against the value recorded at snapshot time
+              detects the silent-divergence corruption mode — the
+              wrong-digest flavor PR 2's env note documents — instead of
+              resuming from poisoned state)
+  RESTORE --> RUNNING (the deterministic engine replays the lost chunks
+              bit-identically; trace-ring drains self-deduplicate because
+              the cursor regresses with the state)
+  after `max_retries` failures on one chunk --> ABORT (SupervisorAbort);
+  the drivers catch it, keep the last good state, and still export
+  sim-stats/trace artifacts for the completed prefix.
+
+Retry exactness: the jitted chunk DONATES its input buffers, so a failed
+dispatch may have invalidated them — the supervisor never reuses a failed
+input; it always replays from an independent snapshot copy. Because the
+engine is deterministic, a retried run's final digest is bit-identical to
+an uninterrupted one (tests/test_faults.py + tools/soak.py are the gates).
+
+On-disk checkpoints are written atomically (tmp + os.replace) so a SIGKILL
+mid-write — the soak tool injects exactly that — can never leave a
+truncated file for the resume path to trip over.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import numpy as np
+
+
+class SupervisorAbort(RuntimeError):
+    """Bounded retries exhausted (or restored state failed its digest
+    cross-check): the run cannot make progress. The driver still owns the
+    last good state and writes artifacts for the completed prefix."""
+
+
+def state_digest_sig(state) -> tuple[int, int]:
+    """Cheap integrity signature of a SimState: (rounds, xor of the
+    per-host event digests). Recorded at snapshot time and re-checked at
+    restore time — a mismatch means device memory silently diverged
+    between the copy and the replay (the known wrong-digest corruption
+    mode), which replaying would only launder into believable results."""
+    import jax
+
+    digest = int(np.bitwise_xor.reduce(
+        np.asarray(jax.device_get(state.stats.digest)).reshape(-1)
+    ))
+    return int(state.stats.rounds), digest
+
+
+class ChunkSupervisor:
+    """Wraps a driver's chunk dispatch in snapshot/retry/abort handling.
+
+    Modeled drivers (`sim.py`, `bench.py`) use periodic snapshots: a failed
+    chunk replays every chunk since the last snapshot (deterministic, so
+    bit-identical). The hybrid driver (`cosim.py`) passes
+    `pre_dispatch_snapshot=True`: its CPU plane advances between device
+    dispatches and cannot roll back, so every dispatch snapshots first and
+    only the failing dispatch itself retries.
+
+    `save_fn` (optional) writes the on-disk checkpoint after each periodic
+    snapshot; it receives a path and must write atomically-renamable
+    output there (the drivers pass `core.checkpoint.save_checkpoint`).
+    """
+
+    def __init__(
+        self,
+        *,
+        snapshot_every_chunks: int = 1,
+        max_retries: int = 3,
+        backoff_base_s: float = 0.05,
+        checkpoint_path: str | None = None,
+        save_fn=None,
+        pre_dispatch_snapshot: bool = False,
+        log=None,
+    ):
+        self.snapshot_every = max(int(snapshot_every_chunks), 1)
+        self.max_retries = int(max_retries)
+        # clamp: a negative base would make time.sleep raise mid-recovery
+        self.backoff_base_s = max(float(backoff_base_s), 0.0)
+        self.checkpoint_path = checkpoint_path
+        self._save_fn = save_fn
+        self.pre_dispatch = bool(pre_dispatch_snapshot)
+        self._log = log
+        self._snap = None
+        self._snap_sig: tuple[int, int] | None = None
+        self._chunks_since_snap = 0
+        # counters for sim-stats / BENCH
+        self.retries = 0  # failed dispatches retried
+        self.restores = 0  # snapshot restores performed
+        self.snapshots = 0  # device snapshots taken
+        self.checkpoints = 0  # on-disk checkpoints written
+        self.aborted = False
+        self.poisoned = False  # snapshot failed its digest cross-check
+        self.last_error: str | None = None
+
+    # ---- snapshots ---------------------------------------------------------
+
+    def _say(self, msg: str):
+        if self._log is not None:
+            print(f"[supervisor] {msg}", file=self._log)
+
+    def _take_snapshot(self, state):
+        from shadow_tpu.core.checkpoint import snapshot_state
+
+        self._snap = snapshot_state(state)
+        self._snap_sig = state_digest_sig(self._snap)
+        self._chunks_since_snap = 0
+        self.snapshots += 1
+
+    def _write_checkpoint(self):
+        if self.checkpoint_path is None or self._save_fn is None:
+            return
+        tmp = self.checkpoint_path + ".tmp"
+        real = self._save_fn(tmp, self._snap)  # save fn may append .npz
+        final = self.checkpoint_path
+        if real.endswith(".npz") and not final.endswith(".npz"):
+            final += ".npz"
+        os.replace(real, final)
+        self.checkpoints += 1
+        self._say(f"checkpoint written: {final}")
+        # test/soak hook: die by SIGKILL right after the Nth on-disk
+        # checkpoint lands — the hard-crash the resume path must survive
+        kill_at = os.environ.get("SHADOW_TPU_TEST_KILL_AT_CHECKPOINT")
+        if kill_at and self.checkpoints >= int(kill_at):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def note_state(self, state):
+        """Adopt `state` as the recovery point (drivers call once before
+        their loop, and the periodic refresh goes through run_chunk)."""
+        self._take_snapshot(state)
+        self._write_checkpoint()
+
+    # ---- the retry loop ----------------------------------------------------
+
+    def run_chunk(self, state, dispatch):
+        """Run `dispatch(state) -> state` with bounded-retry recovery.
+
+        Returns the new state. Raises SupervisorAbort after max_retries
+        consecutive failures of this chunk (or on a restore whose digest
+        cross-check fails) — with the supervisor's snapshot as the last
+        good state (`.last_good()`)."""
+        if self._snap is None or self.pre_dispatch:
+            self._take_snapshot(state)
+        attempt = 0
+        while True:
+            try:
+                out = dispatch(state)
+                # block here so an async dispatch failure surfaces inside
+                # the try (jax errors often materialize at the first use
+                # of the result, which would otherwise escape the retry)
+                import jax
+
+                jax.block_until_ready(out)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # XlaRuntimeError, aborts, anything
+                self.last_error = f"{type(e).__name__}: {e}"
+                attempt += 1
+                self.retries += 1
+                if attempt > self.max_retries:
+                    self.aborted = True
+                    self._say(
+                        f"giving up after {self.max_retries} retries: "
+                        f"{self.last_error}"
+                    )
+                    raise SupervisorAbort(
+                        f"chunk dispatch failed {attempt} times; last: "
+                        f"{self.last_error}"
+                    ) from e
+                delay = self.backoff_base_s * (2 ** (attempt - 1))
+                self._say(
+                    f"dispatch failed ({self.last_error}); retry "
+                    f"{attempt}/{self.max_retries} in {delay:.2f}s"
+                )
+                time.sleep(delay)
+                state = self._restore_checked()
+                continue
+            self._chunks_since_snap += 1
+            if not self.pre_dispatch and (
+                self._chunks_since_snap >= self.snapshot_every
+            ):
+                self._take_snapshot(out)
+                self._write_checkpoint()
+            return out
+
+    def _restore_checked(self):
+        from shadow_tpu.core.checkpoint import restore_snapshot
+
+        restored = restore_snapshot(self._snap)
+        sig = state_digest_sig(restored)
+        if sig != self._snap_sig:
+            self.aborted = True
+            self.poisoned = True
+            raise SupervisorAbort(
+                f"snapshot digest cross-check failed (recorded "
+                f"{self._snap_sig}, restored {sig}): device state silently "
+                f"diverged — refusing to replay from poisoned memory"
+            )
+        self.restores += 1
+        # progress rewound to the snapshot point: restart the snapshot
+        # cadence from zero, or the first replayed chunk would trip the
+        # `>= snapshot_every` threshold early (extra HBM copy + on-disk
+        # write per recovery)
+        self._chunks_since_snap = 0
+        return restored
+
+    def last_good(self):
+        """A fresh copy of the last good snapshot (for the graceful-abort
+        path: report/export the completed prefix, not the failed state).
+        Returns None once the snapshot failed its digest cross-check —
+        handing out state from poisoned memory would launder the very
+        corruption the check exists to catch."""
+        from shadow_tpu.core.checkpoint import restore_snapshot
+
+        if self.poisoned or self._snap is None:
+            return None
+        return restore_snapshot(self._snap)
+
+    def poisoned_state(self):
+        """Copy of the refused snapshot for the graceful-abort EXPORT path
+        only. When the cross-check fails, the driver's in-hand state may
+        hold buffers the failed dispatch already consumed by donation —
+        exporting artifacts from it would crash on deleted arrays. The
+        refused copy is at least materializable, and the artifacts' own
+        top-level `poisoned: true` flag keeps its counters from reading as
+        a trustworthy prefix. Returns None when there is no snapshot or
+        the supervisor is not poisoned (use `last_good()` then)."""
+        from shadow_tpu.core.checkpoint import restore_snapshot
+
+        if not self.poisoned or self._snap is None:
+            return None
+        return restore_snapshot(self._snap)
+
+    def abort_export_state(self):
+        """State the driver should export artifacts from after a graceful
+        abort: a fresh copy of the last good snapshot, or — when that
+        snapshot failed its digest cross-check — the refused copy. The
+        driver's in-hand state may hold buffers the failed dispatch
+        already consumed by donation (exporting from it would crash on
+        deleted arrays), and `report()`'s `poisoned` flag keeps a refused
+        snapshot's counters from reading as a trustworthy prefix. Returns
+        None only when no snapshot was ever taken — then the in-hand
+        state is all there is."""
+        good = self.last_good()
+        return good if good is not None else self.poisoned_state()
+
+    def report(self) -> dict:
+        """JSON-able summary for sim-stats / BENCH rows."""
+        return {
+            "retries": self.retries,
+            "restores": self.restores,
+            "snapshots": self.snapshots,
+            "checkpoints": self.checkpoints,
+            "snapshot_every_chunks": self.snapshot_every,
+            "aborted": self.aborted,
+            **({"poisoned": True} if self.poisoned else {}),
+            **({"last_error": self.last_error} if self.last_error else {}),
+        }

@@ -50,6 +50,7 @@ import yaml
 from shadow_tpu.config.options import ConfigError, ConfigOptions
 from shadow_tpu.core import engine as eng
 from shadow_tpu.core.engine import Engine, EngineParams
+from shadow_tpu.core.supervisor import SupervisorAbort
 from shadow_tpu.host import CpuHost, HostConfig
 from shadow_tpu.host.sockets import NetPacket
 from shadow_tpu.models.hybrid import (
@@ -138,6 +139,50 @@ class HybridSimulation:
         # stats_report queue_overflow_dropped)
         auto_qcap, auto_budget, auto_rpc = ex.resolve_shapes(num_hosts)
         qcap = max(auto_qcap, 256)
+        # fault plane: link-fault (loss/latency) windows act below the
+        # bridge — in the device engine's egress pipeline — so they ride
+        # along on hybrid sims unchanged. Host crashes do NOT: the CPU
+        # plane's processes have live Python/native state no up/down mask
+        # can pause, so a crash schedule here is a config error, not a
+        # silent no-op.
+        from shadow_tpu.core.faults import FaultSchedule, compile_faults
+
+        if cfg.faults.crashes or (
+            cfg.faults.host_churn is not None and cfg.faults.host_churn.prob > 0
+        ):
+            raise ConfigError(
+                "faults: host crashes/churn are not supported on hybrid "
+                "(program) simulations — the CPU plane cannot pause live "
+                "processes; use loss_windows, or model the hosts"
+            )
+        if (cfg.faults.supervisor.enabled
+                and cfg.faults.supervisor.checkpoint_file is not None):
+            # same principle as crashes above: the hybrid supervisor runs
+            # per-dispatch snapshots only (the CPU plane's live processes
+            # cannot be restored from an on-disk device checkpoint), so a
+            # durability knob it cannot honor is a config error, not a
+            # silent drop the user discovers at crash time
+            raise ConfigError(
+                "faults.supervisor.checkpoint_file is not supported on "
+                "hybrid (program) simulations — the CPU plane cannot "
+                "resume from a device checkpoint; remove it or model the "
+                "hosts"
+            )
+        try:
+            self._fault_sched = (
+                compile_faults(
+                    cfg.faults,
+                    num_hosts=num_hosts,
+                    num_real=self._num_real,
+                    stop_time=cfg.general.stop_time,
+                    bootstrap_end=cfg.general.bootstrap_end_time,
+                    default_seed=cfg.general.seed,
+                )
+                if cfg.faults.injecting
+                else FaultSchedule(0, 0, False, None)
+            )
+        except ValueError as e:
+            raise ConfigError(f"faults: {e}") from e
         self.engine_cfg = eng.EngineConfig(
             num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
@@ -174,6 +219,7 @@ class HybridSimulation:
             shaping=any(
                 s.bw_up_bits > 0 or s.bw_down_bits > 0 for s in self.specs
             ),
+            fault_loss_windows=self._fault_sched.loss_windows,
         )
         self.mesh = None
         if world > 1:
@@ -228,6 +274,7 @@ class HybridSimulation:
                 eg_tb=simmod._tb_params(bw_up, ecfg.tb_interval_ns),
                 in_tb=simmod._tb_params(bw_down, ecfg.tb_interval_ns),
                 model=jax.tree.map(jnp.asarray, mparams),
+                faults=self._fault_sched.params,
             )
             mstate_dev = jax.tree.map(jnp.asarray, mstate)
         self.state, self.params = self.engine.init_state(
@@ -429,6 +476,24 @@ class HybridSimulation:
         self._last_gear = None
         self._ob_hwm_run = 0
         self._clear_caps = jax.jit(_clear_caps, donate_argnums=0)
+        # crash-resilient supervisor, per-dispatch mode: the CPU plane
+        # advances between device dispatches and cannot roll back, so
+        # every guarded dispatch snapshots the DEVICE state first and only
+        # the failing dispatch retries (no cross-window replay, no on-disk
+        # checkpoint — hybrid durable checkpoints keep their own
+        # end-of-run constraints, core/checkpoint.save_checkpoint_hybrid)
+        self._supervisor = None
+        self._aborted = False
+        if cfg.faults.supervisor.enabled:
+            from shadow_tpu.core.supervisor import ChunkSupervisor
+
+            self._supervisor = ChunkSupervisor(
+                snapshot_every_chunks=1,
+                max_retries=cfg.faults.supervisor.max_retries,
+                backoff_base_s=cfg.faults.supervisor.backoff_base_ms / 1000.0,
+                pre_dispatch_snapshot=True,
+                log=sys.stderr,
+            )
 
     # ---- egress staging ----------------------------------------------------
 
@@ -560,10 +625,21 @@ class HybridSimulation:
                 jax.block_until_ready(self.state)
             until = min(self._cpu_min_next(), stop)
             t_rounds = time.monotonic()
-            with self.perf.time("device_rounds"):
-                self._device_rounds(
-                    jnp.asarray(max(until, window_end), jnp.int64)
-                )
+            try:
+                with self.perf.time("device_rounds"):
+                    self._device_rounds(
+                        jnp.asarray(max(until, window_end), jnp.int64)
+                    )
+            except SupervisorAbort as e:
+                # graceful abort: export the completed prefix from the
+                # pre-dispatch device snapshot, not the in-hand state
+                # (abort_export_state docs the poisoned/donation rationale)
+                print(f"[supervisor] aborting run: {e}", file=log)
+                good = self._supervisor.abort_export_state()
+                if good is not None:
+                    self.state = good
+                self._aborted = True
+                break
             if self._tracer is not None:
                 self._tracer.drain(
                     self.state.trace,
@@ -583,9 +659,18 @@ class HybridSimulation:
                     f"gear={self._last_gear} "
                     if self._last_gear is not None else ""
                 )
+                fault_f = ""
+                if self.engine_cfg.faults_active:
+                    _s = self.state.stats
+                    fault_f = (
+                        f"faults="
+                        f"{int(np.asarray(_s.faults_dropped).sum())}/"
+                        f"{int(np.asarray(_s.faults_delayed).sum())} "
+                    )
                 print(
                     f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s windows={windows} "
+                    f"{fault_f}"
                     f"{gear_f}"
                     f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                     f"{simmod.resource_heartbeat()}",
@@ -621,8 +706,10 @@ class HybridSimulation:
     def _device_rounds(self, until_arr):
         """One guarded device dispatch — at the adaptive merge gear with
         shed-exact replay when gears are on, the plain full-width program
-        otherwise. The block_until_ready keeps the perf phase honest (jax
-        dispatch is async; see the device_inject comment above).
+        otherwise; wrapped in the supervisor's per-dispatch retry when
+        `faults.supervisor` is enabled. The block_until_ready keeps the
+        perf phase honest (jax dispatch is async; see the device_inject
+        comment above).
 
         Cost note: below the top gear every window pays a device-side
         SimState copy (the replay snapshot). Guarded windows can be a
@@ -630,25 +717,34 @@ class HybridSimulation:
         the copy can eat the narrower sort's savings — merge gears on
         hybrid sims are for device-dominant phases; leave the knob off
         when the CPU plane sets the pace."""
-        if self._gearctl is None:
-            self.state = self._guarded(self.state, self.params, until_arr)
-            jax.block_until_ready(self.state)
-            return
-        from shadow_tpu.core.gears import run_adaptive_chunk
 
-        def dispatch(st, gear):
-            st = self._guarded_at(gear)(st, self.params, until_arr)
-            jax.block_until_ready(st)
+        def run(st):
+            if self._gearctl is None:
+                st = self._guarded(st, self.params, until_arr)
+                jax.block_until_ready(st)
+                return st
+            from shadow_tpu.core.gears import run_adaptive_chunk
+
+            def dispatch(s, gear):
+                s = self._guarded_at(gear)(s, self.params, until_arr)
+                jax.block_until_ready(s)
+                return s
+
+            # rounds0: a guarded window can legitimately retire ZERO
+            # rounds (probe fires immediately / device already at the
+            # horizon) — such windows must not feed the controller an
+            # hwm of 0
+            st, self._last_gear, hwm = run_adaptive_chunk(
+                self._gearctl, st, dispatch,
+                rounds0=int(st.stats.rounds),
+            )
+            self._ob_hwm_run = max(self._ob_hwm_run, hwm)
             return st
 
-        # rounds0: a guarded window can legitimately retire ZERO rounds
-        # (probe fires immediately / device already at the horizon) — such
-        # windows must not feed the controller an hwm of 0
-        self.state, self._last_gear, hwm = run_adaptive_chunk(
-            self._gearctl, self.state, dispatch,
-            rounds0=int(self.state.stats.rounds),
-        )
-        self._ob_hwm_run = max(self._ob_hwm_run, hwm)
+        if self._supervisor is None:
+            self.state = run(self.state)
+        else:
+            self.state = self._supervisor.run_chunk(self.state, run)
 
     def _order_seq(self, gid: int) -> int:
         """Fresh per-host order counter for qdisc-reordered injections."""
@@ -845,6 +941,8 @@ class HybridSimulation:
             "packets_lost": int(s.pkts_lost[:n].sum()),
             "packets_budget_dropped": int(s.pkts_budget_dropped[:n].sum()),
             "packets_codel_dropped": int(s.pkts_codel_dropped[:n].sum()),
+            "faults_dropped": int(s.faults_dropped[:n].sum()),
+            "faults_delayed": int(s.faults_delayed[:n].sum()),
             "queue_overflow_dropped": int(
                 np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
             ),
@@ -867,6 +965,17 @@ class HybridSimulation:
             **(
                 {"gears": self._gearctl.report()}
                 if self._gearctl is not None
+                else {}
+            ),
+            **(
+                {"supervisor": self._supervisor.report()}
+                if self._supervisor is not None
+                else {}
+            ),
+            **({"aborted": True} if self._aborted else {}),
+            **(
+                {"poisoned": True}
+                if self._supervisor is not None and self._supervisor.poisoned
                 else {}
             ),
             **(

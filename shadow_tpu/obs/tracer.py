@@ -53,6 +53,9 @@ TRACE_FIELDS = (
     "next_time",      # min queue head after the round (TIME_MAX if empty)
     "ob_hwm",         # max sends any ONE host staged this round (gear signal)
     "gear",           # active merge gear (outbox columns sorted; B = full)
+    "faults_dropped", # fault-plane drops this round (delta, this shard)
+    "faults_delayed", # fault-plane delays this round (delta, this shard)
+    "hosts_down",     # hosts inside a crash window at this round's end
 )
 TRACE_COLS = len(TRACE_FIELDS)
 (
@@ -70,6 +73,9 @@ TRACE_COLS = len(TRACE_FIELDS)
     COL_NEXT_TIME,
     COL_OB_HWM,
     COL_GEAR,
+    COL_FAULTS_DROPPED,
+    COL_FAULTS_DELAYED,
+    COL_HOSTS_DOWN,
 ) = range(TRACE_COLS)
 
 
@@ -164,6 +170,42 @@ class RoundTracer:
             )
         return max(n, 0) - lost
 
+    def truncate_to_round(self, rounds: int) -> int:
+        """Drop drained rows whose global round index is >= `rounds`.
+
+        The graceful-abort path exports state rewound to the supervisor's
+        last snapshot, but chunks that SUCCEEDED after that snapshot were
+        already drained — without this, trace totals would cover rounds
+        the exported sim-stats prefix does not, breaking trace-vs-stats
+        reconciliation in exactly the artifacts the abort path exists to
+        keep trustworthy. The drivers call it with the rewound state's
+        `stats.rounds`. Chunk records give their round counts back
+        newest-first so chunk totals keep reconciling too. Returns how
+        many rounds were dropped."""
+        dropped = 0
+        kept: list[np.ndarray] = []
+        for seg in self._rows:
+            # COL_ROUND is the global (replicated) round counter at round
+            # entry, so shard 0's column is canonical for every shard
+            mask = seg[0, :, COL_ROUND] < rounds
+            n_drop = int((~mask).sum())
+            if n_drop:
+                dropped += n_drop
+                seg = seg[:, mask, :]
+            if seg.shape[1]:
+                kept.append(seg)
+        if dropped:
+            self._rows = kept
+            self._cursor -= dropped  # keep `rounds`/future drains coherent
+            left = dropped
+            for c in reversed(self._chunks):
+                take = min(c["rounds"], left)
+                c["rounds"] -= take
+                left -= take
+                if left <= 0:
+                    break
+        return dropped
+
     @property
     def rounds(self) -> int:
         return self._cursor - self._origin - self.lost
@@ -248,22 +290,34 @@ class RoundTracer:
         return path
 
     def totals(self) -> dict:
-        """Summed/maxed counters over every traced round (all shards)."""
+        """Summed/maxed counters over every traced round (all shards).
+        The empty-trace case returns zeros under the SAME keys, so the
+        sim-stats `trace` block's schema never depends on whether any
+        round was drained."""
         rows = self.rows()
         flat = rows.reshape(-1, TRACE_COLS)
-        if flat.shape[0] == 0:
-            return {f: 0 for f in TRACE_FIELDS[3:]}
+        empty = flat.shape[0] == 0
+
+        def _sum(col):
+            return 0 if empty else int(flat[:, col].sum())
+
+        def _max(col):
+            return 0 if empty else int(flat[:, col].max())
+
         return {
-            "events": int(flat[:, COL_EVENTS].sum()),
-            "microsteps": int(flat[:, COL_MICROSTEPS].sum()),
-            "popk_deferred": int(flat[:, COL_POPK_DEFERRED].sum()),
-            "bq_rebuilds": int(flat[:, COL_BQ_REBUILDS].sum()),
-            "ici_bytes": int(flat[:, COL_ICI_BYTES].sum()),
-            "sends": int(flat[:, COL_SENDS].sum()),
-            "a2a_shed": int(flat[:, COL_A2A_SHED].sum()),
-            "occ_hwm": int(flat[:, COL_OCC_HWM].max()),
-            "next_time": int(flat[:, COL_NEXT_TIME].max()),
-            "ob_hwm": int(flat[:, COL_OB_HWM].max()),
+            "events": _sum(COL_EVENTS),
+            "microsteps": _sum(COL_MICROSTEPS),
+            "popk_deferred": _sum(COL_POPK_DEFERRED),
+            "bq_rebuilds": _sum(COL_BQ_REBUILDS),
+            "ici_bytes": _sum(COL_ICI_BYTES),
+            "sends": _sum(COL_SENDS),
+            "a2a_shed": _sum(COL_A2A_SHED),
+            "occ_hwm": _max(COL_OCC_HWM),
+            "next_time": _max(COL_NEXT_TIME),
+            "ob_hwm": _max(COL_OB_HWM),
+            "faults_dropped": _sum(COL_FAULTS_DROPPED),
+            "faults_delayed": _sum(COL_FAULTS_DELAYED),
+            "hosts_down_max": _max(COL_HOSTS_DOWN),
         }
 
     def gear_histogram(self) -> dict:
@@ -332,6 +386,12 @@ class RoundTracer:
                "all-to-all block-overflow sheds")
         metric("queue_occupancy_hwm", "gauge", t["occ_hwm"],
                "max per-host queue occupancy observed after any exchange")
+        metric("faults_dropped_total", "counter", t["faults_dropped"],
+               "events/packets discarded by injected faults")
+        metric("faults_delayed_total", "counter", t["faults_delayed"],
+               "events/packets delayed by injected faults")
+        metric("hosts_down_max", "gauge", t["hosts_down_max"],
+               "max hosts simultaneously inside a crash window")
         if rows.shape[1] > 0:
             metric("sim_time_ns", "gauge",
                    int(rows[0, -1, COL_WINDOW_END]),

@@ -364,6 +364,33 @@ class Simulation:
         self._num_real = len(self.hosts)
         num_hosts = -(-self._num_real // world) * world
         qcap, send_budget, rpc = ex.resolve_shapes(num_hosts)
+        # fault plane (core/faults.py): compile the seeded schedule into
+        # device arrays + the static dims the round body specializes on.
+        # Churn draws run over the real-host prefix only, so the schedule
+        # is invariant to mesh padding.
+        from shadow_tpu.core.faults import FaultSchedule, compile_faults
+
+        try:
+            self._fault_sched = (
+                compile_faults(
+                    cfg.faults,
+                    num_hosts=num_hosts,
+                    num_real=self._num_real,
+                    stop_time=cfg.general.stop_time,
+                    bootstrap_end=cfg.general.bootstrap_end_time,
+                    default_seed=cfg.general.seed,
+                    name_to_id={h.name: h.host_id for h in self.hosts},
+                )
+                if cfg.faults.injecting
+                else FaultSchedule(0, 0, False, None)
+            )
+        except ValueError as e:
+            raise ConfigError(f"faults: {e}") from e
+        if self._fault_sched.active and ex.scheduler == "cpu-reference":
+            raise ConfigError(
+                "faults: the cpu-reference scheduler does not model the "
+                "fault plane; run the tpu scheduler or drop the faults block"
+            )
         self.engine_cfg = EngineConfig(
             num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
@@ -394,6 +421,9 @@ class Simulation:
             # round tracer ring sized to the chunk length: the run loop
             # drains at every chunk boundary, so the ring can never wrap
             trace_rounds=rpc if cfg.observability.trace else 0,
+            fault_crash_windows=self._fault_sched.crash_windows,
+            fault_loss_windows=self._fault_sched.loss_windows,
+            fault_queue_clear=self._fault_sched.queue_clear,
         )
         # occupancy-adaptive merge gears (core/gears.py): resolved against
         # the (possibly auto-sized) send budget; [] = disabled
@@ -466,6 +496,7 @@ class Simulation:
                 eg_tb=_tb_params(bw_up, ecfg.tb_interval_ns),
                 in_tb=_tb_params(bw_down, ecfg.tb_interval_ns),
                 model=self._pad(mparams),
+                faults=self._fault_sched.params,
             )
             padded_state = self._pad(mstate)
         # kept for the cpu-reference scheduler path (golden engine inputs)
@@ -522,24 +553,91 @@ class Simulation:
             gearctl = GearController(self._gear_ladder)
             self._gearctl = gearctl
             self._run_adaptive_chunk = run_adaptive_chunk
+        sup = None
+        if cfg.faults.supervisor.enabled and capture is None:
+            # crash-resilient supervisor (core/supervisor.py): periodic
+            # device snapshots + retry-with-backoff on dispatch failure +
+            # graceful abort that still exports the completed prefix. The
+            # capture path keeps its single-round dispatches unsupervised
+            # (pcap writes are host-side effects a replay would duplicate).
+            from shadow_tpu.core.checkpoint import save_checkpoint
+            from shadow_tpu.core.supervisor import ChunkSupervisor
+
+            so = cfg.faults.supervisor
+            ckpt = so.checkpoint_file
+            if ckpt is not None:
+                if not os.path.isabs(ckpt):
+                    ckpt = os.path.join(cfg.general.data_directory, ckpt)
+                os.makedirs(os.path.dirname(ckpt) or ".", exist_ok=True)
+
+            def _save(path, snap_state):
+                # save_checkpoint dumps sim.state: point it at the
+                # supervisor's snapshot for the write, then restore the
+                # binding (the old reference may hold donated buffers)
+                prev = self.state
+                self.state = snap_state
+                try:
+                    return save_checkpoint(path, self)
+                finally:
+                    self.state = prev
+
+            sup = ChunkSupervisor(
+                snapshot_every_chunks=so.snapshot_every_chunks,
+                max_retries=so.max_retries,
+                backoff_base_s=so.backoff_base_ms / 1000.0,
+                checkpoint_path=ckpt,
+                save_fn=_save if ckpt else None,
+                log=log,
+            )
+            self._supervisor = sup
+            sup.note_state(self.state)
         last_gear = None
         chunks = 0
+
+        def _chunk_step(st):
+            nonlocal last_gear
+            if gearctl is not None:
+                st, lg, hwm = self._run_adaptive_chunk(
+                    gearctl, st,
+                    lambda s, g: self.engine.run_chunk_gear(s, self.params, g),
+                )
+                last_gear = lg
+                self._ob_hwm_run = max(self._ob_hwm_run, hwm)
+                return st
+            return self.engine.run_chunk(st, self.params)
+
         try:
             while not bool(self.state.done):
                 t_chunk = time.monotonic()
                 if capture is not None:
                     self.state, sent = capture.step(self.state, self.params)
                     capture.write_round(sent)
-                elif gearctl is not None:
-                    self.state, last_gear, hwm = self._run_adaptive_chunk(
-                        gearctl, self.state,
-                        lambda st, g: self.engine.run_chunk_gear(
-                            st, self.params, g
-                        ),
-                    )
-                    self._ob_hwm_run = max(self._ob_hwm_run, hwm)
+                elif sup is not None:
+                    from shadow_tpu.core.supervisor import SupervisorAbort
+
+                    try:
+                        self.state = sup.run_chunk(self.state, _chunk_step)
+                    except SupervisorAbort as e:
+                        # graceful abort: export the completed prefix from
+                        # the supervisor's snapshot, not the in-hand state
+                        # (abort_export_state docs the poisoned/donation
+                        # rationale)
+                        print(f"[supervisor] aborting run: {e}", file=log)
+                        good = sup.abort_export_state()
+                        if good is not None:
+                            self.state = good
+                            if tracer is not None:
+                                # chunks that succeeded AFTER the snapshot
+                                # were already drained; drop their rows so
+                                # the trace covers exactly the exported
+                                # prefix (truncate_to_round docs this)
+                                tracer.truncate_to_round(
+                                    int(self.state.stats.rounds)
+                                )
+                        self._aborted = True
+                        break
                 else:
-                    self.state = self.engine.run_chunk(self.state, self.params)
+                    self.state = _chunk_step(self.state)
                 if tracer is not None:
                     # pair the drained rounds with the true wall span of
                     # this dispatch (block: async dispatch would pin the
@@ -565,6 +663,14 @@ class Simulation:
                     # gear= rides along only on adaptive runs (old-format
                     # lines stay byte-identical; parse_shadow reads both)
                     gear_f = f"gear={last_gear} " if last_gear is not None else ""
+                    # faults= rides along only when the fault plane is
+                    # active: cumulative dropped/delayed (parse_shadow
+                    # reads old lines without it unchanged)
+                    fault_f = ""
+                    if self.engine_cfg.faults_active:
+                        fd = int(np.asarray(self.state.stats.faults_dropped).sum())
+                        fy = int(np.asarray(self.state.stats.faults_delayed).sum())
+                        fault_f = f"faults={fd}/{fy} "
                     print(
                         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
                         f"wall={wall:.2f}s events={ev} "
@@ -572,6 +678,7 @@ class Simulation:
                         f"msteps/round={msteps / max(rounds, 1):.1f} "
                         f"ev/mstep={ev / max(msteps, 1):.2f} "
                         f"ici_bytes={ici} q_hwm={qhwm} "
+                        f"{fault_f}"
                         f"{gear_f}"
                         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                         f"{resource_heartbeat()}",
@@ -691,6 +798,8 @@ class Simulation:
                 np.asarray(jax.device_get(self.state.queue.dropped))[:n].sum()
             ),
             "packets_budget_dropped": int(s.pkts_budget_dropped[:n].sum()),
+            "faults_dropped": int(s.faults_dropped[:n].sum()),
+            "faults_delayed": int(s.faults_delayed[:n].sum()),
             "outbox_overflow_dropped": int(np.asarray(s.ob_dropped).sum()),
             "bucket_cache_rebuilds": int(np.asarray(s.bq_rebuilds).sum()),
             "popk_deferred": int(np.asarray(s.popk_deferred).sum()),
@@ -711,6 +820,17 @@ class Simulation:
         }
         if self._gearctl is not None:
             report["gears"] = self._gearctl.report()
+        sup = getattr(self, "_supervisor", None)
+        if sup is not None:
+            report["supervisor"] = sup.report()
+        if getattr(self, "_aborted", False):
+            # bounded retries exhausted: everything above describes the
+            # COMPLETED prefix (the supervisor's last good snapshot) —
+            # unless the snapshot was poisoned, which the top-level flag
+            # makes impossible to miss
+            report["aborted"] = True
+            if sup is not None and sup.poisoned:
+                report["poisoned"] = True
         tracer = getattr(self, "_tracer", None)
         if tracer is not None:
             # tracing opted in: the per-host planes are cheap relative to

@@ -32,21 +32,44 @@ def build_sim(
     microstep_events: int = 1,
     trace_rounds: int = 0,
     merge_rows: int = 0,
+    faults: dict | None = None,
+    bootstrap_end: int = 0,
+    rounds_per_chunk: int = 64,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
-    identical inputs."""
+    identical inputs. `faults` is a `faults:` config dict (FaultOptions
+    schema) compiled through the same core/faults path the drivers use."""
     h = len(hosts)
+    fault_sched = None
+    fault_kw = {}
+    if faults:
+        from shadow_tpu.config.options import FaultOptions
+        from shadow_tpu.core.faults import compile_faults
+
+        fault_sched = compile_faults(
+            FaultOptions.from_dict(faults),
+            num_hosts=h, stop_time=stop, default_seed=seed,
+            bootstrap_end=bootstrap_end,
+            name_to_id={d.get("name", f"h{i}"): i
+                        for i, d in enumerate(hosts)},
+        )
+        fault_kw = dict(
+            fault_crash_windows=fault_sched.crash_windows,
+            fault_loss_windows=fault_sched.loss_windows,
+            fault_queue_clear=fault_sched.queue_clear,
+        )
     cfg = EngineConfig(
         num_hosts=h,
         stop_time=stop,
+        bootstrap_end_time=bootstrap_end,
         runahead_floor=runahead_floor,
         static_min_latency=latency,
         queue_capacity=qcap,
         queue_block=queue_block,
         sends_per_host_round=sends_budget,
         max_round_inserts=qcap,
-        rounds_per_chunk=64,
+        rounds_per_chunk=rounds_per_chunk,
         world=world,
         use_codel=use_codel,
         cpu_delay_ns=cpu_delay_ns,
@@ -55,6 +78,7 @@ def build_sim(
         microstep_events=microstep_events,
         trace_rounds=trace_rounds,
         merge_rows=merge_rows,
+        **fault_kw,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)
@@ -72,6 +96,7 @@ def build_sim(
             refill=jnp.full((h,), bw_bits // 1000, jnp.int64),
         ),
         model=mparams,
+        faults=fault_sched.params if fault_sched is not None else None,
     )
     return cfg, model, params, mstate, events
 

@@ -1,0 +1,960 @@
+"""Fault plane (PR 5): deterministic in-sim fault injection + the
+crash-resilient run supervisor.
+
+The contract has three legs (docs/architecture.md "Fault plane"):
+
+  1. faults ABSENT  => the engine program is bit-identical to the
+     fault-free build — digests, event counts, every drop counter —
+     across echo/phold/tgen x flat/bucketed queues x K in {1, 4} x
+     world in {1, 8} (the test_gears gate pattern, extended);
+  2. faults PRESENT => same fault seed, same digest: across reruns,
+     across mesh shapes / queue layouts / K-folds, and across a mid-run
+     snapshot + restore (recovery exactness);
+  3. the supervisor survives injected dispatch failures with bounded
+     retries (digest-identical to an uninterrupted run), and a forced
+     permanent failure still exports sim-stats/trace artifacts for the
+     completed prefix. The end-to-end SIGKILL + on-disk-checkpoint resume
+     runs in a subprocess (tests/subproc.py) like every compiled
+     Simulation leg on this box.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.config.options import ConfigError, FaultOptions
+from shadow_tpu.core import Engine
+from shadow_tpu.core.faults import (
+    compile_faults,
+    fault_u64,
+    fault_uniform,
+)
+from shadow_tpu.core.supervisor import (
+    ChunkSupervisor,
+    SupervisorAbort,
+    state_digest_sig,
+)
+from tests.engine_harness import build_sim, mk_hosts
+
+# the test_gears workload trio: short horizons, exchange-heavy enough to
+# exercise the merge (and under faults, the crash/loss paths) every round
+_CASES = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 5)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(5, {"flow_segs": 8, "flows": 1, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             1_500_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+# a schedule whose windows land inside every case's horizon: churn hits
+# ~half the hosts with ~50 ms outages, the link fault covers [50, 150) ms
+_FAULTS = {
+    "seed": 7,
+    "restart_queue": "hold",
+    "host_churn": {"prob": 0.5, "mean_downtime": "0.05 s"},
+    "loss_windows": [{"start": "0.05 s", "end": "0.15 s", "loss": 0.3,
+                      "latency_factor": 2.0}],
+}
+
+
+def _build(model, hosts, stop, world=1, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=world, **kw
+    )
+    mesh = None
+    if world > 1:
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:world]), ("hosts",)
+        )
+    eng = Engine(cfg, m, mesh)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    return cfg, eng, state, params
+
+
+def _run(model, hosts, stop, world=1, **kw):
+    _, eng, state, params = _build(model, hosts, stop, world, **kw)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500, "simulation failed to terminate"
+    return state
+
+
+def _assert_identical(a, b):
+    fa = jax.device_get(a.stats)
+    fb = jax.device_get(b.stats)
+    np.testing.assert_array_equal(np.asarray(fa.digest), np.asarray(fb.digest))
+    np.testing.assert_array_equal(np.asarray(fa.events), np.asarray(fb.events))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(a.queue.dropped)),
+        np.asarray(jax.device_get(b.queue.dropped)),
+    )
+    for field in ("pkts_sent", "pkts_lost", "pkts_codel_dropped",
+                  "pkts_budget_dropped", "pkts_delivered",
+                  "faults_dropped", "faults_delayed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fa, field)), np.asarray(getattr(fb, field)),
+            err_msg=field,
+        )
+
+
+# ------------------------------------------------- 1: faults-absent gate
+
+_BASELINES: dict = {}
+
+
+def _baseline(case):
+    if case not in _BASELINES:
+        model, hosts, stop, kw = _CASES[case]
+        _BASELINES[case] = _run(model, hosts, stop, **kw)
+    return _BASELINES[case]
+
+
+@pytest.mark.parametrize("qb", [0, 8], ids=["flat", "bucketed"])
+@pytest.mark.parametrize("k", [1, 4], ids=["k1", "k4"])
+@pytest.mark.parametrize("case", sorted(_CASES), ids=sorted(_CASES))
+def test_faults_absent_bit_identical(case, k, qb):
+    """The acceptance gate: with no `faults:` block the fault-plane
+    plumbing traces in NOTHING — digests, events, and every drop counter
+    stay bit-identical across queue layouts and K-folds (any perturbation
+    of the baseline program by this PR's engine edits shows up here)."""
+    if k == 1 and qb == 0:
+        _baseline(case)  # the reference leg itself
+        return
+    model, hosts, stop, kw = _CASES[case]
+    got = _run(model, hosts, stop, queue_block=qb, microstep_events=k, **kw)
+    _assert_identical(_baseline(case), got)
+
+
+# mesh legs need a host count divisible by world=8: the echo/tgen cases
+# grow to 8 hosts, so each compares world 1 vs 8 on ITS OWN host set
+_MESH_CASES = {
+    "phold": _CASES["phold"],
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 8)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(8, {"flow_segs": 8, "flows": 1, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             1_500_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_MESH_CASES), ids=sorted(_MESH_CASES))
+def test_faults_absent_mesh_invariant(case):
+    """world=8 leg of the gate (the conftest's virtual devices)."""
+    model, hosts, stop, kw = _MESH_CASES[case]
+    one = _run(model, hosts, stop, world=1, **kw)
+    got = _run(model, hosts, stop, world=8, **kw)
+    _assert_identical(one, got)
+
+
+# -------------------------------------------- 2: seeded-fault determinism
+
+
+@pytest.mark.parametrize("case", sorted(_CASES), ids=sorted(_CASES))
+def test_fault_seed_deterministic_and_firing(case):
+    """Same fault seed => same digest across reruns; and the schedule
+    genuinely fires (drop/delay counters nonzero) so the determinism
+    claim is about a REAL fault run, not an inert one."""
+    model, hosts, stop, kw = _CASES[case]
+    a = _run(model, hosts, stop, faults=_FAULTS, **kw)
+    b = _run(model, hosts, stop, faults=_FAULTS, **kw)
+    _assert_identical(a, b)
+    sa = jax.device_get(a.stats)
+    assert (int(np.asarray(sa.faults_dropped).sum())
+            + int(np.asarray(sa.faults_delayed).sum())) > 0
+
+
+def test_fault_mesh_queue_k_invariant():
+    """Faulty runs stay bit-identical across mesh shapes, queue layouts,
+    and K-folds — the per-host masked-advance RNG and the head-time crash
+    gating are both shard- and batch-shape independent."""
+    model, hosts, stop, kw = _CASES["phold"]
+    base = _run(model, hosts, stop, faults=_FAULTS, **kw)
+    for variant in (
+        dict(world=8),
+        dict(world=8, exchange="alltoall"),
+        dict(queue_block=8, qcap=32),
+        dict(microstep_events=4),
+        dict(microstep_events=4, queue_block=8, qcap=32),
+    ):
+        got = _run(model, hosts, stop, faults=_FAULTS, **{**kw, **variant})
+        _assert_identical(base, got)
+
+
+def test_fault_clear_cpu_k_invariant():
+    """clear + cpu_delay corner: the down check must read the EXECUTION
+    time (the CPU-busy floor can push an event across a crash-window
+    boundary), identically at K=1 and inside the K-way fold."""
+    model, hosts, stop, kw = _CASES["phold"]
+    f = {"seed": 7, "restart_queue": "clear",
+         "host_churn": {"prob": 0.6, "mean_downtime": "0.04 s"}}
+    kw = dict(kw, cpu_delay_ns=3_000_000)  # busy floor rewrites exec times
+    a = _run(model, hosts, stop, faults=f, **kw)
+    b = _run(model, hosts, stop, faults=f, microstep_events=4, **kw)
+    _assert_identical(a, b)
+    assert int(
+        np.asarray(jax.device_get(a.stats).faults_dropped).sum()
+    ) > 0
+
+
+_SNAPSHOT_RESUME_SCRIPT = """
+import json, sys
+import jax
+import numpy as np
+from shadow_tpu.core import Engine
+from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
+from tests.engine_harness import build_sim, mk_hosts
+
+faults = json.loads(sys.argv[1])
+hosts = mk_hosts(8, {"mean_delay": "20 ms", "population": 3})
+cfg, m, params, mstate, events = build_sim(
+    "phold", hosts, 300_000_000, faults=faults, loss=0.1,
+    rounds_per_chunk=2,  # so the snapshot lands genuinely mid-run
+)
+eng = Engine(cfg, m, None)
+state, params = eng.init_state(params, mstate, events, seed=1)
+state = eng.run_chunk(state, params)
+state = eng.run_chunk(state, params)
+assert not bool(state.done)
+snap = snapshot_state(state)
+
+
+def summary(state):
+    s = jax.device_get(state.stats)
+    return {"digest": np.asarray(s.digest).reshape(-1).tolist(),
+            "events": int(np.asarray(s.events).sum()),
+            "dropped": int(np.asarray(s.faults_dropped).sum()),
+            "delayed": int(np.asarray(s.faults_delayed).sum())}
+
+
+a = state
+while not bool(a.done):
+    a = eng.run_chunk(a, params)
+b = restore_snapshot(snap)
+while not bool(b.done):
+    b = eng.run_chunk(b, params)
+print(json.dumps({"clean": summary(a), "sup": summary(b)}))
+"""
+
+
+def _classified_digest_compare(attempt, what: str):
+    """Run `attempt() -> {"clean": ..., "sup": ...}` up to 3 times; pass
+    as soon as the two summaries match. On 3 mismatches, classify the way
+    tools/soak.py does: the SAME mismatch reproducing across fresh
+    subprocesses is a deterministic bug (fail); VARYING mismatches are
+    this box's documented device-memory scribble (CHANGES.md PR 2 env
+    note) — skip, never silently pass."""
+    outs = []
+    for _ in range(3):
+        out = attempt()
+        if out["sup"] == out["clean"]:
+            return out
+        outs.append(out)
+    pairs = {
+        (tuple(o["clean"]["digest"]), tuple(o["sup"]["digest"]))
+        for o in outs
+    }
+    assert len(pairs) > 1, (
+        f"{what} deterministically diverges (identical mismatch on 3 "
+        f"fresh attempts): {outs[0]}"
+    )
+    pytest.skip(
+        f"{what} digests mismatched DIFFERENTLY across 3 attempts: this "
+        "box's documented device-memory scribble (CHANGES.md PR 2 env "
+        "note), not a deterministic bug"
+    )
+
+
+def test_fault_snapshot_resume_exact():
+    """Recovery exactness at the engine level: snapshot mid-run, finish;
+    restore the snapshot, finish again — bit-identical (the property the
+    supervisor's replay and the on-disk resume both stand on). Runs in
+    the subprocess harness: the rounds_per_chunk=2 dispatch pattern is a
+    magnet for this box's corruption (measured segfaulting mid-pytest on
+    pre-PR HEAD too), and completed-run mismatches get the scribble
+    classification."""
+    import json as _json
+
+    from tests.subproc import run_isolated_json
+
+    _classified_digest_compare(
+        lambda: run_isolated_json(
+            _SNAPSHOT_RESUME_SCRIPT, _json.dumps(_FAULTS)
+        ),
+        "snapshot-resume replay",
+    )
+
+
+def test_hold_vs_clear_semantics():
+    """queue-hold defers a down host's events (counted delayed, none
+    dropped by the crash plane); queue-clear consumes-and-drops them.
+    Both are real behavioral differences, so their digests differ from
+    each other and from the fault-free run."""
+    model, hosts, stop, kw = _CASES["phold"]
+    crash_only = {"seed": 7, "host_churn": {"prob": 0.5,
+                                            "mean_downtime": "0.05 s"}}
+    hold = _run(model, hosts, stop,
+                faults=dict(crash_only, restart_queue="hold"), **kw)
+    clear = _run(model, hosts, stop,
+                 faults=dict(crash_only, restart_queue="clear"), **kw)
+    sh = jax.device_get(hold.stats)
+    sc = jax.device_get(clear.stats)
+    assert int(np.asarray(sh.faults_dropped).sum()) == 0
+    assert int(np.asarray(sh.faults_delayed).sum()) > 0
+    assert int(np.asarray(sc.faults_dropped).sum()) > 0
+    # clear loses events hold preserves
+    assert (int(np.asarray(sc.events).sum())
+            < int(np.asarray(sh.events).sum()))
+    assert not np.array_equal(np.asarray(sh.digest), np.asarray(sc.digest))
+
+
+def test_loss_window_honors_bootstrap():
+    """Fault loss AND latency inflation obey general.bootstrap_end_time
+    exactly like path loss: a window entirely inside the bootstrap phase
+    drops nothing and delays nothing."""
+    model, hosts, stop, kw = _CASES["echo"]
+    lossy = {"seed": 7, "loss_windows": [
+        {"start": "0.01 s", "end": "0.06 s", "loss": 1.0,
+         "latency_factor": 3.0}]}
+    hot = _run(model, hosts, stop, faults=lossy, **kw)
+    gated = _run(model, hosts, stop, faults=lossy,
+                 bootstrap_end=100_000_000, **kw)
+    assert int(np.asarray(jax.device_get(hot.stats).faults_dropped).sum()) > 0
+    gs = jax.device_get(gated.stats)
+    assert int(np.asarray(gs.faults_dropped).sum()) == 0
+    assert int(np.asarray(gs.faults_delayed).sum()) == 0
+
+
+def test_latency_inflation_delays_without_dropping():
+    """A pure latency-inflation window delays deliveries (counted) and
+    drops nothing; moving arrivals is a real behavioral change, so the
+    digest differs from the fault-free run."""
+    model, hosts, stop, kw = _CASES["echo"]
+    slow = {"seed": 7, "loss_windows": [
+        {"start": "0.05 s", "end": "0.15 s", "latency_factor": 3.0}]}
+    got = _run(model, hosts, stop, faults=slow, **kw)
+    s = jax.device_get(got.stats)
+    assert int(np.asarray(s.faults_dropped).sum()) == 0
+    assert int(np.asarray(s.faults_delayed).sum()) > 0
+    base = _baseline("echo")
+    assert not np.array_equal(
+        np.asarray(jax.device_get(base.stats).digest), np.asarray(s.digest)
+    )
+
+
+def test_fault_trace_columns():
+    """The trace ring's fault columns reconcile with the device counters
+    and the hosts_down gauge sees the churn windows."""
+    from shadow_tpu.obs.tracer import RoundTracer
+
+    model, hosts, stop, kw = _CASES["phold"]
+    _, eng, state, params = _build(
+        model, hosts, stop, faults=_FAULTS, trace_rounds=64, **kw
+    )
+    tracer = RoundTracer(64)
+    tracer.sync_cursor(state.trace)
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        jax.block_until_ready(state)
+        tracer.drain(state.trace)
+    t = tracer.totals()
+    s = jax.device_get(state.stats)
+    assert t["faults_dropped"] == int(np.asarray(s.faults_dropped).sum())
+    assert t["faults_delayed"] == int(np.asarray(s.faults_delayed).sum())
+    assert t["hosts_down_max"] > 0
+
+
+# ------------------------------------------------------- 3: supervisor
+
+
+_SUPERVISOR_RETRY_SCRIPT = """
+import json, sys
+import jax
+import numpy as np
+from shadow_tpu.core import Engine
+from shadow_tpu.core.supervisor import ChunkSupervisor
+from tests.engine_harness import build_sim, mk_hosts
+
+faults = json.loads(sys.argv[1])
+hosts = mk_hosts(8, {"mean_delay": "20 ms", "population": 3})
+# several chunks (so the injected failures land mid-run) via a LONGER
+# horizon, not tiny chunks: rounds_per_chunk=2 multiplies the dispatch
+# count ~4x and with it this box's corruption rate
+kw = dict(loss=0.1, rounds_per_chunk=8)
+
+
+def build():
+    cfg, m, params, mstate, events = build_sim(
+        "phold", hosts, 1_500_000_000, faults=faults, **kw
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    return eng, state, params
+
+
+def summary(state):
+    s = jax.device_get(state.stats)
+    return {"digest": np.asarray(s.digest).reshape(-1).tolist(),
+            "events": int(np.asarray(s.events).sum()),
+            "dropped": int(np.asarray(s.faults_dropped).sum()),
+            "delayed": int(np.asarray(s.faults_delayed).sum())}
+
+
+eng, state, params = build()
+while not bool(state.done):
+    state = eng.run_chunk(state, params)
+clean = summary(state)
+
+eng, state, params = build()
+sup = ChunkSupervisor(snapshot_every_chunks=1, max_retries=3,
+                      backoff_base_s=0.001)
+sup.note_state(state)
+calls = {"n": 0}
+
+
+def flaky(st):
+    calls["n"] += 1
+    if calls["n"] in (2, 4):
+        raise RuntimeError("injected dispatch failure")
+    return eng.run_chunk(st, params)
+
+
+chunks = 0
+while not bool(state.done):
+    state = sup.run_chunk(state, flaky)
+    chunks += 1
+    assert chunks < 500
+print(json.dumps({"clean": clean, "sup": summary(state),
+                  "retries": sup.retries, "restores": sup.restores,
+                  "aborted": sup.aborted}))
+"""
+
+
+def test_supervisor_retries_transient_failures_exactly():
+    """Injected dispatch failures (raise on chunks 2 and 4) recover from
+    the periodic snapshot with bounded retries, and the final digest is
+    bit-identical to an uninterrupted run.
+
+    Env note: this leg is THE magnet for the box's known jaxlib
+    corruption (two full engine builds + replay traffic in one process —
+    measured in-process SIGABRT/SIGSEGV on MOST runs, killing the whole
+    pytest process, and re-verified on pre-PR HEAD with no fault plane
+    at all), so it runs in the subprocess harness like the
+    compiled-Simulation legs and skips (never silently passes) on the
+    crash signature. The corruption can also scribble device state into
+    a wrong digest instead of aborting (CHANGES.md PR 2), so completed
+    attempts are CLASSIFIED the way tools/soak.py classifies: the
+    supervisor mechanics (retries/restores/aborted — host-side Python,
+    scribble-proof) assert hard on every attempt; a digest mismatch that
+    REPRODUCES IDENTICALLY across 3 fresh subprocesses is a
+    deterministic replay bug and fails; mismatching digests that VARY
+    across attempts are the documented scribble and skip."""
+    import json as _json
+
+    from tests.subproc import run_isolated_json
+
+    def attempt():
+        out = run_isolated_json(
+            _SUPERVISOR_RETRY_SCRIPT, _json.dumps(_FAULTS)
+        )
+        assert out["retries"] == 2 and out["restores"] == 2
+        assert not out["aborted"]
+        return out
+
+    _classified_digest_compare(attempt, "supervised replay")
+
+
+def test_supervisor_bounded_abort_keeps_last_good_state():
+    """A permanent failure aborts after max_retries, and last_good()
+    hands back the pre-failure snapshot (the completed prefix)."""
+    model, hosts, stop, kw = _CASES["phold"]
+    kw = dict(kw, rounds_per_chunk=2)
+    _, eng, state, params = _build(model, hosts, stop, faults=_FAULTS, **kw)
+    sup = ChunkSupervisor(snapshot_every_chunks=1, max_retries=2,
+                          backoff_base_s=0.001)
+    sup.note_state(state)
+    state = sup.run_chunk(state, lambda st: eng.run_chunk(st, params))
+    good_sig = state_digest_sig(state)
+
+    def broken(st):
+        raise RuntimeError("permanent dispatch failure")
+
+    with pytest.raises(SupervisorAbort):
+        sup.run_chunk(state, broken)
+    assert sup.aborted and sup.retries == 3  # max_retries + the first try
+    assert state_digest_sig(sup.last_good()) == good_sig
+    assert sup.poisoned_state() is None  # only the poisoned path uses it
+
+
+def test_supervisor_restore_resets_snapshot_cadence():
+    """A restore rewinds progress to the snapshot point, so the snapshot
+    cadence restarts from zero — a recovery must not trip an early
+    snapshot (extra HBM copy + on-disk write) on the first replayed
+    chunk."""
+    model, hosts, stop, kw = _CASES["phold"]
+    kw = dict(kw, rounds_per_chunk=2)
+    _, eng, state, params = _build(model, hosts, stop, faults=_FAULTS, **kw)
+    sup = ChunkSupervisor(snapshot_every_chunks=3, max_retries=2,
+                          backoff_base_s=0.001)
+    sup.note_state(state)
+    ok = lambda st: eng.run_chunk(st, params)
+    state = sup.run_chunk(state, ok)  # 1 chunk since snapshot
+
+    fails = iter([True])
+
+    def flaky(st):
+        if next(fails, False):
+            raise RuntimeError("transient dispatch failure")
+        return eng.run_chunk(st, params)
+
+    # fail -> restore (cadence resets) -> replay ok: 1 chunk since restore
+    state = sup.run_chunk(state, flaky)
+    assert sup.restores == 1 and sup.snapshots == 1
+    state = sup.run_chunk(state, ok)  # 2 since restore: still no snapshot
+    assert sup.snapshots == 1
+    state = sup.run_chunk(state, ok)  # 3 since restore: cadence fires
+    assert sup.snapshots == 2
+
+
+def test_supervisor_digest_cross_check_detects_divergence():
+    """A snapshot whose restored digest no longer matches the recorded
+    signature must abort (silent-divergence corruption), not replay."""
+    model, hosts, stop, kw = _CASES["phold"]
+    _, eng, state, params = _build(model, hosts, stop, faults=_FAULTS, **kw)
+    sup = ChunkSupervisor(snapshot_every_chunks=1, max_retries=2,
+                          backoff_base_s=0.001)
+    sup.note_state(state)
+    sup._snap_sig = (sup._snap_sig[0], sup._snap_sig[1] ^ 0xDEAD)  # poison
+
+    def fail_once(st):
+        raise RuntimeError("trigger a restore")
+
+    with pytest.raises(SupervisorAbort, match="cross-check"):
+        sup.run_chunk(state, fail_once)
+    # the snapshot is now untrustworthy: the supervisor must refuse to
+    # hand it back as a GOOD prefix, and must say so in the report —
+    # but the export fallback still materializes (the driver's in-hand
+    # state may hold donated-away buffers; artifacts flag poisoned=true)
+    assert sup.poisoned
+    assert sup.last_good() is None
+    assert sup.poisoned_state() is not None
+    assert sup.report()["poisoned"] is True
+
+
+# -------------------------------------------- compile / config units
+
+
+def test_compile_faults_units():
+    fo = FaultOptions.from_dict({
+        "seed": 3,
+        "crashes": [
+            {"host": 1, "down_at": "1 s", "up_at": "2 s"},
+            {"host": 1, "down_at": "1.5 s", "up_at": "3 s"},  # overlaps
+            {"host": "h2", "down_at": "4 s", "up_at": "5 s"},
+        ],
+    })
+    sched = compile_faults(
+        fo, num_hosts=4, stop_time=10_000_000_000,
+        name_to_id={"h2": 2},
+    )
+    assert sched.active and sched.crash_windows == 1  # merged to one window
+    down = np.asarray(sched.params.down_t)
+    up = np.asarray(sched.params.up_t)
+    assert down[1, 0] == 1_000_000_000 and up[1, 0] == 3_000_000_000
+    assert down[2, 0] == 4_000_000_000
+    assert sched.loss_windows == 0 and sched.params.win_start is None
+
+    # churn is a pure function of the fault seed
+    fo2 = FaultOptions.from_dict(
+        {"seed": 9, "host_churn": {"prob": 0.5, "mean_downtime": "1 s"}}
+    )
+    s1 = compile_faults(fo2, num_hosts=16, stop_time=10_000_000_000)
+    s2 = compile_faults(fo2, num_hosts=16, stop_time=10_000_000_000)
+    np.testing.assert_array_equal(
+        np.asarray(s1.params.down_t), np.asarray(s2.params.down_t)
+    )
+    # mesh padding never churns: padded lanes carry no windows
+    s3 = compile_faults(fo2, num_hosts=24, num_real=16,
+                        stop_time=10_000_000_000)
+    assert (np.asarray(s3.params.down_t)[16:] == np.iinfo(np.int64).max).all()
+    np.testing.assert_array_equal(
+        np.asarray(s3.params.down_t)[:16], np.asarray(s1.params.down_t)
+    )
+
+    with pytest.raises(ValueError, match="unknown host"):
+        compile_faults(
+            FaultOptions.from_dict(
+                {"crashes": [{"host": "nope", "down_at": "1 s",
+                              "up_at": "2 s"}]}
+            ),
+            num_hosts=4, stop_time=10_000_000_000, name_to_id={},
+        )
+    with pytest.raises(ValueError, match="out of range"):
+        compile_faults(
+            FaultOptions.from_dict(
+                {"crashes": [{"host": 9, "down_at": "1 s", "up_at": "2 s"}]}
+            ),
+            num_hosts=4, stop_time=10_000_000_000,
+        )
+    # the CLI-override path can setattr restart_queue raw — the compiler
+    # must reject unknown policies rather than silently degrade to hold
+    bad = FaultOptions.from_dict({"host_churn": {"prob": 0.5}})
+    bad.restart_queue = "wipe"
+    with pytest.raises(ValueError, match="hold\\|clear"):
+        compile_faults(bad, num_hosts=4, stop_time=10_000_000_000)
+
+
+def test_fault_rng_counter_based():
+    """Schedule draws are pure functions of (seed, host, counter)."""
+    a = fault_u64(1, np.arange(8), 0)
+    b = fault_u64(1, np.arange(8), 0)
+    np.testing.assert_array_equal(a, b)
+    assert (fault_u64(1, np.arange(8), 1) != a).any()
+    assert (fault_u64(2, np.arange(8), 0) != a).any()
+    u = fault_uniform(1, np.arange(1000), 0)
+    assert (0 <= u).all() and (u < 1).all()
+
+
+def test_fault_options_parse():
+    f = FaultOptions.from_dict(None)
+    assert not f.injecting and not f.supervisor.enabled
+    f = FaultOptions.from_dict({
+        "seed": 5,
+        "restart_queue": "clear",
+        "host_churn": {"prob": 0.2, "mean_downtime": "2 s"},
+        "loss_windows": [{"start": "1 s", "end": "2 s", "loss": 0.5,
+                          "latency_factor": 1.5}],
+        "supervisor": {"snapshot_every_chunks": 4,
+                       "checkpoint_file": "ck.npz", "max_retries": 5},
+    })
+    assert f.injecting and f.supervisor.enabled
+    assert f.host_churn.mean_downtime == 2_000_000_000
+    assert f.loss_windows[0].start == 1_000_000_000
+    with pytest.raises(ConfigError, match="restart_queue"):
+        FaultOptions.from_dict({"restart_queue": "wipe"})
+    with pytest.raises(ConfigError, match="prob"):
+        FaultOptions.from_dict({"host_churn": {"prob": 1.5}})
+    with pytest.raises(ConfigError, match="latency_factor"):
+        FaultOptions.from_dict({"loss_windows": [
+            {"start": "1 s", "end": "2 s", "latency_factor": 0.5}]})
+    with pytest.raises(ConfigError, match="loss"):
+        FaultOptions.from_dict({"loss_windows": [
+            {"start": "1 s", "end": "2 s", "loss": 2.0}]})
+    with pytest.raises(ConfigError, match="unknown faults"):
+        FaultOptions.from_dict({"nope": 1})
+    with pytest.raises(ConfigError, match="snapshot_every_chunks"):
+        FaultOptions.from_dict({"supervisor": {"snapshot_every_chunks": -1}})
+
+
+def test_engine_rejects_mismatched_fault_wiring():
+    """EngineConfig fault dims and EngineParams.faults must agree."""
+    from shadow_tpu.core import EngineConfig
+
+    with pytest.raises(ValueError, match="fault window"):
+        EngineConfig(num_hosts=4, stop_time=1, fault_crash_windows=-1)
+    # config says faults, params carry none -> loud at init_state
+    cfg, model, params, mstate, events = build_sim(
+        "phold", mk_hosts(4, {"mean_delay": "20 ms", "population": 2}),
+        100_000_000,
+    )
+    import dataclasses
+
+    bad = dataclasses.replace(cfg, fault_crash_windows=1)
+    eng = Engine(bad, model, None)
+    with pytest.raises(ValueError, match="FaultSchedule"):
+        eng.init_state(params, mstate, events, seed=1)
+
+
+def test_hybrid_rejects_crashes_allows_loss_windows():
+    """The hybrid driver refuses crash schedules (live CPU processes
+    cannot pause) but accepts link-fault windows."""
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.cosim import HybridSimulation
+
+    base = {
+        "general": {"stop_time": "1 s", "seed": 1},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "hosts": {
+            "a": {"network_node_id": 0,
+                  "processes": [{"path": "udp_echo_server"}]},
+        },
+    }
+    cfg = ConfigOptions.from_dict({
+        **base,
+        "faults": {"host_churn": {"prob": 0.5}},
+    })
+    with pytest.raises(ConfigError, match="hybrid"):
+        HybridSimulation(cfg, world=1)
+    # a durability knob the hybrid cannot honor is equally loud: its
+    # per-dispatch supervisor never writes on-disk checkpoints (the CPU
+    # plane cannot resume from a device checkpoint), so accepting
+    # checkpoint_file would be a silent drop discovered at crash time
+    cfg = ConfigOptions.from_dict({
+        **base,
+        "faults": {"supervisor": {"snapshot_every_chunks": 1,
+                                  "checkpoint_file": "ck.npz"}},
+    })
+    with pytest.raises(ConfigError, match="checkpoint_file"):
+        HybridSimulation(cfg, world=1)
+
+
+def test_golden_scheduler_rejects_faults():
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.sim import Simulation
+
+    cfg = ConfigOptions.from_dict({
+        "general": {"stop_time": "1 s", "seed": 1},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"scheduler": "cpu-reference"},
+        "faults": {"host_churn": {"prob": 0.5}},
+        "hosts": {"n": {"count": 4, "network_node_id": 0,
+                        "processes": [{"model": "timer",
+                                       "model_args": {"interval": "100 ms"}}]}},
+    })
+    with pytest.raises(ConfigError, match="cpu-reference"):
+        Simulation(cfg, world=1)
+
+
+# -------------------------------------- heartbeat / subproc satellites
+
+
+def test_heartbeat_regex_faults_and_old_formats():
+    """parse_shadow must read the new faults= field AND keep parsing the
+    older line formats verbatim (one literal line per generation)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    faulty = ("[heartbeat] sim_time=1.043s wall=1.83s events=400 rounds=264 "
+              "msteps/round=1.0 ev/mstep=1.44 ici_bytes=0 q_hwm=8 "
+              "faults=20/38 ratio=0.57x rss_gib=0.85")
+    m = HEARTBEAT_RE.search(faulty)
+    assert m and m.group("faults_dropped") == "20"
+    assert m.group("faults_delayed") == "38" and m.group("ratio") == "0.57"
+    # literal PRE-fault-plane formats, one per generation:
+    old_pr2 = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+               "msteps/round=3.0 ev/mstep=3.33 ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(old_pr2)
+    assert m and m.group("faults_dropped") is None
+    assert m.group("ratio") == "0.40"
+    old_pr4 = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+               "msteps/round=3.0 ev/mstep=3.33 ici_bytes=4096 q_hwm=7 "
+               "gear=2 ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(old_pr4)
+    assert m and m.group("gear") == "2" and m.group("faults_dropped") is None
+    hybrid = ("[heartbeat] sim_time=1.000s wall=2.50s windows=10 "
+              "faults=3/4 gear=4 ratio=0.40x")
+    m = HEARTBEAT_RE.search(hybrid)
+    assert m and m.group("faults_dropped") == "3" and m.group("windows") == "10"
+
+
+def test_subproc_retries_one_off_abort(tmp_path):
+    """tests/subproc.py retries the corruption signature once: a script
+    that aborts on its first attempt and succeeds on the second must
+    come back as a normal completed process, not a skip."""
+    from tests.subproc import run_isolated
+
+    sentinel = tmp_path / "second_try"
+    script = f"""
+import os, sys
+p = {str(sentinel)!r}
+if not os.path.exists(p):
+    open(p, "w").close()
+    os.abort()
+print("survived")
+"""
+    proc = run_isolated(script, prelude=False)
+    assert proc.returncode == 0 and "survived" in proc.stdout
+
+
+def test_subproc_skips_after_exhausted_retries():
+    from _pytest.outcomes import Skipped
+
+    from tests.subproc import run_isolated
+
+    with pytest.raises(Skipped, match="2/2 attempts"):
+        run_isolated("import os; os.abort()", prelude=False)
+
+
+# -------------------------------------- compiled-Simulation legs (subproc)
+
+_KILL_RESUME_SCRIPT = """
+import json, os, sys
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+from shadow_tpu.core.checkpoint import load_checkpoint
+
+data_dir, mode = sys.argv[1], sys.argv[2]
+cfgd = {
+  'general': {'stop_time': '2 s', 'seed': 1, 'heartbeat_interval': None,
+              'data_directory': data_dir},
+  'network': {'graph': {'type': '1_gbit_switch'}},
+  'experimental': {'event_queue_capacity': 16, 'rounds_per_chunk': 8},
+  'faults': {'seed': 7,
+             'host_churn': {'prob': 0.4, 'mean_downtime': '0.3 s'},
+             'supervisor': {'snapshot_every_chunks': 2,
+                            'checkpoint_file': 'resume.npz'}},
+  'hosts': {'node': {'count': 12, 'network_node_id': 0,
+      'processes': [{'model': 'phold',
+                     'model_args': {'population': 2, 'mean_delay': '100 ms',
+                                    'size_bytes': 64}}]}},
+}
+cfg = ConfigOptions.from_dict(cfgd)
+sim = Simulation(cfg, world=1)
+ck = os.path.join(data_dir, 'resume.npz')
+if mode == 'resume' and os.path.exists(ck):
+    load_checkpoint(ck, sim)
+rep = sim.run(log=sys.stderr)
+print(json.dumps({'digest': rep['determinism_digest'],
+                  'events': rep['events_processed'],
+                  'supervisor': rep.get('supervisor')}))
+"""
+
+
+def test_kill_resume_digest_equal(tmp_path):
+    """The satellite crash-recovery gate: SIGKILL a driver mid-run (the
+    supervisor's kill-at-checkpoint hook delivers a real SIGKILL), resume
+    from the on-disk checkpoint, and the final digest equals an
+    uninterrupted run's. Mismatches CLASSIFY like the sibling
+    `_classified_digest_compare` gates and tools/soak.py: the same
+    mismatch reproducing across 3 fresh kill+resume cycles is a
+    deterministic recovery bug (FAIL); varying mismatches are this box's
+    documented pre-crash device-memory scribble poisoning the checkpoint
+    (CHANGES.md PR 2 env note) — skip, never a silent pass."""
+    import subprocess
+
+    from tests.subproc import run_isolated_json
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(
+            [os.path.abspath(os.path.join(os.path.dirname(__file__), "..")),
+             os.environ.get("PYTHONPATH", "")]
+        ),
+        SHADOW_TPU_TEST_KILL_AT_CHECKPOINT="3",
+    )
+    prelude = "import jax\njax.config.update('jax_platforms', 'cpu')\n"
+
+    def attempt(idx: int):
+        base = tmp_path / f"a{idx}"
+        ref = run_isolated_json(
+            _KILL_RESUME_SCRIPT, str(base / "ref"), "fresh"
+        )
+        # the kill leg dies by design (SIGKILL at the 3rd checkpoint):
+        # drive it directly — run_isolated would mistake an intentional
+        # -9 + empty stdout for an ordinary completed process, and we
+        # must also tolerate it dying EARLIER of the box's spontaneous
+        # corruption (the resume below recovers either way, from
+        # whatever checkpoint landed)
+        proc = subprocess.run(
+            [sys.executable, "-c", prelude + _KILL_RESUME_SCRIPT,
+             str(base / "kill"), "fresh"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert proc.returncode != 0, "kill leg unexpectedly survived"
+        if not os.path.exists(base / "kill" / "resume.npz"):
+            pytest.skip(
+                "kill leg died before its first checkpoint landed "
+                f"(rc={proc.returncode}): nothing to resume on this box"
+            )
+        res = run_isolated_json(
+            _KILL_RESUME_SCRIPT, str(base / "kill"), "resume"
+        )
+        return ref, res
+
+    pairs = []
+    for i in range(3):
+        ref, res = attempt(i)
+        if res["digest"] == ref["digest"]:
+            assert res["events"] == ref["events"]
+            return
+        pairs.append((ref["digest"], res["digest"]))
+    assert len(set(pairs)) > 1, (
+        "kill+resume deterministically diverges (identical mismatch on "
+        f"3 fresh cycles): resumed {pairs[0][1]} != reference {pairs[0][0]}"
+    )
+    pytest.skip(
+        f"kill+resume digests mismatched DIFFERENTLY across 3 attempts "
+        f"({pairs}): the documented pre-crash device-memory scribble "
+        "poisons checkpoints written near a crash (CHANGES.md PR 2 env "
+        "note), not a deterministic recovery bug"
+    )
+
+
+_ABORT_EXPORT_SCRIPT = """
+import json, os, sys
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+data_dir = sys.argv[1]
+cfgd = {
+  'general': {'stop_time': '2 s', 'seed': 1, 'heartbeat_interval': None,
+              'data_directory': data_dir},
+  'network': {'graph': {'type': '1_gbit_switch'}},
+  'experimental': {'event_queue_capacity': 16, 'rounds_per_chunk': 8},
+  'observability': {'trace': True},
+  'faults': {'supervisor': {'snapshot_every_chunks': 1, 'max_retries': 2,
+                            'backoff_base_ms': 1}},
+  'hosts': {'node': {'count': 12, 'network_node_id': 0,
+      'processes': [{'model': 'phold',
+                     'model_args': {'population': 2, 'mean_delay': '100 ms',
+                                    'size_bytes': 64}}]}},
+}
+cfg = ConfigOptions.from_dict(cfgd)
+sim = Simulation(cfg, world=1)
+# force a PERMANENT dispatch failure from chunk 3 on
+real = sim.engine.run_chunk
+calls = {'n': 0}
+def broken(state, params):
+    calls['n'] += 1
+    if calls['n'] >= 3:
+        raise RuntimeError('injected permanent dispatch failure')
+    return real(state, params)
+sim.engine.run_chunk = broken
+rep = sim.run(log=sys.stderr)
+sim.write_outputs(report=rep)
+print(json.dumps({
+    'aborted': rep.get('aborted', False),
+    'retries': rep['supervisor']['retries'],
+    'rounds': rep['rounds'],
+    'have_stats': os.path.exists(os.path.join(data_dir, 'sim-stats.json')),
+    'have_trace': os.path.exists(os.path.join(data_dir, 'trace.json')),
+}))
+"""
+
+
+def test_permanent_failure_still_exports_prefix(tmp_path):
+    """Acceptance: a forced permanent dispatch failure aborts with
+    bounded retries AND still writes sim-stats/trace artifacts for the
+    completed prefix."""
+    from tests.subproc import run_isolated_json
+
+    out = run_isolated_json(_ABORT_EXPORT_SCRIPT, str(tmp_path / "d"))
+    assert out["aborted"] is True
+    assert out["retries"] == 3  # max_retries(2) + the first attempt
+    assert out["rounds"] > 0  # the completed prefix, not an empty run
+    assert out["have_stats"] and out["have_trace"]

@@ -9,6 +9,12 @@
 # Usage: tools/check_tier1.sh [extra pytest args...]
 #   e.g. tools/check_tier1.sh -k gears
 # Exit code is pytest's; DOTS_PASSED=<n> is printed last either way.
+#
+# Optional second stage: TIER1_SOAK=1 additionally runs the 2-minute
+# crash-recovery soak smoke (tools/soak.py --smoke: SIGKILL + resume +
+# digest-exactness on a faulty scenario). Its failure is folded into the
+# exit code only when the pytest stage passed, so the primary signal
+# stays pytest's.
 set -o pipefail
 cd "$(dirname "$0")/.."
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
@@ -19,4 +25,12 @@ timeout -k 10 "${TIER1_TIMEOUT:-870}" \
   -p no:randomly "$@" 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+if [ -n "${TIER1_SOAK:-}" ]; then
+  echo "== soak smoke (TIER1_SOAK) =="
+  timeout -k 10 "${TIER1_SOAK_TIMEOUT:-150}" \
+    env JAX_PLATFORMS=cpu python tools/soak.py --smoke
+  soak_rc=$?
+  echo "SOAK_RC=$soak_rc"
+  [ "$rc" -eq 0 ] && rc=$soak_rc
+fi
 exit $rc

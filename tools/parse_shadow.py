@@ -25,6 +25,9 @@ HEARTBEAT_RE = re.compile(
     # PR 3 observability fields; optional so pre-PR-3 logs still parse
     r"(?:ici_bytes=(?P<ici_bytes>\d+) )?"
     r"(?:q_hwm=(?P<q_hwm>\d+) )?"
+    # PR 5 fault-plane field (only emitted on faulty runs):
+    # faults=<dropped>/<delayed>, cumulative
+    r"(?:faults=(?P<faults_dropped>\d+)/(?P<faults_delayed>\d+) )?"
     # PR 4 adaptive-exchange field (only emitted on merge_gears runs)
     r"(?:gear=(?P<gear>\d+) )?"
     r"ratio=(?P<ratio>[\d.]+)x"
