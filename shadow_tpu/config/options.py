@@ -629,6 +629,143 @@ class FaultOptions:
 
 
 @dataclass
+class CampaignOptions:
+    """The ensemble plane's sweep declaration (core/ensemble.py +
+    tools/campaign.py + docs/architecture.md "Ensemble plane"): one
+    vmapped program advances R replicas — seed sweeps, fault-schedule
+    sweeps, A/B override pairs — per dispatch, with each replica
+    bit-identical to its solo run (tests/test_ensemble.py is the gate).
+
+    Replicas are the CROSS PRODUCT of the declared axes (an omitted axis
+    contributes the base config), in (seed, fault_schedule, override)
+    nesting order — so replica indices are stable and documentable:
+    index = ((seed_i * len(fault_schedules)) + fault_i) * len(overrides)
+    + override_i."""
+
+    # seed axis: explicit list, or {start: S, count: N} for a range;
+    # empty = [general.seed]
+    seeds: list[int] = field(default_factory=list)
+    # fault-schedule axis: each entry is a full `faults:` block (injection
+    # fields only — the campaign's supervisor comes from the top-level
+    # faults block), kept as the RAW mapping (validated at parse) because
+    # the campaign driver expands replicas at the config-dict level;
+    # empty = [the top-level faults block]
+    fault_schedules: list[dict] = field(default_factory=list)
+    # override axis: each entry maps dotted config paths to values
+    # (the merge_cli_overrides syntax), e.g. {"experimental.cpu_delay": 2}.
+    # Only values that change ARRAYS may vary — anything that changes an
+    # EngineConfig static (shapes, queue layout, K, policies) is rejected
+    # at build time. empty = [{}]
+    overrides: list[dict] = field(default_factory=list)
+    # replica index pairs expected to end bit-identical (A/A controls, or
+    # A/B pairs whose delta should be inert); a pair that diverges is
+    # reported in the ledger and — when `bisect` is on — pinpointed to
+    # its first divergent chunk by snapshot-replay binary search
+    expect_identical: list[list[int]] = field(default_factory=list)
+    # per-replica digest ledger, written into general.data_directory
+    # (null disables)
+    ledger_file: str | None = "campaign-ledger.json"
+    bisect: bool = True
+    # replica-count guard: a campaign multiplies state HBM by R
+    max_replicas: int = 64
+
+    @property
+    def active(self) -> bool:
+        """True when the block declares any sweep axis."""
+        return bool(self.seeds or self.fault_schedules or self.overrides)
+
+    @property
+    def num_replicas(self) -> int:
+        return (
+            max(len(self.seeds), 1)
+            * max(len(self.fault_schedules), 1)
+            * max(len(self.overrides), 1)
+        )
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "CampaignOptions":
+        d = dict(d or {})
+        seeds_raw = d.pop("seeds", []) or []
+        if isinstance(seeds_raw, dict):
+            sd = dict(seeds_raw)
+            start, count = int(sd.pop("start", 1)), int(sd.pop("count", 0))
+            if sd:
+                raise ConfigError(f"unknown campaign.seeds keys: {sorted(sd)}")
+            if count < 1:
+                raise ConfigError(
+                    f"campaign.seeds.count must be >= 1, got {count}"
+                )
+            seeds = list(range(start, start + count))
+        else:
+            seeds = [int(s) for s in seeds_raw]
+        scheds = []
+        for i, f in enumerate(d.pop("fault_schedules", []) or []):
+            f = dict(f or {})
+            parsed = FaultOptions.from_dict(f)  # loud validation up front
+            if parsed.supervisor.enabled or parsed.supervisor.checkpoint_file:
+                raise ConfigError(
+                    f"campaign.fault_schedules[{i}]: supervisor settings "
+                    f"belong in the top-level faults block (the supervisor "
+                    f"wraps the whole campaign, not one replica)"
+                )
+            scheds.append(f)
+        overrides = []
+        for i, ov in enumerate(d.pop("overrides", []) or []):
+            if ov is None:
+                ov = {}
+            if not isinstance(ov, dict):
+                raise ConfigError(
+                    f"campaign.overrides[{i}] must be a mapping of dotted "
+                    f"config paths to values, got {ov!r}"
+                )
+            overrides.append(dict(ov))
+        pairs = []
+        for i, p in enumerate(d.pop("expect_identical", []) or []):
+            if (
+                not isinstance(p, (list, tuple))
+                or len(p) != 2
+                or not all(isinstance(x, int) and x >= 0 for x in p)
+            ):
+                raise ConfigError(
+                    f"campaign.expect_identical[{i}] must be a pair of "
+                    f"replica indices, got {p!r}"
+                )
+            pairs.append([int(p[0]), int(p[1])])
+        c = CampaignOptions(
+            seeds=seeds,
+            fault_schedules=scheds,
+            overrides=overrides,
+            expect_identical=pairs,
+            ledger_file=d.pop("ledger_file", "campaign-ledger.json"),
+            bisect=bool(d.pop("bisect", True)),
+            max_replicas=int(d.pop("max_replicas", 64)),
+        )
+        if c.ledger_file is not None and not str(c.ledger_file):
+            raise ConfigError(
+                "campaign.ledger_file must be non-empty (use null to disable)"
+            )
+        if c.max_replicas < 1:
+            raise ConfigError(
+                f"campaign.max_replicas must be >= 1, got {c.max_replicas}"
+            )
+        if c.active and c.num_replicas > c.max_replicas:
+            raise ConfigError(
+                f"campaign declares {c.num_replicas} replicas, over "
+                f"max_replicas={c.max_replicas} (each replica holds a full "
+                f"SimState in device memory; raise the guard deliberately)"
+            )
+        for p in c.expect_identical:
+            if max(p) >= c.num_replicas:
+                raise ConfigError(
+                    f"campaign.expect_identical pair {p} references a "
+                    f"replica >= num_replicas={c.num_replicas}"
+                )
+        if d:
+            raise ConfigError(f"unknown campaign options: {sorted(d)}")
+        return c
+
+
+@dataclass
 class ProcessOptions:
     """reference: ProcessOptions (configuration.rs:643).
 
@@ -784,6 +921,7 @@ class ConfigOptions:
         default_factory=ObservabilityOptions
     )
     faults: FaultOptions = field(default_factory=FaultOptions)
+    campaign: CampaignOptions = field(default_factory=CampaignOptions)
     host_option_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: list[HostOptions] = field(default_factory=list)
 
@@ -813,6 +951,7 @@ class ConfigOptions:
                 d.pop("observability", None)
             ),
             faults=FaultOptions.from_dict(d.pop("faults", None)),
+            campaign=CampaignOptions.from_dict(d.pop("campaign", None)),
             host_option_defaults=defaults,
             hosts=hosts,
         )

@@ -17,17 +17,25 @@ from shadow_tpu.core.engine import (
 )
 from shadow_tpu.core.faults import FaultParams, FaultSchedule, compile_faults
 from shadow_tpu.core.supervisor import ChunkSupervisor, SupervisorAbort
+from shadow_tpu.core.ensemble import (
+    EnsembleEngine,
+    bisect_divergence,
+    build_ensemble,
+)
 
 __all__ = [
     "ChunkSupervisor",
     "Engine",
     "EngineConfig",
     "EngineParams",
+    "EnsembleEngine",
     "FaultParams",
     "FaultSchedule",
     "Outbox",
     "SimState",
     "Stats",
     "SupervisorAbort",
+    "bisect_divergence",
+    "build_ensemble",
     "compile_faults",
 ]

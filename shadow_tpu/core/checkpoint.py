@@ -72,9 +72,10 @@ def _dump_leaves(state) -> tuple[dict, object]:
     return arrays, treedef
 
 
-def _restore_leaves(data, state, engine):
+def _restore_leaves(data, state, engine=None):
     """Validate the stored leaves against `state`'s tree and rebuild it,
-    re-sharding onto the engine's mesh when present."""
+    re-sharding onto the engine's mesh when present (ensemble restores
+    pass engine=None: world=1, no mesh to re-shard onto)."""
     leaves, treedef = jax.tree_util.tree_flatten(state)
     new_leaves = []
     for i in range(len(leaves)):
@@ -85,7 +86,7 @@ def _restore_leaves(data, state, engine):
         new_leaves.append(jnp.asarray(arr))
     out = jax.tree_util.tree_unflatten(treedef, new_leaves)
     out = _refresh_queue_caches(out)
-    if engine.mesh is not None:
+    if engine is not None and engine.mesh is not None:
         specs = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(engine.mesh, s),
             engine.state_specs(),
@@ -99,12 +100,19 @@ def _refresh_queue_caches(state):
     merge): a bucketed queue's (bt, bo, bfill) minima are derived state, so
     they are recomputed from the restored slab rather than trusted from the
     file — a hand-edited or bit-rotted .npz can desynchronize the caches but
-    never the simulation."""
+    never the simulation. Ensemble states carry a leading replica axis on
+    every plane; the rebuild vmaps over it (same derivation per replica)."""
     from shadow_tpu.ops.events import BucketQueue, bucket_rebuild
 
     q = getattr(state, "queue", None)
     if isinstance(q, BucketQueue):
-        state = state._replace(queue=bucket_rebuild(q, q.block))
+        if q.t.ndim == 3:  # [R, H, C]: stacked ensemble queue
+            block = q.t.shape[2] // q.bt.shape[2]
+            state = state._replace(
+                queue=jax.vmap(lambda qq: bucket_rebuild(qq, block))(q)
+            )
+        else:
+            state = state._replace(queue=bucket_rebuild(q, q.block))
     return state
 
 
@@ -153,6 +161,59 @@ def load_checkpoint(path: str, sim) -> None:
             "model, or engine version)"
         )
     sim.state = _restore_leaves(data, sim.state, sim.engine)
+
+
+# ---------------------------------------------------------------- ensemble
+
+
+def ensemble_fingerprint(engine_cfg, state, params, replica_meta) -> str:
+    """Guard record for campaign checkpoints: the reconciled EngineConfig,
+    the STACKED state treedef (carries R in every leaf shape via the
+    treedef + leaf validation), the stacked params digest, and the
+    replica metadata (labels/seeds/schedule descriptors from the campaign
+    expansion) — so a checkpoint written by one campaign refuses to
+    restore into a differently-composed one, even when shapes happen to
+    match."""
+    _, treedef = jax.tree_util.tree_flatten(state)
+    blob = json.dumps(
+        {
+            "cfg": dataclasses.asdict(engine_cfg),
+            "treedef": str(treedef),
+            "params": _params_digest(params),
+            "replicas": replica_meta,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def save_ensemble_checkpoint(path: str, state, fingerprint: str) -> str:
+    """Snapshot a stacked ensemble SimState (every leaf [R, ...]). The
+    campaign supervisor's periodic on-disk durability point — same .npz
+    layout as the solo checkpoints, guarded by `ensemble_fingerprint`."""
+    arrays, _ = _dump_leaves(state)
+    arrays["__guard__"] = np.frombuffer(
+        fingerprint.encode(), dtype=np.uint8
+    )
+    if not path.endswith(".npz"):
+        path += ".npz"
+    np.savez_compressed(path, **arrays)
+    return path
+
+
+def load_ensemble_checkpoint(path: str, state, fingerprint: str):
+    """Restore a stacked ensemble state saved by `save_ensemble_checkpoint`
+    into a freshly built campaign of the same composition. `state` is the
+    fresh stacked state (tree/shape template); returns the restored one
+    (bucket caches rebuilt per replica, like the solo path)."""
+    data = np.load(path)
+    got = bytes(data["__guard__"]).decode()
+    if got != fingerprint:
+        raise CheckpointError(
+            "ensemble checkpoint does not match this campaign (different "
+            "config, replica composition, or engine version)"
+        )
+    return _restore_leaves(data, state, engine=None)
 
 
 # ---------------------------------------------------------------- hybrid

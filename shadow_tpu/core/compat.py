@@ -1,0 +1,28 @@
+"""Shared jax-version compatibility shims for the device plane.
+
+One home for the API-drift adapters every driver needs, so call sites
+(`core/engine.py`, `cosim.py`) import ONE public helper instead of
+reaching into another module's privates — `cosim.py` used to import
+`engine._shard_map` at two call sites, which coupled the bridge to an
+engine-internal name.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map with a fallback for older jax (< 0.5: the API lives
+    in jax.experimental.shard_map and the replication check is
+    `check_rep`)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )

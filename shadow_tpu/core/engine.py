@@ -119,19 +119,9 @@ from shadow_tpu.simtime import TIME_MAX
 AXIS = "hosts"  # mesh axis name for the host dimension
 
 
-def _shard_map(f, mesh, in_specs, out_specs):
-    """jax.shard_map with a fallback for older jax (< 0.5: the API lives in
-    jax.experimental.shard_map and the replication check is `check_rep`)."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
-    from jax.experimental.shard_map import shard_map as _sm
-
-    return _sm(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
-    )
+# the jax<0.5 shard_map shim lives in core/compat.py (shared with the
+# co-simulation bridge); the old private name stays importable here
+from shadow_tpu.core.compat import shard_map_compat as _shard_map
 
 _FNV_PRIME = jnp.uint64(1099511628211)
 _MIX1 = jnp.uint64(0x9E3779B97F4A7C15)

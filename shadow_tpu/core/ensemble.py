@@ -1,0 +1,435 @@
+"""The ensemble plane: vmapped replica campaigns over one jitted round loop.
+
+PR 5 made every scenario a pure function of (seed, fault schedule); this
+module exploits that purity at the program level. BASELINE.md r6 measured
+~83% of the CPU microstep as full-width handler dispatch — per-dispatch
+cost that is IDENTICAL work for every independent replica of a workload.
+Stacking R replicas' variable state/param leaves along a leading axis and
+`jax.vmap`-ing the chunk body (`core/engine._run_chunk`) into one jitted
+program advances R seed sweeps / fault-schedule sweeps / A/B config pairs
+per dispatch, amortizing that fixed cost across the whole campaign — the
+paper's "run many experiments over a simulated network" use case at
+hardware speed (Rain's microsecond-scale-workload economics in PAPERS.md
+is the same argument: keep the hot loop dense, move orchestration off it).
+
+Exactness contract (tests/test_ensemble.py is the gate): replica r of a
+vmapped run is BIT-IDENTICAL — digest, event count, every drop and fault
+counter — to a solo run of the same (seed, fault schedule, params).
+Nothing crosses the replica axis: vmap adds a batch dimension to every
+per-replica op, `lax.while_loop`'s batching rule runs the loop while ANY
+replica's condition holds and select-masks finished replicas' carries
+(a frozen lane is exactly a solo run that stopped), and all cross-host
+reductions stay within a replica. Leaves identical across replicas
+(routing tables, static model params) are NOT stacked — they broadcast
+via `in_axes=None`, so a campaign's HBM cost is R x (state + varying
+params), not R x everything.
+
+What may vary per replica: array VALUES only — RNG seeds, model
+state/params built from different seeds or model args, fault schedules
+(padded to common static dims, see `reconcile_fault_statics`), numeric
+EngineParams leaves (latencies, loss, token buckets). What may NOT vary:
+anything the trace specializes on — every EngineConfig static (shapes,
+queue layout, K, exchange, policies). `build_ensemble` enforces this
+loudly.
+
+Scope this round: world=1 only (a replica axis on top of a device mesh
+is a 2-D mesh program — a later PR). `EnsembleEngine` raises ConfigError
+for world > 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config.options import ConfigError
+from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
+from shadow_tpu.core.engine import (
+    EngineConfig,
+    EngineParams,
+    SimState,
+    _run_chunk,
+)
+from shadow_tpu.core.faults import FaultParams, LAT_SCALE
+from shadow_tpu.simtime import TIME_MAX
+
+# fields EngineConfig may legitimately differ in across replicas BEFORE
+# reconciliation: the fault static dims, which reconcile_fault_statics
+# pads to a common maximum (crash-window padding with never-firing
+# TIME_MAX windows is exact; see the loss-window rule below), and the
+# restart-queue policy, which is value-inert for replicas without crash
+# windows and must merely agree among those WITH them (checked there)
+_RECONCILED_FIELDS = (
+    "fault_crash_windows",
+    "fault_loss_windows",
+    "fault_queue_clear",
+)
+
+
+def tree_stack(trees: Sequence[Any]):
+    """Stack R same-structure pytrees along a new leading replica axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_index(tree, r: int):
+    """Extract replica r's slice of a stacked pytree (host-side view)."""
+    return jax.tree.map(lambda a: a[r], tree)
+
+
+def _leaves_equal(*xs) -> bool:
+    a0 = np.asarray(xs[0])
+    return all(np.array_equal(a0, np.asarray(x)) for x in xs[1:])
+
+
+_BCAST = object()  # per-leaf marker: identical across replicas, broadcast
+
+
+def stack_params(params_list: Sequence[EngineParams]):
+    """(stacked_params, in_axes_tree): leaves identical across replicas
+    stay single-copy and broadcast (`in_axes=None`); differing leaves are
+    stacked along axis 0. The equality check runs host-side ONCE per leaf
+    at build time (the marker tree below feeds both outputs — `None`
+    itself cannot carry through `tree.map`, it reads as an empty
+    subtree) — campaign builds are seconds-scale, and the payoff is that
+    the replicated routing tables (the dominant EngineParams bytes on
+    multi-node graphs) are never duplicated R times in HBM."""
+    marks = jax.tree.map(
+        lambda *xs: _BCAST if _leaves_equal(*xs) else 0, *params_list
+    )
+    stacked = jax.tree.map(
+        lambda m, *xs: xs[0] if m is _BCAST else jnp.stack(xs),
+        marks,
+        *params_list,
+    )
+    axes = jax.tree.map(lambda m: None if m is _BCAST else 0, marks)
+    return stacked, axes
+
+
+# ------------------------------------------------------ fault reconciliation
+
+
+def _pad_fault_params(
+    fp: FaultParams | None, w: int, l: int, num_hosts: int
+) -> FaultParams | None:
+    """Pad one replica's fault arrays to common static dims (W crash
+    windows, L loss windows). Padding is EXACT by construction:
+
+      crash windows — a [TIME_MAX, TIME_MAX) window contains no time, so
+      the down mask, resume floor, and every hold/clear decision are
+      unchanged; a replica with no crashes at all gets an all-TIME_MAX
+      [H, W] pair, and the traced hold/clear plumbing is value-inert for
+      it (resume floor 0, no down event ever).
+
+      loss windows — a [0, 0) window is never active and pads with
+      loss 0 / latency x1.0, so `window_effects`' max-reductions are
+      unchanged. Crucially the per-send fault-loss RNG DRAW count depends
+      only on L > 0 (one draw per send), not on L's value — so padding
+      L upward never shifts a replica's RNG stream.
+    """
+    if w == 0 and l == 0:
+        return None
+    down_t = up_t = win_start = win_end = win_loss = win_lat = None
+    if w:
+        if fp is not None and fp.down_t is not None:
+            have = fp.down_t.shape[1]
+            if have < w:
+                pad = jnp.full((num_hosts, w - have), TIME_MAX, jnp.int64)
+                down_t = jnp.concatenate([fp.down_t, pad], axis=1)
+                up_t = jnp.concatenate([fp.up_t, pad], axis=1)
+            else:
+                down_t, up_t = fp.down_t, fp.up_t
+        else:
+            down_t = jnp.full((num_hosts, w), TIME_MAX, jnp.int64)
+            up_t = jnp.full((num_hosts, w), TIME_MAX, jnp.int64)
+    if l:
+        # the L > 0 mixing rule is enforced upstream; here every replica
+        # has at least one real window, so only upward padding remains
+        have = fp.win_start.shape[0]
+        if have < l:
+            pad = l - have
+            win_start = jnp.concatenate(
+                [fp.win_start, jnp.zeros((pad,), jnp.int64)]
+            )
+            win_end = jnp.concatenate(
+                [fp.win_end, jnp.zeros((pad,), jnp.int64)]
+            )
+            win_loss = jnp.concatenate(
+                [fp.win_loss, jnp.zeros((pad,), jnp.float32)]
+            )
+            win_lat = jnp.concatenate(
+                [fp.win_lat, jnp.full((pad,), LAT_SCALE, jnp.int64)]
+            )
+        else:
+            win_start, win_end = fp.win_start, fp.win_end
+            win_loss, win_lat = fp.win_loss, fp.win_lat
+    return FaultParams(down_t, up_t, win_start, win_end, win_loss, win_lat)
+
+
+def reconcile_fault_statics(
+    cfgs: Sequence[EngineConfig], params_list: Sequence[EngineParams]
+) -> tuple[EngineConfig, list[EngineParams]]:
+    """One EngineConfig + per-replica padded params for a mixed-schedule
+    campaign. Crash-window dims pad freely (0 -> W is exact: the hold
+    floor of a never-down host is 0 and clear mode never fires, with no
+    RNG consequences). Loss windows may NOT mix presence: L > 0 traces
+    one extra RNG draw per send into the program, so a replica with no
+    loss windows can never be bit-identical to its solo build inside a
+    program that has them — the campaign must be split, or the replica
+    given a real (possibly far-future) window explicitly."""
+    base = cfgs[0]
+    for i, c in enumerate(cfgs[1:], start=1):
+        norm = {f: 0 for f in _RECONCILED_FIELDS}
+        if dataclasses.replace(c, **norm) != dataclasses.replace(base, **norm):
+            diffs = [
+                f.name
+                for f in dataclasses.fields(base)
+                if f.name not in _RECONCILED_FIELDS
+                and getattr(c, f.name) != getattr(base, f.name)
+            ]
+            raise ConfigError(
+                f"ensemble replicas must share every EngineConfig static "
+                f"(replica {i} differs from replica 0 in {diffs}); "
+                f"per-replica variation is array VALUES only — seeds, "
+                f"fault schedules, numeric params"
+            )
+    ls = [c.fault_loss_windows for c in cfgs]
+    if any(ls) and not all(ls):
+        raise ConfigError(
+            "ensemble replicas must agree on loss-window PRESENCE: "
+            "fault_loss_windows > 0 traces one extra RNG draw per send, "
+            "so mixing faulty and fault-free link schedules in one "
+            "vmapped program would shift the fault-free replicas' RNG "
+            "streams off their solo runs — split the campaign, or give "
+            "every replica at least one loss window"
+        )
+    w = max(c.fault_crash_windows for c in cfgs)
+    l = max(ls)
+    clears = {
+        c.fault_queue_clear for c in cfgs if c.fault_crash_windows > 0
+    }
+    if len(clears) > 1:
+        raise ConfigError(
+            "ensemble replicas with crash windows must share one "
+            "restart_queue policy (hold vs clear is a trace-time static)"
+        )
+    clear = clears.pop() if clears else base.fault_queue_clear
+    common = dataclasses.replace(
+        base,
+        fault_crash_windows=w,
+        fault_loss_windows=l,
+        fault_queue_clear=clear if w else base.fault_queue_clear,
+    )
+    h = common.num_hosts
+    padded = [
+        p._replace(faults=_pad_fault_params(p.faults, w, l, h))
+        for p in params_list
+    ]
+    return common, padded
+
+
+# ------------------------------------------------------------ the engine
+
+
+class EnsembleEngine:
+    """R replicas of one EngineConfig advanced by a single vmapped chunk
+    program. Built via `build_ensemble` (which reconciles configs and
+    stacks the leaves); `run_chunk(state)` then advances every replica
+    one chunk per dispatch, donating the stacked state exactly like the
+    solo engine. Per-replica stats/digests stay separate end-to-end —
+    every Stats leaf simply grows a leading [R] axis."""
+
+    def __init__(self, cfg: EngineConfig, model):
+        if cfg.world != 1:
+            raise ConfigError(
+                f"the ensemble plane runs world=1 this round (got world="
+                f"{cfg.world}): a replica axis over a device mesh is a 2-D "
+                f"mesh program — shard the campaign across processes, or "
+                f"drop general.parallelism to 1"
+            )
+        self.cfg = cfg
+        self.model = model
+        self.num_replicas = 0
+        self._params = None
+        self._chunk = None
+
+    def build(
+        self,
+        states: Sequence[SimState],
+        params_list: Sequence[EngineParams],
+    ) -> SimState:
+        """Stack R per-replica (state, params) pairs and jit the vmapped
+        chunk. Returns the stacked SimState (every leaf [R, ...])."""
+        if len(states) != len(params_list) or not states:
+            raise ConfigError("ensemble needs >= 1 (state, params) pair")
+        self.num_replicas = len(states)
+        self._params, axes = stack_params(params_list)
+        chunk = functools.partial(_run_chunk, self.cfg, self.model, None)
+        self._chunk = jax.jit(
+            jax.vmap(chunk, in_axes=(0, axes)), donate_argnums=0
+        )
+        return tree_stack(states)
+
+    def run_chunk(self, state: SimState) -> SimState:
+        """Advance every replica one chunk (frozen replicas — done, or
+        out of rounds — keep their carries bit-exactly via the while-loop
+        batching select)."""
+        return self._chunk(state, self._params)
+
+
+def build_ensemble(
+    model,
+    replicas: Sequence[tuple[EngineConfig, SimState, EngineParams]],
+) -> tuple[EnsembleEngine, SimState]:
+    """(EnsembleEngine, stacked state) from per-replica built sims.
+
+    Each tuple is one replica's (engine config, initialized SimState,
+    initialized EngineParams) — the exact objects `Engine.init_state`
+    returns for a solo run, so a campaign replica IS its solo run, just
+    stacked. Fault statics are reconciled (padded) here; every other
+    config static must already match."""
+    cfgs = [c for c, _, _ in replicas]
+    states = [s for _, s, _ in replicas]
+    params_list = [p for _, _, p in replicas]
+    common, padded = reconcile_fault_statics(cfgs, params_list)
+    ens = EnsembleEngine(common, model)
+    stacked = ens.build(states, padded)
+    return ens, stacked
+
+
+# ------------------------------------------------------------ ledger helpers
+
+
+def replica_digest_arrays(state: SimState, num_real: int | None = None):
+    """Per-replica per-host digest planes, np.uint64[R, n]."""
+    d = np.asarray(jax.device_get(state.stats.digest))
+    return d[:, : (num_real or d.shape[1])]
+
+
+def replica_digest_sigs(state: SimState, num_real: int | None = None):
+    """Per-replica xor-folded digest signatures, np.uint64[R] — the
+    cheap per-chunk ledger entry (full-array comparison remains the
+    authoritative divergence test; xor is a summary, not a proof)."""
+    d = replica_digest_arrays(state, num_real)
+    return np.bitwise_xor.reduce(d, axis=1)
+
+
+def replica_ledger(
+    state: SimState, num_real: int | None = None, labels=None
+) -> list[dict]:
+    """Per-replica digest-ledger rows: the solo `stats_report` counters,
+    one dict per replica, read from the stacked state in one device_get."""
+    s = jax.device_get(state.stats)
+    qdrop = np.asarray(jax.device_get(state.queue.dropped))
+    now = np.asarray(jax.device_get(state.now))
+    done = np.asarray(jax.device_get(state.done))
+    r_count = np.asarray(s.digest).shape[0]
+    n = num_real or np.asarray(s.digest).shape[1]
+    rows = []
+    for r in range(r_count):
+        def tot(field):
+            return int(np.asarray(getattr(s, field))[r, :n].sum())
+
+        rows.append(
+            {
+                "replica": r,
+                **({"label": labels[r]} if labels else {}),
+                "digest": f"{int(np.bitwise_xor.reduce(np.asarray(s.digest)[r, :n])):016x}",
+                "rounds": int(np.asarray(s.rounds)[r]),
+                "done": bool(done[r]),
+                "simulated_seconds": float(now[r]) / 1e9,
+                "events_processed": tot("events"),
+                "packets_sent": tot("pkts_sent"),
+                "packets_delivered": tot("pkts_delivered"),
+                "packets_lost": tot("pkts_lost"),
+                "packets_unreachable": tot("pkts_unreachable"),
+                "packets_codel_dropped": tot("pkts_codel_dropped"),
+                "packets_budget_dropped": tot("pkts_budget_dropped"),
+                "queue_overflow_dropped": int(qdrop[r, :n].sum()),
+                "faults_dropped": tot("faults_dropped"),
+                "faults_delayed": tot("faults_delayed"),
+                "monotonic_violations": tot("monotonic_violations"),
+                "microsteps": int(np.asarray(s.microsteps)[r].sum()),
+            }
+        )
+    return rows
+
+
+# ------------------------------------------------------------ bisection
+
+
+def pair_digests_equal(
+    state: SimState, pair: tuple[int, int], num_real: int | None = None
+) -> bool:
+    """Full-array digest equality between two replicas of a stacked
+    state — the authoritative expected-identical check (per-host arrays,
+    not the xor fold, so a compensating two-host collision cannot hide a
+    divergence)."""
+    d = replica_digest_arrays(state, num_real)
+    i, j = pair
+    return bool(np.array_equal(d[i], d[j]))
+
+
+def bisect_divergence(
+    run_chunk,
+    state0: SimState,
+    pair: tuple[int, int],
+    *,
+    hi: int,
+    num_real: int | None = None,
+    log=None,
+) -> int:
+    """First chunk (1-based) after which replicas `pair` carry different
+    digests, by binary search over chunk boundaries from a pre-run device
+    snapshot.
+
+    Preconditions: the pair's digests are EQUAL in `state0` (chunk 0) and
+    DIVERGENT after `hi` chunks. Invariant exploited: the engine is
+    deterministic, so re-running k chunks from the chunk-0 snapshot
+    reproduces the original prefix bit-exactly, and once the pair's
+    per-host digest arrays differ they never re-converge (each replica's
+    digest is a rolling fold over its own — now different — event
+    history; equality after divergence would need a fold collision
+    across every host simultaneously). The search keeps a device
+    snapshot at the highest chunk known-equal, so each probe replays
+    only the gap from there: total replay work is <= 2 x hi chunks, and
+    the state machine is
+
+        lo (snapshot, pair equal) --run (mid-lo) chunks--> probe(mid)
+        probe equal     -> adopt: lo = mid, snapshot advances
+        probe divergent -> hi = mid
+        until hi - lo == 1; answer = hi.
+
+    `run_chunk` may donate its input (the probes run on fresh
+    `restore_snapshot` copies). Returns the 1-based index of the first
+    divergent chunk."""
+    if not pair_digests_equal(state0, pair, num_real):
+        raise ValueError(
+            f"bisect_divergence: pair {pair} already divergent at chunk 0"
+        )
+    lo, hi_k = 0, int(hi)
+    snap_lo = snapshot_state(state0)
+    probes = 0
+    while hi_k - lo > 1:
+        mid = (lo + hi_k) // 2
+        st = restore_snapshot(snap_lo)
+        for _ in range(mid - lo):
+            st = run_chunk(st)
+        probes += 1
+        if pair_digests_equal(st, pair, num_real):
+            lo = mid
+            snap_lo = snapshot_state(st)
+        else:
+            hi_k = mid
+        if log is not None:
+            print(
+                f"[bisect] pair {pair}: chunk {mid} "
+                f"{'equal' if lo == mid else 'divergent'} "
+                f"(window now ({lo}, {hi_k}])",
+                file=log,
+            )
+    return hi_k

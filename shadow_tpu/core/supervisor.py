@@ -56,13 +56,19 @@ def state_digest_sig(state) -> tuple[int, int]:
     per-host event digests). Recorded at snapshot time and re-checked at
     restore time — a mismatch means device memory silently diverged
     between the copy and the replay (the known wrong-digest corruption
-    mode), which replaying would only launder into believable results."""
+    mode), which replaying would only launder into believable results.
+
+    Replica-axis-aware: an ensemble state's `stats.rounds` is [R] (one
+    counter per replica) and its digest plane [R, H]; the signature sums
+    the rounds and folds the whole plane, so the same supervisor wraps
+    solo and campaign dispatches unchanged."""
     import jax
 
     digest = int(np.bitwise_xor.reduce(
         np.asarray(jax.device_get(state.stats.digest)).reshape(-1)
     ))
-    return int(state.stats.rounds), digest
+    rounds = int(np.asarray(jax.device_get(state.stats.rounds)).sum())
+    return rounds, digest
 
 
 class ChunkSupervisor:

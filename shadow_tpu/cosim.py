@@ -423,7 +423,7 @@ class HybridSimulation:
             from jax.sharding import PartitionSpec as P
 
             rep = P()
-            from shadow_tpu.core.engine import _shard_map
+            from shadow_tpu.core.compat import shard_map_compat as _shard_map
 
             prepare = _shard_map(
                 prepare, self.mesh,
@@ -447,7 +447,7 @@ class HybridSimulation:
             if self.mesh is not None:
                 from jax.sharding import PartitionSpec as P
 
-                from shadow_tpu.core.engine import _shard_map
+                from shadow_tpu.core.compat import shard_map_compat as _shard_map
 
                 state_spec = self.engine.state_specs()
                 g = _shard_map(

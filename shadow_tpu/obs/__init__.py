@@ -10,11 +10,12 @@ from shadow_tpu.obs.pcap import PcapWriter, packet_bytes
 from shadow_tpu.obs.strace import StraceLogger
 from shadow_tpu.obs.perf import PerfTimers
 from shadow_tpu.obs.simlog import SimLogger, format_sim_time
-from shadow_tpu.obs.tracer import RoundTracer, TraceRing
+from shadow_tpu.obs.tracer import ReplicaTracer, RoundTracer, TraceRing
 
 __all__ = [
     "PcapWriter",
     "PerfTimers",
+    "ReplicaTracer",
     "RoundTracer",
     "SimLogger",
     "StraceLogger",

@@ -428,3 +428,127 @@ class RoundTracer:
             self.write_metrics(
                 os.path.join(data_dir, obs.metrics_file), extra=report
             )
+
+
+# the per-replica reduction below sums these ring columns and maxes those
+_REPLICA_SUM_COLS = {
+    "events": COL_EVENTS,
+    "microsteps": COL_MICROSTEPS,
+    "popk_deferred": COL_POPK_DEFERRED,
+    "bq_rebuilds": COL_BQ_REBUILDS,
+    "ici_bytes": COL_ICI_BYTES,
+    "sends": COL_SENDS,
+    "a2a_shed": COL_A2A_SHED,
+    "faults_dropped": COL_FAULTS_DROPPED,
+    "faults_delayed": COL_FAULTS_DELAYED,
+}
+_REPLICA_MAX_COLS = {
+    "occ_hwm": COL_OCC_HWM,
+    "ob_hwm": COL_OB_HWM,
+    "hosts_down_max": COL_HOSTS_DOWN,
+}
+
+
+class ReplicaTracer:
+    """Per-replica totals reduction for ensemble campaign runs.
+
+    A stacked campaign state's trace ring is [R, world, Rr, F] with a
+    per-replica cursor [R, world]: replicas record rounds at their OWN
+    pace (a finished replica's frozen lane stops appending), so the
+    single-cursor `RoundTracer` drain cannot be reused — each replica's
+    new rows must be located by ITS cursor. This class drains per replica
+    at chunk boundaries (ring sized to rounds_per_chunk, so a drain per
+    chunk never wraps for any replica) and folds running per-replica
+    totals — sums for the counter columns, maxes for the high-water
+    columns — which the campaign ledger cross-checks against the
+    per-replica device stats. Like the ring itself, pure observation."""
+
+    def __init__(self, ring_rounds: int, num_replicas: int):
+        if ring_rounds <= 0:
+            raise ValueError(f"ring_rounds must be > 0, got {ring_rounds}")
+        if num_replicas <= 0:
+            raise ValueError(
+                f"num_replicas must be > 0, got {num_replicas}"
+            )
+        self.ring_rounds = int(ring_rounds)
+        self.num_replicas = int(num_replicas)
+        self._cursor = np.zeros((num_replicas,), np.int64)
+        self._origin = np.zeros((num_replicas,), np.int64)
+        self.lost = np.zeros((num_replicas,), np.int64)
+        self._sums = np.zeros((num_replicas, TRACE_COLS), np.int64)
+        self._maxs = np.zeros((num_replicas, TRACE_COLS), np.int64)
+
+    def _cursors_of(self, ring: TraceRing) -> np.ndarray:
+        import jax
+
+        cur = np.asarray(jax.device_get(ring.cursor))  # [R, world]
+        if cur.ndim != 2 or cur.shape[0] != self.num_replicas:
+            raise ValueError(
+                f"expected a stacked [R={self.num_replicas}, world] ring "
+                f"cursor, got shape {cur.shape}"
+            )
+        return cur.max(axis=1)
+
+    def sync_cursor(self, ring: TraceRing) -> np.ndarray:
+        """Adopt each replica's current cursor as its drain origin (same
+        contract as RoundTracer.sync_cursor, per replica)."""
+        cur = self._cursors_of(ring)
+        self._cursor = cur.copy()
+        self._origin = cur.copy()
+        return cur
+
+    def drain(self, ring: TraceRing) -> int:
+        """Fold rounds recorded since the last drain into the running
+        per-replica totals; returns how many rows were folded (all
+        replicas, wrap losses excluded — those count in `.lost`)."""
+        import jax
+
+        cur = self._cursors_of(ring)
+        if not (cur > self._cursor).any():
+            return 0
+        rows = np.asarray(jax.device_get(ring.rows))  # [R, world, Rr, F]
+        folded = 0
+        for r in range(self.num_replicas):
+            n = int(cur[r] - self._cursor[r])
+            if n <= 0:
+                continue
+            lost = max(0, n - self.ring_rounds)
+            self.lost[r] += lost
+            idx = [
+                i % self.ring_rounds
+                for i in range(int(self._cursor[r]) + lost, int(cur[r]))
+            ]
+            flat = rows[r][:, idx, :].reshape(-1, TRACE_COLS)
+            self._sums[r] += flat.sum(axis=0)
+            self._maxs[r] = np.maximum(self._maxs[r], flat.max(axis=0))
+            self._cursor[r] = cur[r]
+            folded += n - lost
+        return folded
+
+    @property
+    def rounds(self) -> np.ndarray:
+        """Rounds folded per replica, i64[R]."""
+        return self._cursor - self._origin - self.lost
+
+    def replica_totals(self) -> list[dict]:
+        """One totals dict per replica (RoundTracer.totals key naming)."""
+        out = []
+        for r in range(self.num_replicas):
+            t = {"rounds": int(self.rounds[r]),
+                 "rounds_lost": int(self.lost[r])}
+            for k, c in _REPLICA_SUM_COLS.items():
+                t[k] = int(self._sums[r, c])
+            for k, c in _REPLICA_MAX_COLS.items():
+                t[k] = int(self._maxs[r, c])
+            out.append(t)
+        return out
+
+    def totals(self) -> dict:
+        """Campaign-wide aggregate: sums summed, high-waters maxed."""
+        t = {"rounds": int(self.rounds.sum()),
+             "rounds_lost": int(self.lost.sum())}
+        for k, c in _REPLICA_SUM_COLS.items():
+            t[k] = int(self._sums[:, c].sum())
+        for k, c in _REPLICA_MAX_COLS.items():
+            t[k] = int(self._maxs[:, c].max()) if self.num_replicas else 0
+        return t

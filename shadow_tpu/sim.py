@@ -340,6 +340,43 @@ def resolve_world(parallelism: int) -> int:
     return parallelism
 
 
+def heartbeat_line(
+    now_ns: int,
+    wall: float,
+    events: int,
+    microsteps: int,
+    rounds: int,
+    ici_bytes: int,
+    q_hwm: int,
+    *,
+    fault: tuple[int, int] | None = None,
+    gear: int | None = None,
+    rep: tuple[int, int] | None = None,
+) -> str:
+    """The `[heartbeat]` progress line, shared by the Simulation run loop
+    and the campaign driver so tools/parse_shadow.py has ONE format to
+    track. Optional fields ride along in a fixed order (faults, gear,
+    rep, then ratio); lines without them are byte-identical to the older
+    formats, which the parser keeps reading (gated by literal-line
+    tests). `rep` is (replicas done, total) on ensemble campaign runs."""
+    fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
+    gear_f = f"gear={gear} " if gear is not None else ""
+    rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
+    return (
+        f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
+        f"wall={wall:.2f}s events={events} "
+        f"rounds={rounds} "
+        f"msteps/round={microsteps / max(rounds, 1):.1f} "
+        f"ev/mstep={events / max(microsteps, 1):.2f} "
+        f"ici_bytes={ici_bytes} q_hwm={q_hwm} "
+        f"{fault_f}"
+        f"{gear_f}"
+        f"{rep_f}"
+        f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
+        f"{resource_heartbeat()}"
+    )
+
+
 class Simulation:
     """Built simulation: engine + host specs + run loop."""
 
@@ -660,28 +697,19 @@ class Simulation:
                     rounds = int(self.state.stats.rounds)
                     ici = int(np.asarray(self.state.stats.ici_bytes).sum())
                     qhwm = int(np.asarray(self.state.stats.q_occ_hwm).max())
-                    # gear= rides along only on adaptive runs (old-format
-                    # lines stay byte-identical; parse_shadow reads both)
-                    gear_f = f"gear={last_gear} " if last_gear is not None else ""
                     # faults= rides along only when the fault plane is
-                    # active: cumulative dropped/delayed (parse_shadow
-                    # reads old lines without it unchanged)
-                    fault_f = ""
+                    # active, gear= only on adaptive runs (old-format
+                    # lines stay byte-identical; parse_shadow reads both)
+                    fault = None
                     if self.engine_cfg.faults_active:
                         fd = int(np.asarray(self.state.stats.faults_dropped).sum())
                         fy = int(np.asarray(self.state.stats.faults_delayed).sum())
-                        fault_f = f"faults={fd}/{fy} "
+                        fault = (fd, fy)
                     print(
-                        f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
-                        f"wall={wall:.2f}s events={ev} "
-                        f"rounds={rounds} "
-                        f"msteps/round={msteps / max(rounds, 1):.1f} "
-                        f"ev/mstep={ev / max(msteps, 1):.2f} "
-                        f"ici_bytes={ici} q_hwm={qhwm} "
-                        f"{fault_f}"
-                        f"{gear_f}"
-                        f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
-                        f"{resource_heartbeat()}",
+                        heartbeat_line(
+                            now_ns, wall, ev, msteps, rounds, ici, qhwm,
+                            fault=fault, gear=last_gear,
+                        ),
                         file=log,
                     )
                     if simlog is not None:

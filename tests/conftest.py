@@ -21,3 +21,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; register the marker so opting a heavy
+    # leg out (e.g. the campaign end-to-end, covered by the
+    # TIER1_CAMPAIGN stage instead) never warns
+    config.addinivalue_line("markers", "slow: excluded from tier-1")

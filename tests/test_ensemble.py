@@ -1,0 +1,624 @@
+"""Ensemble plane gates (core/ensemble.py + tools/campaign.py).
+
+The ISSUE acceptance property: replica r of a vmapped campaign is
+BIT-IDENTICAL — digest, event count, every drop/fault counter — to a solo
+run with the same (seed, fault schedule), across echo/phold/tgen x
+flat/bucketed queues x K in {1, 4}; plus a forced-divergence campaign
+whose bisection must report the correct first divergent chunk.
+
+In-process legs stick to single-dispatch engine-harness runs (the stable
+path on this box); multi-chunk legs (bisection, the campaign driver) run
+through tests/subproc.py — this box's documented jaxlib-0.4.37 corruption
+targets exactly the many-small-dispatch pattern they need (CHANGES.md env
+notes), and an in-process abort would kill the whole pytest run.
+
+Build-order note: each replica is built ONCE; the ensemble stacks COPIES
+of the per-replica states (jnp.stack allocates), so the same build then
+runs its solo leg afterwards — solo dispatches donate only their own
+state buffers, never the stacked ones.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from engine_harness import build_sim, mk_hosts  # noqa: E402
+from tests.subproc import run_isolated_json  # noqa: E402
+
+from shadow_tpu.config.options import ConfigError, ConfigOptions  # noqa: E402
+from shadow_tpu.core import Engine  # noqa: E402
+from shadow_tpu.core.ensemble import (  # noqa: E402
+    EnsembleEngine,
+    build_ensemble,
+    pair_digests_equal,
+    replica_digest_sigs,
+    replica_ledger,
+    tree_index,
+)
+
+# the counters the bit-identity gate compares, per replica vs solo
+_GATED_STATS = (
+    "digest", "events", "pkts_sent", "pkts_lost", "pkts_delivered",
+    "pkts_unreachable", "pkts_codel_dropped", "pkts_budget_dropped",
+    "monotonic_violations", "faults_dropped", "faults_delayed",
+    "popk_deferred",
+)
+
+
+def _build_replica(model_name, hosts, stop, *, seed, faults=None, **kw):
+    """One replica's (engine, model, (cfg, state, params))."""
+    cfg, m, params, mstate, events = build_sim(
+        model_name, hosts, stop, world=1, seed=seed, faults=faults, **kw
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=seed)
+    return eng, m, (eng.cfg, state, params)
+
+
+def _run_solo(eng, state, params, max_chunks=200):
+    n = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        n += 1
+        assert n < max_chunks, "solo run failed to terminate"
+    return state
+
+
+def _run_ensemble(ens, state, max_chunks=200):
+    n = 0
+    while not bool(np.asarray(jax.device_get(state.done)).all()):
+        state = ens.run_chunk(state)
+        n += 1
+        assert n < max_chunks, "ensemble run failed to terminate"
+    return state, n
+
+
+def _build_and_run(model_name, hosts, stop, specs, **common_kw):
+    """Build replicas from (seed, faults) specs, stack + run the ensemble,
+    then run each build's solo leg. Returns (ens, ens_state, solo_states)."""
+    builds = [
+        _build_replica(model_name, hosts, stop, seed=seed, faults=faults,
+                       **common_kw)
+        for seed, faults in specs
+    ]
+    model = builds[0][1]
+    ens, state = build_ensemble(model, [rep for _, _, rep in builds])
+    state, _ = _run_ensemble(ens, state)
+    solos = [
+        _run_solo(eng, rep[1], rep[2]) for eng, _, rep in builds
+    ]
+    return ens, state, solos
+
+
+def _assert_replica_matches_solo(ens_state, r, solo_state, ctx=""):
+    es = jax.device_get(ens_state.stats)
+    ss = jax.device_get(solo_state.stats)
+    for f in _GATED_STATS:
+        a = np.asarray(getattr(es, f))[r]
+        b = np.asarray(getattr(ss, f))
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"{ctx} replica {r}: stats.{f} diverged from solo"
+        )
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(ens_state.queue.dropped))[r],
+        np.asarray(jax.device_get(solo_state.queue.dropped)),
+        err_msg=f"{ctx} replica {r}: queue.dropped diverged from solo",
+    )
+    assert int(np.asarray(jax.device_get(ens_state.stats.rounds))[r]) == int(
+        solo_state.stats.rounds
+    ), f"{ctx} replica {r}: rounds diverged from solo"
+
+
+# the three workloads of the acceptance grid (the test_popk _CASES shapes:
+# phold's bursty pushes exercise the K-fold deferral guard, echo the
+# shaping pipeline, tgen the TCP plane)
+_CASES = {
+    "phold": (
+        "phold",
+        mk_hosts(10, {"mean_delay": "20 ms", "population": 3}),
+        400_000_000,
+        dict(loss=0.1),
+    ),
+    "echo": (
+        "udp_echo",
+        [dict(host_id=0, name="server", start_time=0,
+              model_args={"role": "server"})]
+        + [dict(host_id=i, name=f"c{i}", start_time=0,
+                model_args={"role": "client", "peer": "server",
+                            "interval": "4 ms", "size_bytes": 2000})
+           for i in range(1, 5)],
+        300_000_000,
+        dict(bw_bits=2_000_000, loss=0.05, use_codel=True),
+    ),
+    "tgen": (
+        "tgen_tcp",
+        mk_hosts(6, {"flow_segs": 12, "flows": 1, "cwnd_cap": 8,
+                     "rto_min": "100 ms"}),
+        4_000_000_000,
+        dict(loss=0.05, latency=10_000_000, sends_budget=16),
+    ),
+}
+
+# queue layout x K-fold grid; qb (queue_block) must divide the harness
+# qcap of 32
+_GRID = [(0, 1), (0, 4), (8, 1), (8, 4)]
+
+
+@pytest.mark.parametrize("case", sorted(_CASES))
+@pytest.mark.parametrize("qb,k", _GRID, ids=lambda v: str(v))
+def test_vmap_vs_solo_bit_identity(case, qb, k):
+    """THE acceptance gate: every replica of a seed-sweep ensemble equals
+    its solo run bit-for-bit, across models x queue layouts x K."""
+    model_name, hosts, stop, kw = _CASES[case]
+    _, state, solos = _build_and_run(
+        model_name, hosts, stop, [(s, None) for s in (1, 2, 3)],
+        queue_block=qb, microstep_events=k, **kw,
+    )
+    for r, solo_state in enumerate(solos):
+        _assert_replica_matches_solo(
+            state, r, solo_state, ctx=f"{case} qb={qb} k={k}"
+        )
+
+
+def test_vmap_vs_solo_fault_schedule_sweep():
+    """Fault-schedule axis: replicas with DIFFERENT schedules (different
+    window counts — exercises the crash-window padding — plus loss
+    windows on every replica per the mixing rule) each equal their
+    natural solo runs, which compile the UNPADDED dims."""
+    hosts = mk_hosts(8, {"mean_delay": "20 ms", "population": 3})
+    stop = 400_000_000
+    scheds = [
+        {"crashes": [{"host": 2, "down_at": "0.1 s", "up_at": "0.25 s"},
+                     {"host": 2, "down_at": "0.3 s", "up_at": "0.35 s"}],
+         "loss_windows": [{"start": "0.05 s", "end": "0.2 s", "loss": 0.3}]},
+        {"host_churn": {"prob": 0.5, "mean_downtime": "0.1 s"}, "seed": 9,
+         "loss_windows": [{"start": "0.1 s", "end": "0.3 s", "loss": 0.1,
+                           "latency_factor": 2.0},
+                          {"start": "0.32 s", "end": "0.36 s",
+                           "loss": 0.5}]},
+    ]
+    ens, state, solos = _build_and_run(
+        "phold", hosts, stop,
+        [(1, scheds[0]), (2, scheds[1]), (3, scheds[0])],
+        loss=0.1,
+    )
+    # the reconciled statics are the maxima over the sweep
+    assert ens.cfg.fault_crash_windows >= 1
+    assert ens.cfg.fault_loss_windows == 2
+    for r, solo_state in enumerate(solos):
+        _assert_replica_matches_solo(state, r, solo_state, ctx="fault-sweep")
+    # fault-plane sanity: the schedules really did something
+    assert np.asarray(jax.device_get(state.stats.faults_delayed)).sum() > 0
+
+
+def test_crash_pad_zero_to_w_exact():
+    """A fault-free replica stacked with a crashing one: the 0 -> W crash
+    padding must leave the fault-free replica bit-identical to its
+    schedule-free solo build (no loss windows anywhere, so the mixing
+    rule does not bite)."""
+    hosts = mk_hosts(6, {"mean_delay": "20 ms", "population": 2})
+    stop = 300_000_000
+    crash = {"crashes": [{"host": 1, "down_at": "0.1 s", "up_at": "0.2 s"}]}
+    ens, state, solos = _build_and_run(
+        "phold", hosts, stop, [(1, None), (1, crash)]
+    )
+    assert ens.cfg.fault_crash_windows == 1
+    for r, solo_state in enumerate(solos):
+        _assert_replica_matches_solo(state, r, solo_state, ctx="pad0W")
+    # and the two replicas did diverge (the crash held events)
+    assert not pair_digests_equal(state, (0, 1))
+
+
+def test_clear_policy_pads_fault_free_replica():
+    """A restart_queue: clear crash replica stacked with a FAULT-FREE one
+    must reconcile (the policy is value-inert for a host that is never
+    down) — both replicas bit-identical to their solos — while two
+    CRASHING replicas with different policies still reject."""
+    hosts = mk_hosts(6, {"mean_delay": "20 ms", "population": 2})
+    stop = 300_000_000
+    clear = {"crashes": [{"host": 1, "down_at": "0.1 s", "up_at": "0.2 s"}],
+             "restart_queue": "clear"}
+    ens, state, solos = _build_and_run(
+        "phold", hosts, stop, [(1, clear), (1, None)]
+    )
+    assert ens.cfg.fault_queue_clear and ens.cfg.fault_crash_windows == 1
+    for r, solo_state in enumerate(solos):
+        _assert_replica_matches_solo(state, r, solo_state, ctx="clear-pad")
+    hold = {"crashes": [{"host": 1, "down_at": "0.1 s", "up_at": "0.2 s"}],
+            "restart_queue": "hold"}
+    _, model, rep_a = _build_replica("phold", hosts, stop, seed=1,
+                                     faults=clear)
+    _, _, rep_b = _build_replica("phold", hosts, stop, seed=1, faults=hold)
+    with pytest.raises(ConfigError, match="restart_queue"):
+        build_ensemble(model, [rep_a, rep_b])
+
+
+def test_loss_window_mixing_rejected():
+    """Mixing loss-window presence across replicas must fail loudly: L>0
+    traces an extra RNG draw per send, so a fault-free replica could
+    never match its solo run inside that program."""
+    hosts = mk_hosts(4, {"mean_delay": "20 ms", "population": 2})
+    stop = 200_000_000
+    lossy = {"loss_windows": [{"start": "0.05 s", "end": "0.1 s",
+                               "loss": 0.5}]}
+    _, model, rep_a = _build_replica("phold", hosts, stop, seed=1)
+    _, _, rep_b = _build_replica("phold", hosts, stop, seed=1, faults=lossy)
+    with pytest.raises(ConfigError, match="loss-window"):
+        build_ensemble(model, [rep_a, rep_b])
+
+
+def test_static_mismatch_rejected():
+    """Replicas differing in a trace-time static (here the K fold) must
+    be rejected with the config-statics message."""
+    hosts = mk_hosts(4, {"mean_delay": "20 ms", "population": 2})
+    stop = 200_000_000
+    _, model, rep_a = _build_replica(
+        "phold", hosts, stop, seed=1, microstep_events=1
+    )
+    _, _, rep_b = _build_replica(
+        "phold", hosts, stop, seed=2, microstep_events=4
+    )
+    with pytest.raises(ConfigError, match="EngineConfig static"):
+        build_ensemble(model, [rep_a, rep_b])
+
+
+def test_world_gt_1_rejected():
+    """The ensemble plane is world=1 this round — a mesh config raises."""
+    import dataclasses
+
+    cfg, model, *_ = build_sim(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms"}), 100_000_000, world=1
+    )
+    with pytest.raises(ConfigError, match="world"):
+        EnsembleEngine(dataclasses.replace(cfg, world=8), model)
+
+
+def test_identical_replicas_stay_identical():
+    """The A/A control: two replicas built identically must end with
+    equal digest arrays (and equal xor signatures)."""
+    hosts = mk_hosts(6, {"mean_delay": "20 ms", "population": 2})
+    stop = 300_000_000
+    builds = [
+        _build_replica("phold", hosts, stop, seed=1) for _ in range(2)
+    ]
+    ens, state = build_ensemble(builds[0][1], [rep for _, _, rep in builds])
+    state, _ = _run_ensemble(ens, state)
+    assert pair_digests_equal(state, (0, 1))
+    sigs = replica_digest_sigs(state)
+    assert sigs[0] == sigs[1]
+    led = replica_ledger(state, labels=["a", "b"])
+    assert led[0]["digest"] == led[1]["digest"]
+    assert led[0]["events_processed"] == led[1]["events_processed"] > 0
+    # tree_index extracts a coherent per-replica view
+    sub = tree_index(state, 0)
+    assert int(sub.stats.rounds) == led[0]["rounds"]
+
+
+# ---------------------------------------------------------------- bisection
+
+_BISECT_SCRIPT = r"""
+import json, sys
+sys.path.insert(0, "tests")
+import jax, numpy as np
+from engine_harness import build_sim, mk_hosts
+from shadow_tpu.core import Engine
+from shadow_tpu.core.checkpoint import snapshot_state
+from shadow_tpu.core.ensemble import (
+    bisect_divergence, build_ensemble, pair_digests_equal,
+)
+
+# same seed, two crash schedules: divergence starts in the chunk whose
+# windows contain the 0.9 s crash. rounds_per_chunk=8 with 50 ms windows
+# -> ~10 chunks over 4 sim-s, so the bisection genuinely bisects.
+HOSTS = mk_hosts(8, {"mean_delay": "20 ms", "population": 2})
+STOP = 4_000_000_000
+SCHEDS = [
+    {"crashes": [{"host": 1, "down_at": "0.9 s", "up_at": "1.2 s"}]},
+    {"crashes": [{"host": 1, "down_at": "2.9 s", "up_at": "3.2 s"}]},
+]
+replicas, model = [], None
+for sched in SCHEDS:
+    cfg, model, params, mstate, events = build_sim(
+        "phold", HOSTS, STOP, world=1, seed=1, faults=sched,
+        rounds_per_chunk=8)
+    eng = Engine(cfg, model, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    replicas.append((eng.cfg, state, params))
+ens, state = build_ensemble(model, replicas)
+snap0 = snapshot_state(state)
+
+# ground truth by linear chunk scan on the full digest arrays
+truth, chunks = None, 0
+while not bool(np.asarray(jax.device_get(state.done)).all()):
+    state = ens.run_chunk(state)
+    chunks += 1
+    assert chunks < 100
+    if truth is None and not pair_digests_equal(state, (0, 1)):
+        truth = chunks
+assert truth is not None, "pair never diverged"
+got = bisect_divergence(ens.run_chunk, snap0, (0, 1), hi=chunks)
+print(json.dumps({"truth": truth, "bisected": got, "chunks": chunks}))
+"""
+
+
+def test_bisection_finds_first_divergent_chunk():
+    """Forced divergence: an A/B pair differing only in WHEN a crash
+    window opens must bisect to exactly the chunk a linear full-digest
+    scan identifies. Multi-chunk dispatch pattern -> subprocess-isolated
+    (the known corruption magnet; tests/subproc classifies it)."""
+    data = run_isolated_json(_BISECT_SCRIPT, timeout=420)
+    assert data["bisected"] == data["truth"], data
+    # the 0.9 s crash lands mid-run, not in chunk 1: the search had a
+    # real window to bisect
+    assert 1 <= data["truth"] < data["chunks"], data
+
+
+# ------------------------------------------------------- campaign driver
+
+_CAMPAIGN_SCRIPT = r"""
+import json, tempfile
+from tools.campaign import _smoke_worker
+with tempfile.TemporaryDirectory() as tmp:
+    print(json.dumps(_smoke_worker(tmp)))
+"""
+
+
+@pytest.mark.slow
+def test_campaign_driver_end_to_end():
+    """tools/campaign.py end-to-end (subprocess-isolated): the A/A
+    control holds, replica 0 equals its solo Simulation, and the forced
+    A/B divergence bisects to the linear-scan chunk. Marked slow — the
+    TIER1_CAMPAIGN=1 stage of check_tier1.sh runs the same body."""
+    data = run_isolated_json(_CAMPAIGN_SCRIPT, timeout=500)
+    assert data["ok"], data
+
+
+def test_campaign_options_parse():
+    base = {
+        "general": {"stop_time": "1 s", "seed": 1},
+        "hosts": {"n": {"count": 2, "network_node_id": 0,
+                        "processes": [{"model": "timer",
+                                       "model_args": {"interval": "100 ms"}}]}},
+    }
+    cfg = ConfigOptions.from_dict(
+        {**base, "campaign": {"seeds": {"start": 5, "count": 3},
+                              "overrides": [{}, {"general.seed": 9}],
+                              "expect_identical": [[0, 1]]}}
+    )
+    assert cfg.campaign.seeds == [5, 6, 7]
+    assert cfg.campaign.num_replicas == 6
+    with pytest.raises(ConfigError, match="expect_identical"):
+        ConfigOptions.from_dict(
+            {**base, "campaign": {"seeds": [1], "expect_identical": [[0]]}}
+        )
+    with pytest.raises(ConfigError, match="max_replicas"):
+        ConfigOptions.from_dict(
+            {**base, "campaign": {"seeds": list(range(100))}}
+        )
+    with pytest.raises(ConfigError, match="supervisor"):
+        ConfigOptions.from_dict(
+            {**base, "campaign": {
+                "seeds": [1],
+                "fault_schedules": [
+                    {"supervisor": {"snapshot_every_chunks": 2}}],
+            }}
+        )
+    with pytest.raises(ConfigError, match="references a replica"):
+        ConfigOptions.from_dict(
+            {**base, "campaign": {"seeds": [1, 2],
+                                  "expect_identical": [[0, 5]]}}
+        )
+    # the campaign block round-trips through to_dict (provenance dump)
+    assert "campaign" in cfg.to_dict()
+
+
+def test_campaign_replica_expansion_order():
+    from tools.campaign import expand_replicas, replica_config_dict
+
+    base = {
+        "general": {"stop_time": "1 s", "seed": 42},
+        "hosts": {"n": {"count": 2, "network_node_id": 0,
+                        "processes": [{"model": "timer",
+                                       "model_args": {"interval": "100 ms"}}]}},
+        "campaign": {"seeds": [1, 2],
+                     "fault_schedules": [{}, {"host_churn": {"prob": 0.1}}],
+                     "overrides": [{}, {"general.seed": 7}]},
+    }
+    specs = expand_replicas(ConfigOptions.from_dict(base))
+    assert len(specs) == 8
+    # seed-major, then schedule, then override (the documented formula)
+    assert [s.seed for s in specs[:4]] == [1, 1, 1, 1]
+    assert specs[0].label == "seed=1,faults=0,ov=0"
+    assert specs[2].faults == {"host_churn": {"prob": 0.1}}
+    assert specs[4].seed == 2 and specs[4].faults == {}
+    # overrides win over the seed axis where they collide (applied last)
+    d = replica_config_dict(base, specs[1])
+    assert d["general"]["seed"] == 7
+    # deep dotted paths reach into host process lists
+    from tools.campaign import _apply_dict_override
+
+    _apply_dict_override(d, "hosts.n.processes.0.model_args.interval", "50 ms")
+    assert d["hosts"]["n"]["processes"][0]["model_args"]["interval"] == "50 ms"
+    # the campaign block never leaks into replica configs
+    assert "campaign" not in d
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_heartbeat_regex_rep_and_old_formats():
+    """parse_shadow must read the new rep= field AND keep parsing every
+    older line format verbatim (one literal line per generation — the
+    same pattern as the gear= and faults= fields)."""
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    camp = ("[heartbeat] sim_time=1.290s wall=1.63s events=574 rounds=72 "
+            "msteps/round=2.5 ev/mstep=3.19 ici_bytes=0 q_hwm=7 "
+            "rep=0/3 ratio=0.79x rss_gib=0.88")
+    m = HEARTBEAT_RE.search(camp)
+    assert m and m.group("rep_done") == "0" and m.group("rep_total") == "3"
+    assert m.group("ratio") == "0.79"
+    faulty_camp = ("[heartbeat] sim_time=1.293s wall=1.70s events=364 "
+                   "rounds=48 msteps/round=2.4 ev/mstep=3.17 ici_bytes=0 "
+                   "q_hwm=7 faults=0/4 rep=0/2 ratio=0.76x rss_gib=0.95")
+    m = HEARTBEAT_RE.search(faulty_camp)
+    assert m and m.group("rep_done") == "0" and m.group("rep_total") == "2"
+    assert m.group("faults_dropped") == "0" and m.group("faults_delayed") == "4"
+    # literal pre-ensemble formats, one per generation
+    old_pr5 = ("[heartbeat] sim_time=1.043s wall=1.83s events=400 rounds=264 "
+               "msteps/round=1.0 ev/mstep=1.44 ici_bytes=0 q_hwm=8 "
+               "faults=20/38 ratio=0.57x rss_gib=0.85")
+    m = HEARTBEAT_RE.search(old_pr5)
+    assert m and m.group("rep_done") is None
+    assert m.group("faults_dropped") == "20" and m.group("ratio") == "0.57"
+    old_pr4 = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+               "msteps/round=3.0 ev/mstep=3.33 ici_bytes=4096 q_hwm=7 "
+               "gear=2 ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(old_pr4)
+    assert m and m.group("gear") == "2" and m.group("rep_done") is None
+    old_pr2 = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+               "msteps/round=3.0 ev/mstep=3.33 ratio=0.40x rss_gib=1.00")
+    m = HEARTBEAT_RE.search(old_pr2)
+    assert m and m.group("rep_done") is None and m.group("ratio") == "0.40"
+
+
+def test_heartbeat_line_formats():
+    """The factored formatter emits byte-stable lines (minus the live
+    resource suffix) for every field combination, and they parse back."""
+    from shadow_tpu.sim import heartbeat_line
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    line = heartbeat_line(1_000_000_000, 2.5, 100, 30, 10, 4096, 7)
+    assert line.startswith(
+        "[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+        "msteps/round=3.0 ev/mstep=3.33 ici_bytes=4096 q_hwm=7 ratio=0.40x"
+    )
+    line = heartbeat_line(
+        1_000_000_000, 2.5, 100, 30, 10, 0, 7,
+        fault=(2, 3), gear=4, rep=(1, 8),
+    )
+    assert "faults=2/3 gear=4 rep=1/8 ratio=0.40x" in line
+    m = HEARTBEAT_RE.search(line)
+    assert m and m.group("rep_total") == "8" and m.group("gear") == "4"
+
+
+def test_replica_tracer_folds_per_replica():
+    """ReplicaTracer: per-replica cursors drain independently (a lagging
+    replica's rows are not misattributed), totals split sums vs maxes,
+    and wrap losses count per replica."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.obs.tracer import (
+        COL_EVENTS, COL_OCC_HWM, ReplicaTracer, TRACE_COLS, TraceRing,
+    )
+
+    rr, r_count = 4, 2
+    rows = np.zeros((r_count, 1, rr, TRACE_COLS), np.int64)
+    # replica 0 recorded 3 rounds (events 10, 20, 30; occ 5, 9, 2);
+    # replica 1 recorded 1 round (events 7; occ 4)
+    for i, (ev, occ) in enumerate([(10, 5), (20, 9), (30, 2)]):
+        rows[0, 0, i, COL_EVENTS] = ev
+        rows[0, 0, i, COL_OCC_HWM] = occ
+    rows[1, 0, 0, COL_EVENTS] = 7
+    rows[1, 0, 0, COL_OCC_HWM] = 4
+    ring = TraceRing(rows=jnp.asarray(rows),
+                     cursor=jnp.asarray([[3], [1]], jnp.int64))
+    tr = ReplicaTracer(rr, r_count)
+    assert tr.drain(ring) == 4
+    t = tr.replica_totals()
+    assert t[0]["rounds"] == 3 and t[0]["events"] == 60
+    assert t[0]["occ_hwm"] == 9
+    assert t[1]["rounds"] == 1 and t[1]["events"] == 7
+    agg = tr.totals()
+    assert agg["events"] == 67 and agg["occ_hwm"] == 9
+    # second drain with only replica 1 advancing; its cursor jumped
+    # 1 -> 6 over a 4-deep ring => 1 row lost, 4 folded
+    rows2 = rows.copy()
+    for i, ev in enumerate([100, 101, 102, 103]):
+        rows2[1, 0, i, COL_EVENTS] = ev
+    ring2 = TraceRing(rows=jnp.asarray(rows2),
+                      cursor=jnp.asarray([[3], [6]], jnp.int64))
+    assert tr.drain(ring2) == 4
+    t = tr.replica_totals()
+    assert t[0]["rounds"] == 3  # untouched
+    # replica 1 now totals 5 folded rounds (1 + 4), 1 lost to the wrap
+    assert t[1]["rounds"] == 5 and t[1]["rounds_lost"] == 1
+    # rows folded in the second drain: cursors 2..5 -> ring idx 2, 3, 0, 1
+    assert t[1]["events"] == 7 + 102 + 103 + 100 + 101
+    assert int(tr.rounds.sum()) == 8
+
+
+def test_ensemble_checkpoint_roundtrip_and_guard():
+    """Replica-axis checkpoints: save/load round-trips a stacked state
+    bit-exactly (bucket caches rebuilt per replica) and a wrong
+    fingerprint refuses."""
+    import tempfile
+
+    from shadow_tpu.core.checkpoint import (
+        CheckpointError,
+        load_ensemble_checkpoint,
+        save_ensemble_checkpoint,
+        snapshot_state,
+    )
+
+    hosts = mk_hosts(6, {"mean_delay": "20 ms", "population": 2})
+    stop = 300_000_000
+    builds = [
+        _build_replica("phold", hosts, stop, seed=seed, queue_block=8)
+        for seed in (1, 2)
+    ]
+    ens, state = build_ensemble(builds[0][1], [rep for _, _, rep in builds])
+    template = snapshot_state(state)
+    state, _ = _run_ensemble(ens, state)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = save_ensemble_checkpoint(
+            os.path.join(tmp, "camp"), state, "fp-abc"
+        )
+        restored = load_ensemble_checkpoint(path, template, "fp-abc")
+        for got, want in zip(
+            jax.tree_util.tree_leaves(restored),
+            jax.tree_util.tree_leaves(state),
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        with pytest.raises(CheckpointError, match="does not match"):
+            load_ensemble_checkpoint(path, template, "fp-other")
+
+
+def test_supervisor_sig_replica_aware():
+    """state_digest_sig must accept an ensemble state ([R] rounds) —
+    the campaign runs under the unmodified ChunkSupervisor."""
+    from shadow_tpu.core.supervisor import state_digest_sig
+
+    hosts = mk_hosts(4, {"mean_delay": "20 ms", "population": 2})
+    builds = [
+        _build_replica("phold", hosts, 200_000_000, seed=seed)
+        for seed in (1, 2)
+    ]
+    ens, state = build_ensemble(builds[0][1], [rep for _, _, rep in builds])
+    rounds, digest = state_digest_sig(state)
+    assert rounds == 0 and isinstance(digest, int)
+    state, _ = _run_ensemble(ens, state)
+    rounds2, digest2 = state_digest_sig(state)
+    assert rounds2 > 0 and digest2 != digest
+
+
+def test_compat_shim_promoted():
+    """The shard_map shim: one public home (core/compat.py), the old
+    private engine alias intact, and cosim no longer imports engine
+    privates at its two call sites."""
+    from shadow_tpu.core import compat, engine
+
+    assert engine._shard_map is compat.shard_map_compat
+    src = open(os.path.join(
+        os.path.dirname(__file__), "..", "shadow_tpu", "cosim.py"
+    )).read()
+    assert "from shadow_tpu.core.engine import _shard_map" not in src
+    assert src.count("shard_map_compat") >= 2

@@ -15,6 +15,13 @@
 # digest-exactness on a faulty scenario). Its failure is folded into the
 # exit code only when the pytest stage passed, so the primary signal
 # stays pytest's.
+#
+# Optional third stage: TIER1_CAMPAIGN=1 runs the ensemble-plane smoke
+# (tools/campaign.py --smoke: an A/A control campaign that must hold +
+# a forced-divergence A/B campaign whose bisection must agree with the
+# linear digest scan). The smoke runs its compiled legs in a worker
+# subprocess and self-classifies the known jaxlib corruption signature
+# as SKIP, like the soak stage.
 set -o pipefail
 cd "$(dirname "$0")/.."
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
@@ -32,5 +39,13 @@ if [ -n "${TIER1_SOAK:-}" ]; then
   soak_rc=$?
   echo "SOAK_RC=$soak_rc"
   [ "$rc" -eq 0 ] && rc=$soak_rc
+fi
+if [ -n "${TIER1_CAMPAIGN:-}" ]; then
+  echo "== campaign smoke (TIER1_CAMPAIGN) =="
+  timeout -k 10 "${TIER1_CAMPAIGN_TIMEOUT:-330}" \
+    env JAX_PLATFORMS=cpu python tools/campaign.py --smoke
+  campaign_rc=$?
+  echo "CAMPAIGN_RC=$campaign_rc"
+  [ "$rc" -eq 0 ] && rc=$campaign_rc
 fi
 exit $rc

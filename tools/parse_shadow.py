@@ -30,6 +30,9 @@ HEARTBEAT_RE = re.compile(
     r"(?:faults=(?P<faults_dropped>\d+)/(?P<faults_delayed>\d+) )?"
     # PR 4 adaptive-exchange field (only emitted on merge_gears runs)
     r"(?:gear=(?P<gear>\d+) )?"
+    # PR 6 ensemble-campaign field (only emitted by tools/campaign.py):
+    # rep=<replicas done>/<total replicas>
+    r"(?:rep=(?P<rep_done>\d+)/(?P<rep_total>\d+) )?"
     r"ratio=(?P<ratio>[\d.]+)x"
     r"(?: rss_gib=(?P<rss_gib>[\d.]+))?"
     r"(?: utime_min=(?P<utime_min>[\d.]+))?"
