@@ -591,13 +591,13 @@ def seed_queue(
         seq[host] += 1
     return (
         EventQueue(
-            t=jnp.asarray(t),
-            order=jnp.asarray(order),
-            kind=jnp.asarray(kind),
-            payload=jnp.asarray(payload),
+            t=jnp.asarray(t, jnp.int64),
+            order=jnp.asarray(order, jnp.int64),
+            kind=jnp.asarray(kind, jnp.int32),
+            payload=jnp.asarray(payload, jnp.int32),
             dropped=jnp.zeros((h,), jnp.int64),
         ),
-        jnp.asarray(seq),
+        jnp.asarray(seq, jnp.int64),
     )
 
 
@@ -1217,9 +1217,11 @@ def _trace_round(
         )
         vals[COL_HOSTS_DOWN] = jnp.sum(down, dtype=jnp.int64)
     row = jnp.stack([jnp.asarray(v, jnp.int64) for v in vals])
-    idx = (ring.cursor[0] % cfg.trace_rounds).astype(jnp.int32)
+    # the cursor is a registered i64 lane (core/lanes.py); the slice index
+    # stays i64 rather than narrowing the lane value (shadowlint R2)
+    idx = ring.cursor[0] % cfg.trace_rounds
     written = lax.dynamic_update_slice(
-        ring.rows, row[None, None, :], (jnp.int32(0), idx, jnp.int32(0))
+        ring.rows, row[None, None, :], (jnp.int64(0), idx, jnp.int64(0))
     )
     # the done-round is not a scheduling round: no row, no cursor bump
     return TraceRing(

@@ -325,7 +325,7 @@ def replica_ledger(
     one dict per replica, read from the stacked state in one device_get."""
     s = jax.device_get(state.stats)
     qdrop = np.asarray(jax.device_get(state.queue.dropped))
-    now = np.asarray(jax.device_get(state.now))
+    now = np.asarray(jax.device_get(state.now), np.int64)
     done = np.asarray(jax.device_get(state.done))
     r_count = np.asarray(s.digest).shape[0]
     n = num_real or np.asarray(s.digest).shape[1]

@@ -234,7 +234,7 @@ def compile_faults(
             for i, (d, u_) in enumerate(wins):
                 down[h, i] = d
                 up[h, i] = u_
-        down_t, up_t = jnp.asarray(down), jnp.asarray(up)
+        down_t, up_t = jnp.asarray(down, jnp.int64), jnp.asarray(up, jnp.int64)
     else:
         down_t = up_t = None
 

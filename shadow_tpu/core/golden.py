@@ -179,9 +179,9 @@ def run_golden(
 ) -> GoldenResult:
     h = cfg.num_hosts
     node_of = np.asarray(params.node_of)
-    lat_ns = np.asarray(params.lat_ns)
+    lat_ns = np.asarray(params.lat_ns, np.int64)
     loss = np.asarray(params.loss)
-    jitter_ns = np.asarray(params.jitter_ns)
+    jitter_ns = np.asarray(params.jitter_ns, np.int64)
     eg = [
         _TokenBucket(c, r, cfg.tb_interval_ns)
         for c, r in zip(np.asarray(params.eg_tb.capacity), np.asarray(params.eg_tb.refill))
@@ -311,10 +311,10 @@ def run_golden(
 
             # ---- model dispatch: the SAME vectorized handler as the device
             ctx = HandlerCtx(
-                t=jnp.asarray(ev_t),
+                t=jnp.asarray(ev_t, jnp.int64),
                 window_end=jnp.asarray(window_end, jnp.int64),
-                kind=jnp.asarray(ev_kind & KIND_MASK),
-                payload=jnp.asarray(ev_payload),
+                kind=jnp.asarray(ev_kind & KIND_MASK, jnp.int32),
+                payload=jnp.asarray(ev_payload, jnp.int32),
                 active=jnp.asarray(dispatch),
                 is_packet=jnp.asarray(is_pkt),
                 src=unpack_order_src(jnp.asarray(ev_order)),

@@ -1,0 +1,189 @@
+"""Lane registry: the single source of truth for simulation-lane widths.
+
+Every invariant this engine lives on — bit-identical digests, i64
+time/order keys, counter-based RNG purity — depends on lanes keeping
+their declared widths. The reference Shadow leans on Rust's type system
+for this (SimulationTime is a newtype over u64; a narrowing conversion
+does not compile). The JAX port has no static types, so this module
+declares the widths once and two enforcement layers read it:
+
+  * shadowlint stage A (tools/lint/astlint.py, rule R2) — pure-AST scan
+    of shadow_tpu/core + shadow_tpu/ops + obs/tracer.py flagging
+    `.astype(...)` narrowing and implicit-dtype construction of any
+    registered lane;
+  * the jaxpr audit (tools/lint/jaxpr_audit.py) — traces the round body
+    and asserts the actual carry dtypes of `STATE_LANES` match.
+
+The planned SimState "memory diet" (ROADMAP item 1) narrows lanes HERE,
+deliberately, and both layers follow — instead of an `astype` somewhere
+in the round body silently truncating event times.
+
+IMPORTANT: this module is imported by stage A, which must run without
+JAX (the tier-1 pre-stage survives jaxlib corruption that kills compiled
+runs). Keep it stdlib-only: names and dtype strings, no jnp.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Terminal lane names -> required width, used by the AST narrowing rule (R2).
+# A "terminal name" is the last attribute/variable name of an expression
+# (`ev.t` -> "t", `ring.cursor[0]` -> "cursor"). Narrowing a 64-bit lane
+# below its registered width, or constructing one without an explicit
+# dtype, is a lint error.
+# ---------------------------------------------------------------------------
+
+# Simulated-time lanes: int64 nanoseconds (reference SimulationTime).
+# i32 ns wraps at ~2.1 sim-seconds; i32 ms would break the deterministic
+# (time, order) total key. Never narrow.
+TIME_LANES = frozenset({
+    "t",
+    "now",
+    "window_start",
+    "window_end",
+    "cpu_busy_until",
+    "busy_until",
+    "min_used_lat",
+    "down_t",
+    "up_t",
+    "win_start",
+    "win_end",
+    "arrive",
+    "depart",
+    "eg_depart",
+    "next_time",
+    "exec_t",
+    "t_push",
+    "t_cand",
+    "resume",
+    "lat_ns",
+    "jitter_ns",
+})
+
+# Event-ordering lanes: int64 packed (locality, src-host, seq) keys
+# (ops/events.py pack_order). The packing uses the full 63 bits; any
+# narrowing collides order keys and breaks determinism.
+ORDER_LANES = frozenset({"order", "seq"})
+
+# Monotone counter lanes: int64. A long campaign overflows i32 counters
+# (events at 10k hosts pass 2^31 in under an hour of sim time), and the
+# trace ring's cursor arithmetic assumes no wrap.
+COUNTER_LANES = frozenset({"cursor", "rounds", "microsteps", "events"})
+
+# Digest lanes: uint64 (FNV-1a fold, core/engine.py _digest_update).
+DIGEST_LANES = frozenset({"digest"})
+
+# Deliberately-32-bit lanes (ids and per-round cursors bounded by
+# construction): narrowing TO these widths is fine, narrowing below is
+# not. Kept here so the registry names every engine lane, not only the
+# wide ones.
+NARROW_LANES = {
+    "dst": "int32",
+    "kind": "int32",
+    "payload": "int32",
+    "sent_round": "int32",
+}
+
+#: terminal lane name -> required dtype string
+LANE_WIDTHS: dict[str, str] = {
+    **{n: "int64" for n in TIME_LANES},
+    **{n: "int64" for n in ORDER_LANES},
+    **{n: "int64" for n in COUNTER_LANES},
+    **{n: "uint64" for n in DIGEST_LANES},
+    **NARROW_LANES,
+}
+
+#: ops helpers whose RETURN value is a lane (the AST rule resolves
+#: `q_next_time(q).astype(...)` through this map)
+FUNC_RETURN_LANES: dict[str, str] = {
+    "q_next_time": "t",
+    "next_time": "t",
+    "bq_next_time": "t",
+    "pack_order": "order",
+}
+
+BITS = {
+    "bool": 1,
+    "int8": 8, "uint8": 8,
+    "int16": 16, "uint16": 16,
+    "int32": 32, "uint32": 32, "float32": 32,
+    "int64": 64, "uint64": 64, "float64": 64,
+}
+
+
+def lane_width_bits(name: str) -> int | None:
+    """Registered width in bits for a terminal lane name, else None."""
+    dt = LANE_WIDTHS.get(name)
+    return BITS[dt] if dt else None
+
+
+# ---------------------------------------------------------------------------
+# SimState carry paths -> required dtype, asserted by the jaxpr audit on
+# the TRACED round body (jax.eval_shape of core/engine._run_chunk). Paths
+# are dotted attribute chains from SimState. Every Stats counter is also
+# required to appear here — stage A rule R3 cross-checks the Stats
+# NamedTuple against this dict, so adding a stats field without declaring
+# its width fails lint.
+# ---------------------------------------------------------------------------
+
+_STATS_I64 = (
+    "events", "pkts_sent", "pkts_lost", "pkts_unreachable",
+    "pkts_codel_dropped", "pkts_delivered", "monotonic_violations",
+    "pkts_budget_dropped", "faults_dropped", "faults_delayed",
+    "ob_dropped", "a2a_shed", "microsteps", "bq_rebuilds",
+    "popk_deferred", "ici_bytes", "q_occ_hwm", "outbox_hwm",
+    "gear_shed", "rounds",
+)
+
+STATE_LANES: dict[str, str] = {
+    "now": "int64",
+    "done": "bool",
+    "queue.t": "int64",
+    "queue.order": "int64",
+    "queue.kind": "int32",
+    "queue.payload": "int32",
+    "queue.dropped": "int64",
+    # bucketed-queue cache planes (present only when queue_block > 0)
+    "queue.bt": "int64",
+    "queue.bo": "int64",
+    "queue.bfill": "int32",
+    "rng.s": "uint64",
+    "seq": "int64",
+    "sent_round": "int32",
+    "cpu_busy_until": "int64",
+    "min_used_lat": "int64",
+    "outbox.dst": "int32",
+    "outbox.t": "int64",
+    "outbox.order": "int64",
+    "outbox.kind": "int32",
+    "outbox.payload": "int32",
+    "outbox.count": "int32",
+    "trace.rows": "int64",
+    "trace.cursor": "int64",
+    **{f"stats.{f}": "int64" for f in _STATS_I64},
+    "stats.digest": "uint64",
+}
+
+# ---------------------------------------------------------------------------
+# Stats fields that are deliberately NOT exported in sim-stats.json
+# (rule R3 requires every Stats field to be either read by
+# shadow_tpu/sim.py stats_report or listed here with a reason).
+# ---------------------------------------------------------------------------
+
+STATS_EXPORT_EXEMPT: dict[str, str] = {
+    "gear_shed": (
+        "transient gear-abort control signal: a shedding chunk is "
+        "discarded and replayed from its pre-chunk snapshot, so the "
+        "counter is structurally zero in any accepted final state; the "
+        "gears{} block in sim-stats carries the replay accounting"
+    ),
+}
+
+# ---------------------------------------------------------------------------
+# Heartbeat-format registry (rule R5). Keys that older emitters produced
+# but no current code path emits — the parser must keep matching them so
+# recorded logs stay parseable. (`windows=` is still live: the hybrid
+# cosim driver emits it.)
+# ---------------------------------------------------------------------------
+
+HEARTBEAT_LEGACY_KEYS: frozenset[str] = frozenset()

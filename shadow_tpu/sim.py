@@ -829,6 +829,10 @@ class Simulation:
             "faults_dropped": int(s.faults_dropped[:n].sum()),
             "faults_delayed": int(s.faults_delayed[:n].sum()),
             "outbox_overflow_dropped": int(np.asarray(s.ob_dropped).sum()),
+            # alltoall block-overflow sheds: structurally zero when
+            # a2a_block is sized right — exported so a mis-sized block is
+            # visible in sim-stats, not only in test asserts
+            "alltoall_shed_dropped": int(np.asarray(s.a2a_shed).sum()),
             "bucket_cache_rebuilds": int(np.asarray(s.bq_rebuilds).sum()),
             "popk_deferred": int(np.asarray(s.popk_deferred).sum()),
             "ici_bytes": int(np.asarray(s.ici_bytes).sum()),

@@ -224,6 +224,9 @@ def test_parse_and_plot_tools(tmp_path):
             str(log),
             "-o",
             str(parsed),
+            # literal-line gate: a seed-generation heartbeat the parser
+            # silently skipped would pass without --strict (shadowlint R5)
+            "--strict",
         ],
         capture_output=True,
         text=True,

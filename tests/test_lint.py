@@ -1,0 +1,458 @@
+"""shadowlint gates: every rule pack must (a) fire on a known-bad fixture
+and (b) stay quiet on the real tree; heartbeat format generations must
+round-trip through `parse_shadow --strict`; the jaxpr audit must hold the
+lane-width and fingerprint invariants on the echo config.
+
+Stage A tests import no JAX (that is the point of stage A); the jaxpr
+audit test and the live-emitter round-trip do.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint.astlint import Project, run_stage_a  # noqa: E402
+from tools.lint import schema as lint_schema  # noqa: E402
+
+
+def _mk(tmp_path, relpath: str, src: str) -> None:
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+
+
+# --------------------------------------------------------------------------
+# R1: jit purity
+# --------------------------------------------------------------------------
+
+
+def test_r1_fires_on_clock_rng_io_and_global(tmp_path):
+    _mk(tmp_path, "shadow_tpu/core/eng.py", """
+        import time
+        import numpy as np
+
+        COUNTER = 0
+
+        def helper(x):
+            return np.random.rand() + time.time()
+
+        def round_body(state):
+            global COUNTER
+            COUNTER += 1
+            print(state)
+            open("/tmp/x", "w")
+            return helper(state)
+    """)
+    fs = run_stage_a(str(tmp_path), entries=["shadow_tpu.core.eng:round_body"])
+    r1 = [f for f in fs if f.rule == "R1"]
+    msgs = "\n".join(f.msg for f in r1)
+    assert "time" in msgs, msgs
+    assert "numpy.random" in msgs, msgs
+    assert "`print`" in msgs and "`open`" in msgs, msgs
+    assert "global COUNTER" in msgs, msgs
+    # the banned call sits in a HELPER — reached through the call graph
+    assert any("helper" in f.msg for f in r1), msgs
+
+
+def test_r1_ignores_host_side_functions(tmp_path):
+    _mk(tmp_path, "shadow_tpu/core/eng.py", """
+        import os
+
+        def round_body(state):
+            return state + 1
+
+        def init_state():
+            return os.environ.get("SEED", "0")
+    """)
+    fs = run_stage_a(str(tmp_path), entries=["shadow_tpu.core.eng:round_body"])
+    assert [f for f in fs if f.rule == "R1"] == []
+
+
+def test_r1_control_plane_allows_io_but_not_clock(tmp_path):
+    _mk(tmp_path, "shadow_tpu/core/ctl.py", """
+        import time
+
+        def controller(state, log):
+            print(state, file=log)
+            return time.monotonic()
+    """)
+    fs = run_stage_a(
+        str(tmp_path),
+        entries=["shadow_tpu.core.ctl:controller"],
+        traced_entries=[],
+    )
+    r1 = [f for f in fs if f.rule == "R1"]
+    msgs = "\n".join(f.msg for f in r1)
+    assert "time" in msgs, msgs  # clock read: banned even host-side
+    assert "print" not in msgs, msgs  # host I/O: fine in the control plane
+
+
+# --------------------------------------------------------------------------
+# R2: lane widths
+# --------------------------------------------------------------------------
+
+
+def test_r2_fires_on_narrowing_and_implicit_dtype(tmp_path):
+    _mk(tmp_path, "shadow_tpu/core/eng.py", """
+        import jax.numpy as jnp
+
+        def f(ev, vals, mk):
+            t32 = ev.t.astype(jnp.int32)          # narrowing a time lane
+            t = jnp.asarray(vals)                 # implicit width
+            q = mk(order=jnp.zeros((4,), jnp.int32))   # wrong width
+            e = mk(t=5)                           # bare int literal
+            ok = ev.order.astype(jnp.int64)       # widening: fine
+            ok2 = mk(kind=jnp.zeros((4,), jnp.int32))  # registered i32: fine
+            return t32, t, q, e, ok, ok2
+    """)
+    fs = run_stage_a(str(tmp_path), entries=[])
+    r2 = [f for f in fs if f.rule == "R2"]
+    msgs = "\n".join(f.msg for f in r2)
+    assert "`t.astype(int32)` narrows" in msgs, msgs
+    assert "constructed without an explicit dtype" in msgs, msgs
+    assert "`order` constructed as int32" in msgs, msgs
+    assert "bare int literal for 64-bit lane `t`" in msgs, msgs
+    assert len(r2) == 4, msgs  # the two `ok` lines stay quiet
+
+
+def test_r2_quiet_on_dtype_preserving_idioms(tmp_path):
+    _mk(tmp_path, "shadow_tpu/core/eng.py", """
+        import jax.numpy as jnp
+
+        def f(ob, src):
+            t = jnp.full_like(ob.t, 42)            # *_like inherits dtype
+            order = jnp.asarray(src, jnp.int64)    # explicit
+            occ = (ob.t != 42).astype(jnp.int32)   # bool compare: no lane
+            return t, order, occ
+    """)
+    fs = run_stage_a(str(tmp_path), entries=[])
+    assert [f for f in fs if f.rule == "R2"] == []
+
+
+# --------------------------------------------------------------------------
+# R4: static-arg hygiene
+# --------------------------------------------------------------------------
+
+
+def test_r4_fires_on_item_and_lane_int(tmp_path):
+    _mk(tmp_path, "shadow_tpu/core/eng.py", """
+        def round_body(st, s):
+            n = int(st.now)         # traced lane -> Python int
+            v = st.seq.item()       # .item() in traced scope
+            k = int(getattr(s, "count_max", 1) or 1)  # static metadata: fine
+            return n + v + k
+    """)
+    fs = run_stage_a(str(tmp_path), entries=["shadow_tpu.core.eng:round_body"])
+    r4 = [f for f in fs if f.rule == "R4"]
+    msgs = "\n".join(f.msg for f in r4)
+    assert "int(...now...)" in msgs, msgs
+    assert ".item()" in msgs, msgs
+    assert len(r4) == 2, msgs
+
+
+# --------------------------------------------------------------------------
+# R3: stats schema + trace columns
+# --------------------------------------------------------------------------
+
+
+def _schema_project(tmp_path, engine_src):
+    _mk(tmp_path, "shadow_tpu/core/engine.py", engine_src)
+    return Project(str(tmp_path), extra_dirs=())
+
+
+def test_r3_fires_on_schema_drift(tmp_path):
+    proj = _schema_project(tmp_path, """
+        from typing import NamedTuple
+
+        class Stats(NamedTuple):
+            events: int
+            mystery: int
+
+        def _init_stats():
+            return Stats(events=1)
+
+        class Engine:
+            def state_specs(self):
+                return Stats(events=1, bogus_spec=2)
+
+        def upd(st):
+            return st.stats._replace(not_a_field=1)
+    """)
+    fs = lint_schema.check_stats_schema(proj)
+    msgs = "\n".join(f.msg for f in fs)
+    assert "Stats.mystery missing from _init_stats" in msgs, msgs
+    assert "Stats.mystery missing from Engine.state_specs" in msgs, msgs
+    assert "`bogus_spec`, which is not a Stats field" in msgs, msgs
+    assert "stats._replace(not_a_field=...)" in msgs, msgs
+    assert "no entry in shadow_tpu/core/lanes.py" in msgs, msgs  # stats.mystery
+
+
+def test_r3_trace_columns_append_only(tmp_path):
+    _mk(tmp_path, "shadow_tpu/obs/tracer.py", """
+        TRACE_FIELDS = ("round", "events", "window_start")
+    """)
+    proj = Project(str(tmp_path), extra_dirs=())
+    cols = tmp_path / "cols.txt"
+
+    # reorder/remove -> violation
+    cols.write_text("round\nwindow_start\nevents\n")
+    fs = lint_schema.check_trace_columns(proj, columns_file=str(cols))
+    assert fs and "APPEND-ONLY" in fs[0].msg
+
+    # growth without registering -> violation naming the new column
+    cols.write_text("round\nevents\n")
+    fs = lint_schema.check_trace_columns(proj, columns_file=str(cols))
+    assert fs and "window_start" in fs[0].msg
+
+    # exact match -> clean
+    cols.write_text("round\nevents\nwindow_start\n")
+    assert lint_schema.check_trace_columns(proj, columns_file=str(cols)) == []
+
+
+# --------------------------------------------------------------------------
+# R5: heartbeat format compat
+# --------------------------------------------------------------------------
+
+
+def test_r5_fires_on_unparsed_field_and_dead_branch(tmp_path):
+    _mk(tmp_path, "shadow_tpu/sim.py", '''
+        def heartbeat_line(now, wall):
+            return f"[heartbeat] sim_time={now}s zzz={wall} ratio=1.0x"
+    ''')
+    proj = Project(str(tmp_path), extra_dirs=())
+    gens = tmp_path / "gens.txt"
+    gens.write_text("[heartbeat] sim_time=1.0s zzz=2 ratio=1.0x\nbroken hb line\n")
+    hb_re = re.compile(
+        r"\[heartbeat\] sim_time=(?P<sim>[\d.]+)s "
+        r"(?:retired=(?P<retired>\d+) )?ratio=(?P<ratio>[\d.]+)x"
+    )
+    fs = lint_schema.check_heartbeat_compat(
+        proj, heartbeat_re=hb_re, generations_file=str(gens)
+    )
+    msgs = "\n".join(f.msg for f in fs)
+    assert "`zzz=` is emitted" in msgs, msgs          # emitted, unparsed
+    assert "matches `retired=`" in msgs, msgs         # parsed, never emitted
+    assert "no longer parses" in msgs, msgs           # broken generation line
+
+
+def test_r5_suffix_key_is_not_a_match(tmp_path):
+    """An emitted key that is a SUFFIX of a parsed key (`hwm=` vs `q_hwm=`)
+    must still be flagged — matching is against the parser's literal key
+    set, never substring."""
+    _mk(tmp_path, "shadow_tpu/sim.py", '''
+        def heartbeat_line(now, hwm):
+            return f"[heartbeat] sim_time={now}s hwm={hwm} ratio=1.0x"
+    ''')
+    proj = Project(str(tmp_path), extra_dirs=())
+    gens = tmp_path / "gens.txt"
+    gens.write_text("")
+    hb_re = re.compile(
+        r"\[heartbeat\] sim_time=(?P<sim>[\d.]+)s "
+        r"(?:q_hwm=(?P<q_hwm>\d+) )?ratio=(?P<ratio>[\d.]+)x"
+    )
+    fs = lint_schema.check_heartbeat_compat(
+        proj, heartbeat_re=hb_re, generations_file=str(gens)
+    )
+    msgs = "\n".join(f.msg for f in fs)
+    assert "`hwm=` is emitted" in msgs, msgs
+
+
+def test_r5_harvests_optional_field_assignments(tmp_path):
+    _mk(tmp_path, "shadow_tpu/sim.py", '''
+        def heartbeat_line(now, gear=None):
+            gear_f = f"gear={gear} " if gear is not None else ""
+            return f"[heartbeat] sim_time={now}s {gear_f}ratio=1.0x"
+    ''')
+    proj = Project(str(tmp_path), extra_dirs=())
+    keys = lint_schema.emitted_heartbeat_keys(proj)
+    assert set(keys) == {"sim_time", "gear", "ratio"}
+
+
+# --------------------------------------------------------------------------
+# the real tree is clean
+# --------------------------------------------------------------------------
+
+
+def test_stage_a_clean_on_repo():
+    from tools.lint.__main__ import (
+        BASELINE_FILE, check_suppression_policy, load_baseline,
+        split_suppressed,
+    )
+    from tools.lint.schema import run_schema_rules
+
+    project = Project(REPO)
+    findings = run_stage_a(REPO, project=project)
+    findings += run_schema_rules(REPO, project=project)
+    suppressions = load_baseline(BASELINE_FILE)
+    active, suppressed = split_suppressed(findings, suppressions)
+    assert active == [], "\n".join(str(f) for f in active)
+    # acceptance: zero suppressions in core/ and ops/
+    assert check_suppression_policy(suppressions) == []
+    for s in suppressions:
+        assert not s["path"].startswith(("shadow_tpu/core/", "shadow_tpu/ops/"))
+
+
+def test_cli_ast_only_fast_and_clean():
+    import time as _time
+
+    t0 = _time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--ast-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    wall = _time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert wall < 30, f"stage A took {wall:.1f}s — tier-1 pre-stage budget is 30s"
+
+
+# --------------------------------------------------------------------------
+# heartbeat generations: runtime round-trip through parse_shadow --strict
+# --------------------------------------------------------------------------
+
+
+def _generation_lines():
+    with open(os.path.join(REPO, "tools", "lint", "heartbeat_generations.txt")) as f:
+        return [
+            ln.rstrip("\n") for ln in f
+            if ln.strip() and not ln.lstrip().startswith("#")
+        ]
+
+
+def test_generations_match_statically():
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    for ln in _generation_lines():
+        assert HEARTBEAT_RE.search(ln), f"generation line no longer parses: {ln!r}"
+
+
+def _run_parse_shadow(tmp_path, log_text: str, strict: bool):
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    log = tmp_path / "run.log"
+    log.write_text(log_text)
+    out = tmp_path / "out.json"
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "parse_shadow.py"),
+        str(data), "--log", str(log), "-o", str(out),
+    ]
+    if strict:
+        cmd.append("--strict")
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, timeout=60)
+    return r, out
+
+
+def test_generations_roundtrip_strict(tmp_path):
+    lines = _generation_lines()
+    r, out = _run_parse_shadow(tmp_path, "\n".join(lines) + "\n", strict=True)
+    assert r.returncode == 0, r.stderr
+    hbs = json.loads(out.read_text())["heartbeats"]
+    assert len(hbs) == len(lines)
+    # spot-check one field per generation era
+    assert hbs[0]["windows"] == 10 and hbs[0]["sim"] == 0.5
+    assert hbs[1]["rss_gib"] == 1.25
+    assert any(h.get("gear") == 4 for h in hbs)
+    assert any(h.get("faults_dropped") == 3 and h.get("faults_delayed") == 5 for h in hbs)
+    assert any(h.get("rep_done") == 3 and h.get("rep_total") == 6 for h in hbs)
+
+
+def test_strict_rejects_malformed_heartbeat(tmp_path):
+    bad = "[heartbeat] sim_time=borked wall=nope\nsome other stderr line\n"
+    r, _ = _run_parse_shadow(tmp_path, bad, strict=True)
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "unparseable heartbeat" in r.stderr
+    # default mode keeps the old tolerant behavior
+    r2, out = _run_parse_shadow(tmp_path, bad, strict=False)
+    assert r2.returncode == 0, r2.stderr
+    assert json.loads(out.read_text())["heartbeats"] == []
+
+
+def test_strict_rejects_trailing_unknown_field(tmp_path):
+    """A line that MATCHES the regex but carries an extra field past the
+    parsed span would be silently truncated — strict mode refuses it."""
+    sneaky = (
+        "[heartbeat] sim_time=1.000s wall=2.50s events=99 rounds=40 "
+        "ratio=0.40x newfield=7\n"
+    )
+    r, _ = _run_parse_shadow(tmp_path, sneaky, strict=True)
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "past the parsed span" in r.stderr
+    r2, out = _run_parse_shadow(tmp_path, sneaky, strict=False)
+    assert r2.returncode == 0  # tolerant mode: parsed, field dropped
+    assert json.loads(out.read_text())["heartbeats"][0]["rounds"] == 40
+
+
+def test_live_emitter_roundtrips_strict(tmp_path):
+    """The CURRENT heartbeat_line output (every optional-field combination)
+    strict-parses — the runtime half of R5."""
+    from shadow_tpu.sim import heartbeat_line  # imports jax (x64 setup)
+
+    lines = [
+        heartbeat_line(1_000_000_000, 2.5, 100, 30, 10, 4096, 7),
+        heartbeat_line(
+            2_000_000_000, 2.5, 100, 30, 10, 0, 7,
+            fault=(2, 3), gear=4, rep=(1, 8),
+        ),
+    ]
+    r, out = _run_parse_shadow(tmp_path, "\n".join(lines) + "\n", strict=True)
+    assert r.returncode == 0, r.stderr
+    hbs = json.loads(out.read_text())["heartbeats"]
+    assert len(hbs) == 2
+    assert hbs[1]["gear"] == 4 and hbs[1]["rep_total"] == 8
+
+
+# --------------------------------------------------------------------------
+# stage B: jaxpr audit
+# --------------------------------------------------------------------------
+
+
+def test_jaxpr_audit_echo_clean():
+    from tools.lint.jaxpr_audit import run_audit
+
+    findings, report = run_audit(root=REPO, configs=("echo",))
+    rep = report["echo"]
+    if rep["fingerprint_status"] == "unrecorded":
+        # foreign jax version: the only acceptable finding is the
+        # demand to pin a fingerprint — lane/scatter checks still gate
+        assert all("no primitive fingerprint" in str(f) for f in findings)
+    else:
+        assert findings == [], "\n".join(str(f) for f in findings)
+        assert rep["fingerprint_status"] == "ok"
+    # digest-feeding lanes are integer: no float scatter-add may appear
+    assert rep["float_scatter_adds"] == 0
+    assert rep["eqns"] > 100  # a real round body, not a stub trace
+
+
+def test_jaxpr_fingerprint_detects_churn(tmp_path):
+    import jax
+
+    from tools.lint import jaxpr_audit
+
+    with open(jaxpr_audit.FINGERPRINT_FILE) as f:
+        recorded = json.load(f)
+    ver = jax.__version__
+    if ver not in recorded or "echo" not in recorded[ver]:
+        pytest.skip(f"no recorded fingerprint for jax=={ver}")
+    bad = json.loads(json.dumps(recorded))
+    bad[ver]["echo"]["eqns"] += 1
+    bad[ver]["echo"]["primitives"]["add"] = (
+        bad[ver]["echo"]["primitives"].get("add", 0) + 1
+    )
+    fp = tmp_path / "fp.json"
+    fp.write_text(json.dumps(bad))
+    findings, report = jaxpr_audit.run_audit(
+        root=REPO, configs=("echo",), fingerprint_file=str(fp)
+    )
+    assert any("fingerprint changed" in str(f) for f in findings), report
+    # a mismatch must NOT silently rewrite the recorded baseline
+    assert json.loads(fp.read_text()) == bad

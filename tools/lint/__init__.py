@@ -1,0 +1,36 @@
+"""shadowlint — static exactness/purity analyzer for the jitted round body.
+
+Two stages, run via `python -m tools.lint`:
+
+  Stage A (astlint.py + schema.py) — pure-AST rule packs over the repo,
+  importing NO JAX (so the tier-1 pre-stage survives the jaxlib
+  corruption that can kill compiled runs on some boxes):
+
+    R1 jit purity       no time/random/np.random/datetime/global-state
+                        mutation or file I/O reachable from the jitted
+                        entry points
+    R2 lane widths      time/order/counter lanes stay their registered
+                        width (shadow_tpu/core/lanes.py); no astype
+                        narrowing, no implicit-dtype construction
+    R3 carry/schema     Stats fields consistent across the NamedTuple,
+                        _init_stats, sharding specs, lane registry, and
+                        sim-stats export; trace-ring columns append-only
+    R4 static hygiene   EngineConfig statics hashable; no int()/.item()
+                        on lane values inside jitted scope
+    R5 format compat    every heartbeat field emitted anywhere is matched
+                        by tools/parse_shadow.py, and all recorded
+                        heartbeat generations still parse
+
+  Stage B (jaxpr_audit.py) — traces the round body for small echo/phold
+  configs on CPU and walks the jaxpr: lane carry dtypes must match the
+  registry, no 64->32 integer down-cast on a carry lane, float
+  scatter-adds recorded, and a primitive-count fingerprint pinned per
+  jax version (compile-surface churn shows up as a diff, not a surprise
+  recompile).
+
+Findings carry `rule path:line message`. Pre-existing violations are
+burned down through tools/lint/baseline.json — explicit, reviewed
+suppressions, kept at ZERO for shadow_tpu/core and shadow_tpu/ops.
+"""
+
+from tools.lint.astlint import Finding, run_stage_a  # noqa: F401

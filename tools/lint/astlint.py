@@ -1,0 +1,699 @@
+"""shadowlint stage A: AST-level rule packs (no JAX import, ever).
+
+The analyzer parses every module under `shadow_tpu/` (plus `tools/`),
+builds the call graph reachable from the jitted entry points, and runs
+the function-scope rules (R1 purity, R2 lane widths, R4 static-arg
+hygiene) over the reachable set. Schema-level rules (R3, R5) live in
+tools/lint/schema.py.
+
+Resolution is heuristic but deterministic: direct calls resolve through
+the module's own defs and its import table; `self.foo()` resolves within
+the class; bare function references (callbacks, functools.partial
+arguments) count as edges too, so wrapping a traced function never hides
+it. Dynamic dispatch (`model.handle`) is out of reach of stage A — the
+jaxpr audit covers what actually gets traced.
+
+The lane registry (shadow_tpu/core/lanes.py) is loaded BY FILE PATH, not
+imported as a package: `import shadow_tpu` pulls in jax from its
+__init__, and stage A must run on a box whose jaxlib is corrupted.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import os
+import sys
+
+# modules whose use inside jit-reachable code is a purity violation.
+# `os`/`sys`/file handles are host I/O; `time`/`datetime` are wall-clock
+# reads (the reference's determinism gate exists precisely because sim
+# code must never see the host clock); `random`/`numpy.random` are
+# stateful RNGs (the engine's RNG is counter-based and carried in
+# SimState — ops/rng.py).
+BANNED_MODULES = frozenset({
+    "time", "random", "datetime", "os", "sys", "io", "pathlib", "shutil",
+    "subprocess", "tempfile", "socket", "threading", "multiprocessing",
+    "logging",
+})
+BANNED_DOTTED_PREFIXES = ("numpy.random",)
+
+# the determinism subset: modules that break replay-determinism anywhere
+# in the engine's decision path, host-side control planes included
+DETERMINISM_MODULES = frozenset({"time", "random", "datetime", "secrets", "uuid"})
+BANNED_BUILTINS = frozenset({
+    "open", "input", "print", "exec", "eval", "breakpoint", "globals",
+})
+
+# dtype widths sourced from the lane registry at load time
+NARROWING_METHODS = frozenset({"astype"})
+CONSTRUCTORS = {
+    # callable name -> index of the dtype positional argument. The *_like
+    # family is deliberately absent: it inherits the source array's dtype,
+    # which is exactly the registry-preserving behavior.
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+    "asarray": 1, "array": 1,
+}
+
+# hashable static types allowed for EngineConfig fields (R4)
+HASHABLE_ANNOTATIONS = frozenset({"int", "bool", "str", "float", "bytes"})
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_lanes(root: str):
+    """Load shadow_tpu/core/lanes.py WITHOUT importing shadow_tpu (whose
+    __init__ imports jax)."""
+    path = os.path.join(root, "shadow_tpu", "core", "lanes.py")
+    if not os.path.exists(path):
+        # fixture trees (tests) lint against the real registry
+        path = os.path.join(repo_root(), "shadow_tpu", "core", "lanes.py")
+    spec = importlib.util.spec_from_file_location("_shadowlint_lanes", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # "R1".."R5"
+    path: str  # repo-relative, forward slashes
+    line: int
+    msg: str
+
+    def __str__(self) -> str:
+        return f"{self.rule} {self.path}:{self.line} {self.msg}"
+
+
+# --------------------------------------------------------------------------
+# module / function index
+# --------------------------------------------------------------------------
+
+
+class ModuleInfo:
+    def __init__(self, name: str, path: str, tree: ast.Module):
+        self.name = name  # dotted, e.g. "shadow_tpu.core.engine"
+        self.path = path  # repo-relative
+        self.tree = tree
+        self.imports: dict[str, str] = {}  # local alias -> dotted module
+        self.from_imports: dict[str, tuple[str, str]] = {}  # local -> (mod, orig)
+        self.functions: dict[str, ast.AST] = {}  # qualname -> FunctionDef
+        self._index()
+
+    def _index(self):
+        for node in self.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._add_import(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.functions[f"{node.name}.{sub.name}"] = sub
+
+    def _add_import(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        else:
+            if node.module is None or node.level:
+                return  # relative imports unused in this tree
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                self.from_imports[a.asname or a.name] = (node.module, a.name)
+
+    def resolve_local(self, name: str):
+        """local name -> ("func", module, qualname) | ("module", dotted) | None"""
+        if name in self.functions:
+            return ("func", self.name, name)
+        if name in self.from_imports:
+            mod, orig = self.from_imports[name]
+            return ("maybe_func", mod, orig)
+        if name in self.imports:
+            return ("module", self.imports[name])
+        return None
+
+
+class Project:
+    """Parsed view of the repo for stage A."""
+
+    def __init__(self, root: str, extra_dirs: tuple[str, ...] = ("tools",)):
+        self.root = root
+        self.lanes = load_lanes(root)
+        self.modules: dict[str, ModuleInfo] = {}
+        self.syntax_errors: list = []
+        self._scan_dir("shadow_tpu")
+        for d in extra_dirs:
+            self._scan_dir(d)
+
+    def _scan_dir(self, rel: str):
+        base = os.path.join(self.root, rel)
+        if not os.path.isdir(base):
+            return
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                relpath = os.path.relpath(full, self.root).replace(os.sep, "/")
+                dotted = relpath[:-3].replace("/", ".")
+                if dotted.endswith(".__init__"):
+                    dotted = dotted[: -len(".__init__")]
+                try:
+                    with open(full, encoding="utf-8") as f:
+                        tree = ast.parse(f.read(), filename=relpath)
+                except SyntaxError as e:
+                    # surfaced as a finding by run_stage_a
+                    tree = ast.Module(body=[], type_ignores=[])
+                    self.syntax_errors.append((relpath, e))
+                self.modules[dotted] = ModuleInfo(dotted, relpath, tree)
+
+    # ---- call graph -------------------------------------------------------
+
+    def resolve_call(self, mod: ModuleInfo, qual: str, node: ast.AST):
+        """Resolve a call/reference AST node to a function key
+        "module:qualname", or None."""
+        if isinstance(node, ast.Name):
+            r = mod.resolve_local(node.id)
+            if r and r[0] == "func":
+                return f"{r[1]}:{r[2]}"
+            if r and r[0] == "maybe_func":
+                return self._follow_reexports(r[1], r[2])
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and "." in qual:
+                    cls = qual.split(".")[0]
+                    key = f"{cls}.{node.attr}"
+                    if key in mod.functions:
+                        return f"{mod.name}:{key}"
+                    return None
+                r = mod.resolve_local(base.id)
+                if r and r[0] == "module":
+                    return self._follow_reexports(r[1], node.attr)
+        return None
+
+    def _follow_reexports(self, mod_name: str, fname: str, depth: int = 4):
+        """Resolve `fname` in `mod_name`, chasing `from x import y` re-export
+        chains (package __init__ facades like shadow_tpu.net)."""
+        while depth > 0:
+            target = self.modules.get(mod_name)
+            if target is None:
+                return None
+            if fname in target.functions:
+                return f"{target.name}:{fname}"
+            if fname in target.from_imports:
+                mod_name, fname = target.from_imports[fname]
+                depth -= 1
+                continue
+            return None
+        return None
+
+    def edges_of(self, key: str) -> set[str]:
+        mod_name, qual = key.split(":", 1)
+        mod = self.modules[mod_name]
+        fn = mod.functions[qual]
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                tgt = self.resolve_call(mod, qual, node.func)
+                if tgt:
+                    out.add(tgt)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                # bare references: callbacks, functools.partial args — a
+                # traced function passed by value is still traced
+                tgt = self.resolve_call(mod, qual, node)
+                if tgt:
+                    out.add(tgt)
+        return out
+
+    def reachable(self, entries: list[str]) -> list[str]:
+        seen: list[str] = []
+        seen_set: set[str] = set()
+        stack = [e for e in entries if self._exists(e)]
+        while stack:
+            key = stack.pop()
+            if key in seen_set:
+                continue
+            seen_set.add(key)
+            seen.append(key)
+            for nxt in sorted(self.edges_of(key)):
+                if nxt not in seen_set:
+                    stack.append(nxt)
+        return seen
+
+    def _exists(self, key: str) -> bool:
+        mod_name, qual = key.split(":", 1)
+        m = self.modules.get(mod_name)
+        return bool(m and qual in m.functions)
+
+    def expand_entries(self, specs: list[str]) -> list[str]:
+        """Entry specs: "module:name" or "module:*" (every function and
+        method defined in the module)."""
+        out: list[str] = []
+        for spec in specs:
+            mod_name, qual = spec.split(":", 1)
+            m = self.modules.get(mod_name)
+            if m is None:
+                continue
+            if qual == "*":
+                out.extend(f"{mod_name}:{q}" for q in sorted(m.functions))
+            else:
+                out.append(spec)
+        return out
+
+
+# The jitted entry points (ISSUE 7): the chunk bodies the drivers jit
+# (vmapped by the ensemble plane — its traced body IS engine._run_chunk),
+# the fault plane's jit-side helpers, and every ops kernel. Host-side
+# builders (Engine.init_state, compile_faults, seed_queue) are
+# deliberately NOT traced entries: they run in Python, where file I/O and
+# env reads are legitimate.
+DEFAULT_TRACED_ENTRIES = [
+    "shadow_tpu.core.engine:_run_chunk",
+    "shadow_tpu.core.engine:_run_guarded_chunk",
+    "shadow_tpu.core.engine:_round_step_capture",
+    "shadow_tpu.core.faults:down_and_resume",
+    "shadow_tpu.core.faults:window_effects",
+    "shadow_tpu.ops.events:*",
+    "shadow_tpu.ops.merge:*",
+    "shadow_tpu.ops.rng:*",
+]
+
+# The gear/ensemble control planes run host-side between dispatches, but
+# their decisions feed the deterministic replay machinery, so wall-clock
+# and RNG reads are just as banned (the DETERMINISM subset of R1). Host
+# I/O (progress prints to an explicit log) is legitimate there, and R4's
+# traced-value checks do not apply — a host driver reading
+# `int(state.stats.rounds)` off a concrete array is fine.
+DEFAULT_CONTROL_ENTRIES = [
+    "shadow_tpu.core.gears:*",
+    "shadow_tpu.core.ensemble:*",
+]
+
+DEFAULT_ENTRIES = DEFAULT_TRACED_ENTRIES + DEFAULT_CONTROL_ENTRIES
+
+# R2/R4 file scope: the engine core and kernels (plus the tracer module,
+# which owns the `cursor` lane). Models and drivers construct lanes only
+# through engine/ops entry points, which coerce dtypes explicitly.
+LANE_SCOPE_PREFIXES = ("shadow_tpu/core/", "shadow_tpu/ops/", "shadow_tpu/obs/tracer.py")
+
+# tools determinism hygiene (R1, tools scope): stdlib `random` is banned
+# in tools/ — every bench/soak draw goes through a seeded
+# np.random.default_rng so reruns are reproducible from the CLI seed.
+TOOLS_BANNED_IMPORTS = frozenset({"random"})
+
+
+# --------------------------------------------------------------------------
+# R1: jit purity
+# --------------------------------------------------------------------------
+
+
+def _function_local_imports(fn: ast.AST):
+    imports: dict[str, str] = {}
+    from_imports: dict[str, tuple[str, str]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name != "*":
+                    from_imports[a.asname or a.name] = (node.module, a.name)
+    return imports, from_imports
+
+
+def _resolve_base_module(name: str, mod: ModuleInfo, local_imports, local_from):
+    if name in local_imports:
+        return local_imports[name]
+    if name in local_from:
+        m, orig = local_from[name]
+        return f"{m}.{orig}"
+    if name in mod.imports:
+        return mod.imports[name]
+    if name in mod.from_imports:
+        m, orig = mod.from_imports[name]
+        return f"{m}.{orig}"
+    return None
+
+
+def check_purity(
+    project: Project, key: str, io_bans: bool = True
+) -> list[Finding]:
+    """R1 over one reachable function. `io_bans=False` is the control-plane
+    tier (host drivers between dispatches): determinism bans (clock, RNG,
+    global mutation) stay, host I/O is allowed."""
+    mod_name, qual = key.split(":", 1)
+    mod = project.modules[mod_name]
+    fn = mod.functions[qual]
+    local_imports, local_from = _function_local_imports(fn)
+    out: list[Finding] = []
+    banned_mods = BANNED_MODULES if io_bans else DETERMINISM_MODULES
+    where = "jit-reachable" if io_bans else "replay-deterministic"
+
+    def hit(node, what):
+        out.append(Finding(
+            "R1", mod.path, node.lineno,
+            f"{what} inside {where} `{qual}` — traced code must be "
+            f"pure in (state, params)",
+        ))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            dotted = _resolve_base_module(
+                node.value.id, mod, local_imports, local_from
+            )
+            if dotted is None:
+                continue
+            root = dotted.split(".")[0]
+            full = f"{dotted}.{node.attr}"
+            if root in banned_mods:
+                hit(node, f"use of banned module `{dotted}` ({full})")
+            elif any(
+                full.startswith(p) or dotted.startswith(p)
+                for p in BANNED_DOTTED_PREFIXES
+            ):
+                hit(node, f"use of `{full}` (stateful host RNG)")
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            name = node.func.id
+            if io_bans and name in BANNED_BUILTINS and not any(
+                name in d for d in (local_imports, local_from,
+                                    mod.imports, mod.from_imports)
+            ):
+                hit(node, f"call to builtin `{name}` (host I/O / global state)")
+        elif isinstance(node, ast.Global):
+            hit(node, f"`global {', '.join(node.names)}` (global-state mutation)")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] in banned_mods:
+                    hit(node, f"function-local `import {a.name}`")
+    return out
+
+
+def check_tools_determinism(project: Project) -> list[Finding]:
+    """stdlib `random` in tools/: flagged so every tool draw runs through a
+    seeded np.random.default_rng (reproducible from the CLI seed)."""
+    out = []
+    for mod in project.modules.values():
+        if not mod.path.startswith("tools/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in TOOLS_BANNED_IMPORTS:
+                        out.append(Finding(
+                            "R1", mod.path, node.lineno,
+                            f"stdlib `import {a.name}` in a tool — use a "
+                            f"seeded np.random.default_rng so runs are "
+                            f"reproducible from the seed argument",
+                        ))
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.split(".")[0] in TOOLS_BANNED_IMPORTS:
+                    out.append(Finding(
+                        "R1", mod.path, node.lineno,
+                        f"stdlib `from {node.module} import ...` in a tool — "
+                        f"use a seeded np.random.default_rng",
+                    ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R2: lane widths
+# --------------------------------------------------------------------------
+
+
+def _dtype_of_node(node, bits: dict[str, int]) -> str | None:
+    """`jnp.int32` / `np.int64` / `"int32"` -> dtype string, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr if node.attr in bits else None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in bits else None
+    return None
+
+
+def _terminal_lane(node, func_return_lanes) -> str | None:
+    """Best-effort terminal lane name of an expression: `ev.t` -> "t",
+    `ring.cursor[0] % n` -> "cursor", `q_next_time(q)` -> "t"."""
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.BinOp):
+            node = node.left
+        elif isinstance(node, ast.UnaryOp):
+            node = node.operand
+        else:
+            break
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+        return func_return_lanes.get(fname)
+    return None
+
+
+def _constructor_dtype(call: ast.Call, bits: dict[str, int]) -> tuple[bool, str | None]:
+    """(is_constructor, dtype string or None) for jnp/np array builders."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False, None
+    name = f.attr
+    if name not in CONSTRUCTORS:
+        return False, None
+    base = f.value
+    if not (isinstance(base, ast.Name) and base.id in (
+        "jnp", "np", "numpy", "jax"
+    )):
+        return False, None
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return True, _dtype_of_node(kw.value, bits)
+    idx = CONSTRUCTORS[name]
+    if len(call.args) > idx:
+        return True, _dtype_of_node(call.args[idx], bits)
+    return True, None
+
+
+def check_lane_widths(project: Project, mod: ModuleInfo) -> list[Finding]:
+    lanes = project.lanes
+    widths = lanes.LANE_WIDTHS
+    bits = lanes.BITS
+    lane_bits = lanes.lane_width_bits
+    ret_lanes = lanes.FUNC_RETURN_LANES
+    out: list[Finding] = []
+
+    def check_construction(lane: str, call: ast.Call, line: int):
+        want = widths.get(lane)
+        if want is None:
+            return
+        is_ctor, dt = _constructor_dtype(call, bits)
+        if not is_ctor:
+            return
+        if dt is None:
+            out.append(Finding(
+                "R2", mod.path, line,
+                f"lane `{lane}` constructed without an explicit dtype "
+                f"(registry requires {want}; implicit widths are "
+                f"platform-dependent) — shadow_tpu/core/lanes.py",
+            ))
+        elif bits.get(dt, 64) < bits[want] or (
+            want in ("int64", "uint64") and dt.startswith("float")
+        ):
+            out.append(Finding(
+                "R2", mod.path, line,
+                f"lane `{lane}` constructed as {dt}, registry requires "
+                f"{want} — shadow_tpu/core/lanes.py",
+            ))
+
+    for node in ast.walk(mod.tree):
+        # narrowing: <lane-expr>.astype(<narrower>)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in NARROWING_METHODS
+            and node.args
+        ):
+            dt = _dtype_of_node(node.args[0], bits)
+            if dt is None:
+                continue
+            lane = _terminal_lane(node.func.value, ret_lanes)
+            lb = lane_bits(lane) if lane else None
+            if lb and bits.get(dt, 64) < lb:
+                out.append(Finding(
+                    "R2", mod.path, node.lineno,
+                    f"`{lane}.astype({dt})` narrows a registered "
+                    f"{widths[lane]} lane — shadow_tpu/core/lanes.py is "
+                    f"the only place lane widths change",
+                ))
+        # construction via keyword: Queue(t=jnp.asarray(...), ...)
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and isinstance(kw.value, ast.Call):
+                    check_construction(kw.arg, kw.value, kw.value.lineno)
+                elif (
+                    kw.arg
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, int)
+                    and not isinstance(kw.value.value, bool)
+                    and lane_bits(kw.arg) == 64
+                ):
+                    out.append(Finding(
+                        "R2", mod.path, node.lineno,
+                        f"bare int literal for 64-bit lane `{kw.arg}` — "
+                        f"wrap with an explicit i64 (jnp.int64/np.int64) "
+                        f"so the width never floats with the platform",
+                    ))
+        # construction via assignment: t = jnp.asarray(...); a, b = c(), d()
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            pairs = []
+            if isinstance(tgt, ast.Name):
+                pairs.append((tgt.id, val))
+            elif (
+                isinstance(tgt, ast.Tuple)
+                and isinstance(val, ast.Tuple)
+                and len(tgt.elts) == len(val.elts)
+            ):
+                for t_el, v_el in zip(tgt.elts, val.elts):
+                    if isinstance(t_el, ast.Name):
+                        pairs.append((t_el.id, v_el))
+            for name, v in pairs:
+                if isinstance(v, ast.Call):
+                    check_construction(name, v, v.lineno)
+    return out
+
+
+# --------------------------------------------------------------------------
+# R4: static-arg hygiene
+# --------------------------------------------------------------------------
+
+
+def check_static_config(project: Project) -> list[Finding]:
+    """EngineConfig fields must be hashable scalars (they are jit statics:
+    an unhashable field breaks the jit cache; a mutable one makes two
+    configs compare equal while tracing differently)."""
+    out: list[Finding] = []
+    mod = project.modules.get("shadow_tpu.core.engine")
+    if mod is None:
+        return out
+    cls = None
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "EngineConfig":
+            cls = node
+            break
+    if cls is None:
+        return [Finding("R4", mod.path, 1, "EngineConfig class not found")]
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            ann = node.annotation
+            name = ann.id if isinstance(ann, ast.Name) else (
+                ann.value if isinstance(ann, ast.Constant) else None
+            )
+            if name not in HASHABLE_ANNOTATIONS:
+                out.append(Finding(
+                    "R4", mod.path, node.lineno,
+                    f"EngineConfig.{node.target.id}: static field annotated "
+                    f"`{ast.dump(ann) if name is None else name}` — statics "
+                    f"must be hashable scalars (int/bool/str/float)",
+                ))
+    return out
+
+
+def check_static_derivation(project: Project, key: str) -> list[Finding]:
+    """Inside jit-reachable code: no `.item()` and no int()/float() over a
+    registered lane — both materialize a traced value into a Python
+    scalar, which either fails tracing or (worse) bakes one concrete
+    value into the compiled program."""
+    mod_name, qual = key.split(":", 1)
+    mod = project.modules[mod_name]
+    fn = mod.functions[qual]
+    lanes = project.lanes
+    out: list[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            out.append(Finding(
+                "R4", mod.path, node.lineno,
+                f"`.item()` inside jit-reachable `{qual}` — traced values "
+                f"cannot become Python scalars",
+            ))
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("int", "float", "bool")
+            and node.args
+        ):
+            for sub in ast.walk(node.args[0]):
+                term = None
+                if isinstance(sub, ast.Attribute):
+                    term = sub.attr
+                elif isinstance(sub, ast.Name):
+                    term = sub.id
+                if term and lanes.LANE_WIDTHS.get(term) in ("int64", "uint64"):
+                    out.append(Finding(
+                        "R4", mod.path, node.lineno,
+                        f"`{node.func.id}(...{term}...)` inside "
+                        f"jit-reachable `{qual}` — deriving a static from "
+                        f"a traced lane bakes one concrete value into the "
+                        f"program",
+                    ))
+                    break
+    return out
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+
+def run_stage_a(
+    root: str | None = None,
+    entries: list[str] | None = None,
+    traced_entries: list[str] | None = None,
+    project: Project | None = None,
+) -> list[Finding]:
+    """Run the function-scope rule packs (R1, R2, R4). Schema rules (R3,
+    R5) are in tools/lint/schema.py; `python -m tools.lint` runs both."""
+    root = root or repo_root()
+    project = project or Project(root)
+    findings: list[Finding] = []
+    for path, err in project.syntax_errors:
+        findings.append(Finding("R1", path, err.lineno or 1, f"syntax error: {err.msg}"))
+    project.syntax_errors = []
+
+    reached = project.reachable(project.expand_entries(
+        entries if entries is not None else DEFAULT_ENTRIES
+    ))
+    if traced_entries is None:
+        traced_entries = entries if entries is not None else DEFAULT_TRACED_ENTRIES
+    traced = set(project.reachable(project.expand_entries(traced_entries)))
+    for key in reached:
+        findings.extend(check_purity(project, key, io_bans=key in traced))
+        if key in traced:
+            findings.extend(check_static_derivation(project, key))
+
+    for mod in project.modules.values():
+        if any(mod.path.startswith(p) for p in LANE_SCOPE_PREFIXES):
+            findings.extend(check_lane_widths(project, mod))
+
+    findings.extend(check_static_config(project))
+    findings.extend(check_tools_determinism(project))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.msg))
+
+
+if __name__ == "__main__":  # pragma: no cover - debugging aid
+    for f in run_stage_a():
+        print(f)
+    sys.exit(0)

@@ -1,0 +1,402 @@
+"""shadowlint stage A schema rules: R3 (carry/schema consistency) and
+R5 (heartbeat format compatibility). Pure AST + stdlib `re` — no JAX.
+
+R3 cross-checks five registries that must agree for exactness to be
+observable end-to-end:
+
+  Stats NamedTuple fields  (core/engine.py class Stats)
+    == _init_stats(...) construction kwargs
+    == state_specs(...) sharding-spec kwargs
+    ⊆ lane registry STATE_LANES ("stats.<field>")
+    ⊆ sim-stats export (sim.py stats_report reads) ∪ STATS_EXPORT_EXEMPT
+
+  every `stats._replace(field=...)` write in the engine names a real field
+
+  TRACE_FIELDS (obs/tracer.py) is append-only against the checked-in
+  ordering (tools/lint/trace_columns.txt): recorded trace files are
+  indexed by column position, so reordering or removing a column silently
+  corrupts every consumer of an old trace.
+
+R5 statically extracts every `key=` field emitted by the heartbeat
+formatters (sim.heartbeat_line + resource_heartbeat, and the hybrid
+driver's inline [heartbeat] f-string in cosim.py) and requires each to be
+matched by tools/parse_shadow.py's HEARTBEAT_RE — and, in reverse, every
+literal `key=` the regex knows to still have an emitter (or an entry in
+lanes.HEARTBEAT_LEGACY_KEYS). A checked-in file of literal lines, one
+per recorded format generation (tools/lint/heartbeat_generations.txt),
+must keep parsing; the runtime round-trip lives in tests/test_lint.py
+via `parse_shadow --strict`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.lint.astlint import Finding, Project, repo_root
+
+TRACE_COLUMNS_FILE = os.path.join(os.path.dirname(__file__), "trace_columns.txt")
+GENERATIONS_FILE = os.path.join(
+    os.path.dirname(__file__), "heartbeat_generations.txt"
+)
+
+_KEY_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_/]*)=")
+
+
+# --------------------------------------------------------------------------
+# AST harvest helpers
+# --------------------------------------------------------------------------
+
+
+def _find_class(tree: ast.Module, name: str) -> ast.ClassDef | None:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _namedtuple_fields(cls: ast.ClassDef) -> list[str]:
+    return [
+        n.target.id
+        for n in cls.body
+        if isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name)
+    ]
+
+
+def _call_kwargs_of(fn: ast.AST, callee: str) -> tuple[set[str], int]:
+    """Keyword names of the first `callee(...)` call inside `fn`."""
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == callee
+        ):
+            return {k.arg for k in node.keywords if k.arg}, node.lineno
+    return set(), 0
+
+
+def _literal_parts(node) -> list[str]:
+    """All literal string fragments of a str constant / f-string subtree."""
+    parts = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            parts.append(sub.value)
+    return parts
+
+
+def _harvest_keys(node) -> set[str]:
+    keys: set[str] = set()
+    for part in _literal_parts(node):
+        keys.update(_KEY_RE.findall(part))
+    return keys
+
+
+def _heartbeat_keys_of_function(fn: ast.AST) -> set[str]:
+    """Emitted `key=` tokens of a heartbeat-formatting function: harvested
+    from the f-string containing "[heartbeat]" plus any f-strings assigned
+    to names interpolated into it (the fault_f/gear_f/rep_f pattern)."""
+    hb_nodes = [
+        node
+        for node in ast.walk(fn)
+        if isinstance(node, ast.JoinedStr)
+        and any("[heartbeat]" in p for p in _literal_parts(node))
+    ]
+    if not hb_nodes:
+        return set()
+    keys: set[str] = set()
+    wanted: set[str] = set()
+    for hb in hb_nodes:
+        keys |= _harvest_keys(hb)
+        for sub in ast.walk(hb):
+            if isinstance(sub, ast.FormattedValue) and isinstance(
+                sub.value, ast.Name
+            ):
+                wanted.add(sub.value.id)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in wanted:
+                    keys |= _harvest_keys(node.value)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and node.target.id in wanted:
+                keys |= _harvest_keys(node.value)
+    return keys
+
+
+# --------------------------------------------------------------------------
+# R3: Stats / trace-ring schema consistency
+# --------------------------------------------------------------------------
+
+
+def check_stats_schema(project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    eng = project.modules.get("shadow_tpu.core.engine")
+    sim = project.modules.get("shadow_tpu.sim")
+    lanes = project.lanes
+    if eng is None:
+        return [Finding("R3", "shadow_tpu/core/engine.py", 1, "module missing")]
+
+    cls = _find_class(eng.tree, "Stats")
+    if cls is None:
+        return [Finding("R3", eng.path, 1, "Stats NamedTuple not found")]
+    fields = _namedtuple_fields(cls)
+    fset = set(fields)
+
+    def diff(got: set[str], line: int, what: str):
+        for missing in sorted(fset - got):
+            out.append(Finding(
+                "R3", eng.path, line,
+                f"Stats.{missing} missing from {what}",
+            ))
+        for extra in sorted(got - fset):
+            out.append(Finding(
+                "R3", eng.path, line,
+                f"{what} names `{extra}`, which is not a Stats field",
+            ))
+
+    init = eng.functions.get("_init_stats")
+    if init is not None:
+        got, line = _call_kwargs_of(init, "Stats")
+        diff(got, line or init.lineno, "_init_stats construction")
+    else:
+        out.append(Finding("R3", eng.path, cls.lineno, "_init_stats not found"))
+
+    specs = eng.functions.get("Engine.state_specs")
+    if specs is not None:
+        got, line = _call_kwargs_of(specs, "Stats")
+        diff(got, line or specs.lineno, "Engine.state_specs sharding spec")
+    else:
+        out.append(Finding("R3", eng.path, cls.lineno, "Engine.state_specs not found"))
+
+    # lane registry: every Stats field needs a declared width
+    for f in fields:
+        if f"stats.{f}" not in lanes.STATE_LANES:
+            out.append(Finding(
+                "R3", eng.path, cls.lineno,
+                f"Stats.{f} has no entry in shadow_tpu/core/lanes.py "
+                f"STATE_LANES (`stats.{f}`) — declare its width so the "
+                f"jaxpr audit pins it",
+            ))
+
+    # every stats._replace(...) write in the engine names a real field
+    for qual, fn in eng.functions.items():
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_replace"
+            ):
+                continue
+            base = node.func.value
+            term = base.attr if isinstance(base, ast.Attribute) else getattr(
+                base, "id", None
+            )
+            if term != "stats":
+                continue
+            for kw in node.keywords:
+                if kw.arg and kw.arg not in fset:
+                    out.append(Finding(
+                        "R3", eng.path, node.lineno,
+                        f"`stats._replace({kw.arg}=...)` in `{qual}` writes "
+                        f"a field that does not exist on Stats",
+                    ))
+
+    # sim-stats export coverage
+    if sim is not None:
+        report_fn = sim.functions.get("Simulation.stats_report")
+        if report_fn is None:
+            out.append(Finding(
+                "R3", sim.path, 1, "Simulation.stats_report not found"
+            ))
+        else:
+            read = {
+                node.attr
+                for node in ast.walk(report_fn)
+                if isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "s"
+            }
+            exempt = lanes.STATS_EXPORT_EXEMPT
+            for f in fields:
+                if f not in read and f not in exempt:
+                    out.append(Finding(
+                        "R3", sim.path, report_fn.lineno,
+                        f"Stats.{f} is neither exported by stats_report nor "
+                        f"listed (with a reason) in lanes.STATS_EXPORT_EXEMPT"
+                        f" — counters no one can see rot silently",
+                    ))
+            for f in sorted(set(exempt) - fset):
+                out.append(Finding(
+                    "R3", eng.path, cls.lineno,
+                    f"lanes.STATS_EXPORT_EXEMPT names `{f}`, not a Stats field",
+                ))
+    return out
+
+
+def check_trace_columns(
+    project: Project, columns_file: str = TRACE_COLUMNS_FILE
+) -> list[Finding]:
+    out: list[Finding] = []
+    tracer = project.modules.get("shadow_tpu.obs.tracer")
+    if tracer is None:
+        return [Finding("R3", "shadow_tpu/obs/tracer.py", 1, "module missing")]
+    fields = None
+    line = 1
+    for node in tracer.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "TRACE_FIELDS"
+            and isinstance(node.value, ast.Tuple)
+        ):
+            line = node.lineno
+            fields = [
+                el.value
+                for el in node.value.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ]
+    if fields is None:
+        return [Finding("R3", tracer.path, 1, "TRACE_FIELDS literal not found")]
+    try:
+        with open(columns_file, encoding="utf-8") as f:
+            recorded = [
+                ln.strip() for ln in f
+                if ln.strip() and not ln.lstrip().startswith("#")
+            ]
+    except OSError:
+        return [Finding(
+            "R3", tracer.path, line,
+            f"trace-column registry {os.path.basename(columns_file)} missing",
+        )]
+    if fields[: len(recorded)] != recorded:
+        out.append(Finding(
+            "R3", tracer.path, line,
+            f"TRACE_FIELDS no longer starts with the checked-in column "
+            f"ordering (tools/lint/trace_columns.txt) — trace rings are "
+            f"indexed by position, so columns are APPEND-ONLY: first "
+            f"divergence at index "
+            f"{next(i for i, (a, b) in enumerate(zip(fields, recorded)) if a != b) if any(a != b for a, b in zip(fields, recorded)) else min(len(fields), len(recorded))}",
+        ))
+    elif len(fields) > len(recorded):
+        out.append(Finding(
+            "R3", tracer.path, line,
+            f"TRACE_FIELDS grew by {len(fields) - len(recorded)} column(s) "
+            f"({', '.join(fields[len(recorded):])}) — append them to "
+            f"tools/lint/trace_columns.txt in the same commit so the "
+            f"ordering is pinned",
+        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# R5: heartbeat format compatibility
+# --------------------------------------------------------------------------
+
+
+def _load_heartbeat_re():
+    """tools/parse_shadow is stdlib-only — safe to import in stage A."""
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    return HEARTBEAT_RE
+
+
+def emitted_heartbeat_keys(project: Project) -> dict[str, tuple[str, int]]:
+    """key -> (path, line) over every heartbeat emitter in the tree."""
+    keys: dict[str, tuple[str, int]] = {}
+    for mod_name in ("shadow_tpu.sim", "shadow_tpu.cosim"):
+        mod = project.modules.get(mod_name)
+        if mod is None:
+            continue
+        for qual, fn in mod.functions.items():
+            got = _heartbeat_keys_of_function(fn)
+            if qual == "resource_heartbeat":
+                # no "[heartbeat]" literal of its own: harvest directly
+                for node in ast.walk(fn):
+                    if isinstance(node, (ast.JoinedStr, ast.Constant)):
+                        got |= _harvest_keys(node)
+            for k in got:
+                keys.setdefault(k, (mod.path, fn.lineno))
+    return keys
+
+
+def check_heartbeat_compat(
+    project: Project,
+    heartbeat_re=None,
+    generations_file: str = GENERATIONS_FILE,
+) -> list[Finding]:
+    out: list[Finding] = []
+    if heartbeat_re is None:
+        heartbeat_re = _load_heartbeat_re()
+    pattern = heartbeat_re.pattern
+    emitted = emitted_heartbeat_keys(project)
+    if not emitted:
+        return [Finding(
+            "R5", "shadow_tpu/sim.py", 1, "no heartbeat emitters found"
+        )]
+
+    # the parser's literal `key=` vocabulary (group-name syntax masked so
+    # `(?P<name>` never reads as a key) — exact-set matching, NOT substring:
+    # a new `hwm=` emitter must not pass just because `q_hwm=` exists
+    parsed_keys = set(_KEY_RE.findall(pattern.replace("(?P<", "(?P~")))
+
+    # forward: every emitted key must be a literal the parser matches
+    for key, (path, line) in sorted(emitted.items()):
+        if key not in parsed_keys:
+            out.append(Finding(
+                "R5", path, line,
+                f"heartbeat field `{key}=` is emitted but "
+                f"tools/parse_shadow.py HEARTBEAT_RE has no `{key}=` "
+                f"branch — extend the regex (keeping old generations "
+                f"parseable) in the same commit",
+            ))
+
+    # reverse: every literal key the parser knows still has an emitter
+    legacy = set(project.lanes.HEARTBEAT_LEGACY_KEYS)
+    for key in sorted(parsed_keys):
+        if key not in emitted and key not in legacy:
+            out.append(Finding(
+                "R5", "tools/parse_shadow.py", 1,
+                f"HEARTBEAT_RE matches `{key}=` but no emitter produces it "
+                f"— if the field was retired, record it in "
+                f"lanes.HEARTBEAT_LEGACY_KEYS so the parser keeps reading "
+                f"old logs deliberately",
+            ))
+
+    # recorded generations must keep matching (static half; the runtime
+    # strict-parse round-trip is tests/test_lint.py)
+    try:
+        with open(generations_file, encoding="utf-8") as f:
+            lines = [
+                ln.rstrip("\n") for ln in f
+                if ln.strip() and not ln.lstrip().startswith("#")
+            ]
+    except OSError:
+        lines = None
+    if lines is None:
+        out.append(Finding(
+            "R5", "tools/parse_shadow.py", 1,
+            f"heartbeat generations file "
+            f"{os.path.basename(generations_file)} missing",
+        ))
+    else:
+        for i, ln in enumerate(lines, 1):
+            if not heartbeat_re.search(ln):
+                out.append(Finding(
+                    "R5", "tools/lint/heartbeat_generations.txt", i,
+                    f"recorded generation no longer parses: {ln!r}",
+                ))
+    return out
+
+
+def run_schema_rules(
+    root: str | None = None, project: Project | None = None
+) -> list[Finding]:
+    root = root or repo_root()
+    project = project or Project(root)
+    findings = []
+    findings += check_stats_schema(project)
+    findings += check_trace_columns(project)
+    findings += check_heartbeat_compat(project)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.msg))

@@ -41,15 +41,44 @@ HEARTBEAT_RE = re.compile(
 )
 
 
-def parse_heartbeats(path: str) -> list[dict]:
+class HeartbeatParseError(ValueError):
+    """A `[heartbeat]` line the format regex could not match (strict mode)."""
+
+
+def parse_heartbeats(path: str, strict: bool = False) -> list[dict]:
+    """Parse `[heartbeat]` lines from a driver log.
+
+    Default mode skips unmatched lines silently (logs interleave arbitrary
+    stderr). `strict=True` raises HeartbeatParseError on any line that
+    CONTAINS the `[heartbeat]` marker but fails the format regex — the
+    mode the format-compat gates use (shadowlint R5's runtime cross-check
+    and the literal-line tests): a silently skipped heartbeat is exactly
+    how a format drift between emitter and parser would hide."""
     out = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             m = HEARTBEAT_RE.search(line)
             if m:
                 d = {k: v for k, v in m.groupdict().items() if v is not None}
                 out.append(
                     {k: float(v) if "." in v else int(v) for k, v in d.items()}
+                )
+                # every field up to `ratio=` is position-anchored, so an
+                # unknown field there fails the whole match — but a field
+                # appended AFTER the matched span would be dropped without
+                # a trace. Strict mode refuses that too.
+                if strict and re.search(
+                    r"[A-Za-z_][A-Za-z0-9_/]*=", line[m.end():]
+                ):
+                    raise HeartbeatParseError(
+                        f"{path}:{lineno}: heartbeat line carries fields "
+                        f"past the parsed span ({line[m.end():].strip()!r}) "
+                        f"— extend HEARTBEAT_RE: {line.rstrip()!r}"
+                    )
+            elif strict and "[heartbeat]" in line:
+                raise HeartbeatParseError(
+                    f"{path}:{lineno}: unparseable heartbeat line: "
+                    f"{line.rstrip()!r}"
                 )
     return out
 
@@ -118,10 +147,20 @@ def main(argv=None) -> int:
     p.add_argument("data_dir")
     p.add_argument("--log", help="driver stderr log with [heartbeat] lines")
     p.add_argument("-o", "--output", help="write JSON here (default stdout)")
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="error (rc 2) on a [heartbeat] line the format regex cannot "
+        "parse, instead of silently skipping it",
+    )
     args = p.parse_args(argv)
     result = parse_data_dir(args.data_dir)
     if args.log:
-        result["heartbeats"] = parse_heartbeats(args.log)
+        try:
+            result["heartbeats"] = parse_heartbeats(args.log, strict=args.strict)
+        except HeartbeatParseError as e:
+            print(f"parse_shadow: {e}", file=sys.stderr)
+            return 2
     text = json.dumps(result, indent=2)
     if args.output:
         open(args.output, "w").write(text + "\n")
