@@ -13,7 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "events",
 tracked since round 1 as a secondary continuity metric.
 
 Usage: python bench.py                    (full: TPU + CPU-subprocess baseline)
-       python bench.py --config N [--cpu] (one BASELINE config, 1-8)
+       python bench.py --config N [--cpu] (one BASELINE config, 1-9)
        python bench.py --self [--cpu]     (bare PHOLD ratio, prints a float)
 """
 
@@ -444,7 +444,39 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
             },
         }
         return cfg, "phold_seed_sweep_replica_rounds_per_second", stop_s
-    raise SystemExit(f"unknown --config {n} (1-8 supported)")
+    if n == 9:
+        # pressure-plane bench (PR 8): PHOLD with a DELIBERATELY
+        # undersized queue capacity (population 6 against 8 slots — the
+        # seed shapes would shed silently) under `pressure: escalate`.
+        # Measures what drop-free operation costs: the first pressured
+        # chunk aborts in-jit, replays once at a grown slab, and the
+        # proactive headroom check absorbs further growth at chunk
+        # boundaries — the BENCH row carries the regrow/replay counters
+        # (counters.pressure) plus the zero drop totals that prove the
+        # escalation did its job.
+        hosts = 256 if small else 4096
+        cfg = {
+            "general": {"stop_time": "30 s", "seed": 1},
+            "network": {"graph": {"type": "gml", "inline": PHOLD_GML}},
+            "experimental": {"event_queue_capacity": 8,
+                             "sends_per_host_round": 6,
+                             "rounds_per_chunk": 128},
+            "pressure": {"policy": "escalate", "max_capacity": 64},
+            "hosts": {
+                "node": {
+                    "count": hosts,
+                    "network_node_id": 0,
+                    "processes": [{
+                        "model": "phold",
+                        "model_args": {"population": 6,
+                                       "mean_delay": "200 ms",
+                                       "size_bytes": 64},
+                    }],
+                }
+            },
+        }
+        return cfg, "phold_pressure_sim_seconds_per_wall_second", 30
+    raise SystemExit(f"unknown --config {n} (1-9 supported)")
 
 
 def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
@@ -716,9 +748,21 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     # through the same shed-exact controller loop the Simulation driver
     # uses — the BENCH row then carries the gear histogram (chunks per
     # gear + rounds per gear from the trace ring)
-    from shadow_tpu.core.gears import GearController, run_adaptive_chunk
+    from shadow_tpu.core.gears import GearController
+    from shadow_tpu.core.pressure import PressureAbort, ResilienceController
+    from shadow_tpu.core.supervisor import SupervisorAbort
 
     gearctl = GearController(sim._gear_ladder) if sim._gear_ladder else None
+    # the shared snapshot-replay loop (core/pressure.py): gears and/or
+    # pressure escalation, exactly as the Simulation driver wires it —
+    # config 9's BENCH row measures drop-free-under-pressure end to end
+    resil = None
+    if gearctl is not None or cfg.pressure.active:
+        resil = ResilienceController(
+            gearctl=gearctl,
+            pressure=cfg.pressure if cfg.pressure.active else None,
+            queue_block=sim.engine_cfg.queue_block,
+        )
     ob_hwm_run = 0  # run-wide outbox high-water (gear runs reset the
     # device counter per chunk, so the run max is folded host-side)
     # crash-resilient supervisor (PR 5): when the config opts in, chunks
@@ -727,7 +771,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     # snapshot/retry counts in `counters.supervisor`)
     sup = None
     if cfg.faults.supervisor.enabled:
-        from shadow_tpu.core.supervisor import ChunkSupervisor, SupervisorAbort
+        from shadow_tpu.core.supervisor import ChunkSupervisor
 
         sup = ChunkSupervisor(
             snapshot_every_chunks=cfg.faults.supervisor.snapshot_every_chunks,
@@ -738,28 +782,36 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
 
     def _step_raw(state):
         nonlocal ob_hwm_run
-        if gearctl is None:
+        if resil is None:
             state = engine.run_chunk(state, params)
             jax.block_until_ready(state)
             return state
 
-        def dispatch(st, gear):
-            st = engine.run_chunk_gear(st, params, gear)
-            jax.block_until_ready(st)
-            return st
+        def dispatch(st, gear, cap, budget):
+            return engine.run_chunk_resized(st, params, gear, cap, budget)
 
-        state, _, hwm = run_adaptive_chunk(gearctl, state, dispatch)
+        state, _, hwm = resil.run_chunk(state, dispatch)
         ob_hwm_run = max(ob_hwm_run, hwm)
         return state
 
     sup_aborted = False
+    press_aborted = False
 
     def step(state):
-        nonlocal sup_aborted
-        if sup is None:
-            return _step_raw(state)
+        nonlocal sup_aborted, press_aborted
         try:
+            if sup is None:
+                return _step_raw(state)
             return sup.run_chunk(state, _step_raw)
+        except PressureAbort as e:
+            # same honest-artifacts posture as the drivers: abort policy
+            # exports the dropping state, escalate-cornered the last
+            # good pre-chunk snapshot (abort_export_state docs this)
+            print(f"[pressure] aborting bench run: {e}", file=sys.stderr)
+            press_aborted = True
+            sup_aborted = True  # stops the measurement loops
+            good = resil.abort_export_state()
+            return good if good is not None else state
         except SupervisorAbort as e:
             # same graceful-abort contract as the drivers: the BENCH row
             # carries the completed prefix's counters, exported from the
@@ -864,6 +916,28 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
             # the robustness evidence on config 7
             "faults_dropped": int(_np.asarray(s.faults_dropped).sum()),
             "faults_delayed": int(_np.asarray(s.faults_delayed).sum()),
+            # pressure-plane counters (PR 8): config 9's evidence — the
+            # regrow/replay accounting plus the drop totals escalation
+            # kept at zero (and the capacity the run ended at)
+            **(
+                {
+                    "pressure": {
+                        **resil.report(),
+                        "capacity": state.queue.t.shape[1],
+                        "outbox": state.outbox.t.shape[1],
+                    },
+                    "pressure_regrows": (
+                        resil.regrows + resil.proactive_regrows
+                    ),
+                    "pressure_replays": resil.replays,
+                    "queue_overflow_dropped": int(
+                        _np.asarray(
+                            jax.device_get(state.queue.dropped)
+                        ).sum()
+                    ),
+                }
+                if resil is not None and cfg.pressure.active else {}
+            ),
             **(
                 {"supervisor": sup.report()} if sup is not None else {}
             ),
@@ -880,6 +954,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
         **({"aborted": True} if sup_aborted else {}),
+        **({"pressure_aborted": True} if press_aborted else {}),
     }
 
 

@@ -430,6 +430,82 @@ class ObservabilityOptions:
 
 
 @dataclass
+class PressureOptions:
+    """The pressure plane (core/pressure.py + docs/architecture.md
+    "Pressure plane"): what happens when a fixed-shape lane would drop
+    for capacity — queue-push overflow, merge/alltoall sheds, outbox
+    overflow, per-host send-budget drops.
+
+      drop      — today's semantics (default): drops are counted
+                  (queue_overflow_dropped & friends) and the run goes
+                  on. The engine program is bit-identical to before the
+                  pressure plane existed.
+      escalate  — drop-free by construction: the chunk aborts in-jit at
+                  the first dropping round (mesh-uniform, psum'd), the
+                  driver restores the pre-chunk snapshot, regrows the
+                  queue capacity and/or outbox width one geometric rung
+                  (growth_factor), and replays — accepted chunks carry
+                  zero drops and are bit-identical to a run launched at
+                  the final shape. Bounded by max_capacity/max_outbox
+                  (the HBM guard); regrow is also proactive at chunk
+                  boundaries once occupancy crosses `headroom`.
+      abort     — loud failure: stop at the first dropping round with
+                  honest artifacts instead of shedding silently.
+    """
+
+    policy: str = "drop"  # drop | escalate | abort
+    # escalation ceilings (the HBM guard): 0 = auto (8x the initial
+    # queue capacity / 4x the initial send budget)
+    max_capacity: int = 0  # queue slots per host
+    max_outbox: int = 0  # sends per host per round
+    growth_factor: int = 2  # geometric rung ratio (>= 2 keeps the
+    # bucketed queue's block divisibility: C * 2^k stays divisible by B)
+    # proactive-regrow threshold: grow at a chunk boundary once the
+    # occupancy high-water reaches ceil(headroom * capacity) (and the
+    # outbox once a chunk's send high-water FILLS the budget). 0
+    # disables proactive regrow (escalation stays purely reactive).
+    headroom: float = 0.85
+
+    @property
+    def active(self) -> bool:
+        return self.policy != "drop"
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "PressureOptions":
+        d = dict(d or {})
+        p = PressureOptions(
+            policy=str(d.pop("policy", "drop")),
+            max_capacity=int(d.pop("max_capacity", 0)),
+            max_outbox=int(d.pop("max_outbox", 0)),
+            growth_factor=int(d.pop("growth_factor", 2)),
+            headroom=float(d.pop("headroom", 0.85)),
+        )
+        if p.policy not in ("drop", "escalate", "abort"):
+            raise ConfigError(
+                f"pressure.policy must be drop|escalate|abort, "
+                f"got {p.policy!r}"
+            )
+        if p.max_capacity < 0 or p.max_outbox < 0:
+            raise ConfigError(
+                f"pressure ceilings must be >= 0 (0 = auto), got "
+                f"max_capacity={p.max_capacity} max_outbox={p.max_outbox}"
+            )
+        if p.growth_factor < 2:
+            raise ConfigError(
+                f"pressure.growth_factor must be >= 2, "
+                f"got {p.growth_factor}"
+            )
+        if not 0.0 <= p.headroom <= 1.0:
+            raise ConfigError(
+                f"pressure.headroom must be in [0, 1] (0 disables "
+                f"proactive regrow), got {p.headroom}"
+            )
+        if d:
+            raise ConfigError(f"unknown pressure options: {sorted(d)}")
+        return p
+
+
+@dataclass
 class FaultChurnOptions:
     """Seeded host-churn: each host crashes once with probability `prob`
     at a uniform time in [bootstrap_end_time, stop_time), down for an
@@ -921,6 +997,7 @@ class ConfigOptions:
         default_factory=ObservabilityOptions
     )
     faults: FaultOptions = field(default_factory=FaultOptions)
+    pressure: PressureOptions = field(default_factory=PressureOptions)
     campaign: CampaignOptions = field(default_factory=CampaignOptions)
     host_option_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: list[HostOptions] = field(default_factory=list)
@@ -951,6 +1028,7 @@ class ConfigOptions:
                 d.pop("observability", None)
             ),
             faults=FaultOptions.from_dict(d.pop("faults", None)),
+            pressure=PressureOptions.from_dict(d.pop("pressure", None)),
             campaign=CampaignOptions.from_dict(d.pop("campaign", None)),
             host_option_defaults=defaults,
             hosts=hosts,

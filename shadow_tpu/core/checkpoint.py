@@ -135,12 +135,168 @@ def _fingerprint(engine_cfg, treedef, params) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
+# shape-defining EngineConfig fields normalized out of the migration
+# fingerprint: a checkpoint differing from the target sim ONLY in these
+# (plus the derived auto-sizes) can be re-seated through the exactness-
+# gated migration ops instead of refused. Everything else — model,
+# params, policies, queue LAYOUT KIND (bucket-ness changes the treedef),
+# mesh/world — still refuses loudly.
+_MIGRATABLE_CFG_FIELDS = (
+    "queue_capacity",
+    "queue_block",
+    "sends_per_host_round",
+    "max_round_inserts",
+    "microstep_limit",
+    "a2a_block",
+)
+
+
+def _migration_fingerprint(engine_cfg, treedef, params) -> str:
+    """`_fingerprint` with the capacity-shape fields normalized to 0 —
+    the secondary guard the cross-capacity restore path compares."""
+    cfgd = dataclasses.asdict(engine_cfg)
+    for f in _MIGRATABLE_CFG_FIELDS:
+        cfgd[f] = 0
+    blob = json.dumps(
+        {
+            "cfg": cfgd,
+            "treedef": str(treedef),
+            "params": _params_digest(params),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _state_shape_meta(state) -> dict:
+    """The state's actual capacity shapes — recorded at save time so a
+    checkpoint written MID-ESCALATION (pressure plane regrew the slab
+    past the configured base) still restores: leaf shapes are validated
+    against these, not the builder's config."""
+    from shadow_tpu.ops.events import BucketQueue
+
+    q = state.queue
+    return {
+        "queue_capacity": int(q.t.shape[-1]),
+        "queue_block": int(q.block) if isinstance(q, BucketQueue) else 0,
+        "sends_per_host_round": int(state.outbox.t.shape[-1]),
+    }
+
+
+def _shaped_template(state, meta: dict):
+    """`state`'s pytree with the queue/outbox planes re-shaped to the
+    checkpoint's recorded capacity — the shape/dtype reference
+    `_restore_leaves` validates stored leaves against."""
+    from shadow_tpu.core.engine import make_empty_outbox
+    from shadow_tpu.ops.events import (
+        BucketQueue, make_bucket_queue, make_queue,
+    )
+
+    if (meta["queue_block"] > 0) != isinstance(state.queue, BucketQueue):
+        raise CheckpointError(
+            "checkpoint queue layout (flat vs bucketed) does not match "
+            "this simulation; migration cannot cross layout kinds"
+        )
+    h = state.queue.t.shape[0]
+    queue = (
+        make_bucket_queue(h, meta["queue_capacity"], meta["queue_block"])
+        if meta["queue_block"]
+        else make_queue(h, meta["queue_capacity"])
+    )
+    outbox = make_empty_outbox(
+        h, meta["sends_per_host_round"], state.outbox.count
+    )
+    return state._replace(queue=queue, outbox=outbox)
+
+
+def _migrate_restored(state, sim):
+    """Re-seat a source-shaped restored state at the target sim's shapes
+    through the pressure plane's migration ops. Refuses (loudly) exactly
+    when migration would lose information: live events that cannot fit
+    the target capacity, or in-flight outbox entries (chunk-boundary
+    checkpoints never carry any; anything else cannot re-seat)."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.engine import make_empty_outbox
+    from shadow_tpu.ops.events import migrate_queue, migration_fits
+    from shadow_tpu.simtime import TIME_MAX
+
+    cfg = sim.engine_cfg
+    # under an escalate pressure policy a checkpoint written at a GROWN
+    # shape resumes at that shape (shrinking just to re-escalate would
+    # cost a refusal risk and replays for nothing); every other policy
+    # gets exactly the configured shapes
+    escalating = (
+        getattr(getattr(sim.cfg, "pressure", None), "policy", "drop")
+        == "escalate"
+    )
+    cap = int(state.queue.t.shape[-1])
+    budget = int(state.outbox.t.shape[-1])
+    target_cap = max(cfg.queue_capacity, cap) if escalating else (
+        cfg.queue_capacity
+    )
+    target_budget = (
+        max(cfg.sends_per_host_round, budget) if escalating
+        else cfg.sends_per_host_round
+    )
+    if cap != target_cap or (
+        getattr(state.queue, "block", 0) or 0
+    ) != cfg.queue_block:
+        if cap > target_cap and not bool(
+            jnp.all(migration_fits(state.queue, target_cap))
+        ):
+            occ = int(jnp.max(jnp.sum(
+                (state.queue.t != TIME_MAX).astype(jnp.int32), axis=-1
+            )))
+            raise CheckpointError(
+                f"cannot resume at queue capacity {target_cap}: "
+                f"the checkpoint holds up to {occ} live events per host "
+                f"(written at capacity {cap}) — resume at >= {occ} slots"
+            )
+        state = state._replace(
+            queue=migrate_queue(state.queue, target_cap, cfg.queue_block)
+        )
+    if budget != target_budget:
+        if bool(jnp.any(state.outbox.t != TIME_MAX)):
+            raise CheckpointError(
+                "checkpoint carries in-flight outbox entries; a different "
+                "send budget cannot re-seat them (this never happens for "
+                "chunk-boundary checkpoints)"
+            )
+        state = state._replace(
+            outbox=make_empty_outbox(
+                state.outbox.t.shape[0], target_budget, state.outbox.count
+            )
+        )
+    if sim.engine.mesh is not None:
+        specs = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(sim.engine.mesh, s),
+            sim.engine.state_specs(),
+        )
+        state = jax.device_put(state, specs)
+    return state
+
+
 def save_checkpoint(path: str, sim) -> str:
     """Snapshot a `Simulation` (modeled sims; hybrid/mixed sims go through
     `save_checkpoint_hybrid`)."""
     arrays, treedef = _dump_leaves(sim.state)
     arrays["__guard__"] = np.frombuffer(
         _fingerprint(sim.engine_cfg, treedef, sim.params).encode(),
+        dtype=np.uint8,
+    )
+    # cross-capacity restore metadata (pressure plane): the secondary
+    # guard matches across capacity-shape config changes, and __shape__
+    # records the state's ACTUAL shapes (escalation may have regrown
+    # them past the configured base) so the loader can rebuild the
+    # source template and migrate. Older checkpoints lack both and keep
+    # loading through the exact path unchanged.
+    arrays["__guard_migrate__"] = np.frombuffer(
+        _migration_fingerprint(sim.engine_cfg, treedef, sim.params).encode(),
+        dtype=np.uint8,
+    )
+    arrays["__shape__"] = np.frombuffer(
+        json.dumps(_state_shape_meta(sim.state), sort_keys=True).encode(),
         dtype=np.uint8,
     )
     if not path.endswith(".npz"):
@@ -150,17 +306,44 @@ def save_checkpoint(path: str, sim) -> str:
 
 
 def load_checkpoint(path: str, sim) -> None:
-    """Restore state into a freshly built `Simulation` of the same config."""
+    """Restore state into a freshly built `Simulation`. The config must
+    match exactly EXCEPT the capacity shapes (queue capacity/block, send
+    budget — `_MIGRATABLE_CFG_FIELDS`): a checkpoint written at capacity
+    C resumes into a sim built at C' through the pressure plane's
+    exactness-gated migration ops, refusing only when migration is
+    impossible (live events past C', in-flight outbox entries, or a
+    queue-layout-kind change)."""
     data = np.load(path)
     _, treedef = jax.tree_util.tree_flatten(sim.state)
     want = _fingerprint(sim.engine_cfg, treedef, sim.params)
     got = bytes(data["__guard__"]).decode()
-    if got != want:
+    meta = None
+    if "__shape__" in data.files:
+        meta = json.loads(bytes(data["__shape__"]).decode())
+    if got == want and (
+        meta is None or meta == _state_shape_meta(sim.state)
+    ):
+        sim.state = _restore_leaves(data, sim.state, sim.engine)
+        return
+    # exact guard failed (config differs) or shapes differ (escalated
+    # checkpoint): try the migration path
+    if meta is None or "__guard_migrate__" not in data.files:
         raise CheckpointError(
             "checkpoint does not match this simulation (different config, "
-            "model, or engine version)"
+            "model, or engine version; pre-migration checkpoints carry no "
+            "shape record to migrate from)"
         )
-    sim.state = _restore_leaves(data, sim.state, sim.engine)
+    want_m = _migration_fingerprint(sim.engine_cfg, treedef, sim.params)
+    got_m = bytes(data["__guard_migrate__"]).decode()
+    if got_m != want_m:
+        raise CheckpointError(
+            "checkpoint does not match this simulation (different config, "
+            "model, or engine version — beyond the migratable capacity "
+            "shapes)"
+        )
+    template = _shaped_template(sim.state, meta)
+    restored = _restore_leaves(data, template, engine=None)
+    sim.state = _migrate_restored(restored, sim)
 
 
 # ---------------------------------------------------------------- ensemble

@@ -101,6 +101,7 @@ from shadow_tpu.obs.tracer import (
     make_trace_ring,
 )
 from shadow_tpu.obs.tracer import (
+    COL_CAP,
     COL_FAULTS_DELAYED,
     COL_FAULTS_DROPPED,
     COL_HOSTS_DOWN,
@@ -195,6 +196,15 @@ class Stats(NamedTuple):
     gear_shed: Array  # i64[world]
     digest: Array  # u64[H] rolling per-host event-order digest
     rounds: Array  # i64[] scheduling rounds completed (replicated)
+    # pressure-abort signal (core/pressure.py; None unless the pressure
+    # policy is escalate/abort — the default `drop` policy traces no
+    # pressure code and keeps the program bit-identical to before the
+    # pressure plane existed). Cumulative GLOBAL count of capacity drops
+    # (queue-push overflow, merge/a2a/outbox sheds, send-budget drops),
+    # psum'd across the mesh inside the round like gear_shed, so the
+    # chunk loop's first-drop abort condition is uniform on every shard.
+    # Structurally zero in any state an escalate run accepts.
+    pressure: Any = None  # i64[world] | None
 
 
 class SimState(NamedTuple):
@@ -375,6 +385,14 @@ class EngineConfig:
     # "clear" (events whose execution time falls in a down window are
     # dropped and counted in stats.faults_dropped)
     fault_queue_clear: bool = False
+    # Pressure plane (core/pressure.py; config `pressure:`): when True
+    # (policies escalate/abort) the round body maintains the psum'd
+    # `stats.pressure` drop total and the chunk while_loop aborts at the
+    # first round where ANY host dropped for capacity — the exact
+    # detector the escalation/abort drivers replay or stop on. False
+    # (policy drop, the default) traces no pressure code at all: the
+    # program is bit-identical to the pre-pressure engine.
+    pressure_abort: bool = False
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -537,18 +555,35 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         gear_shed=jnp.zeros((cfg.world,), jnp.int64),
         digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
         rounds=jnp.zeros((), jnp.int64),
+        pressure=(
+            jnp.zeros((cfg.world,), jnp.int64) if cfg.pressure_abort
+            else None
+        ),
     )
 
 
-def _init_outbox(cfg: EngineConfig) -> Outbox:
-    h, b = cfg.num_hosts, cfg.sends_per_host_round
+def make_empty_outbox(num_hosts: int, send_budget: int, count) -> Outbox:
+    """A fresh (empty) [H, B] staging outbox. The single source of the
+    empty layout — the engine build, the pressure plane's outbox
+    migration, and the checkpoint restore paths all construct through
+    here so a new Outbox field or sentinel change cannot silently
+    diverge between them. `count` provides the per-shard count word's
+    shape/sharding (zeroed)."""
+    h, b = num_hosts, send_budget
     return Outbox(
         dst=jnp.zeros((h, b), jnp.int32),
         t=jnp.full((h, b), TIME_MAX, jnp.int64),
         order=jnp.zeros((h, b), jnp.int64),
         kind=jnp.zeros((h, b), jnp.int32),
         payload=jnp.zeros((h, b, EVENT_PAYLOAD_WORDS), jnp.int32),
-        count=jnp.zeros((cfg.world,), jnp.int32),
+        count=jnp.zeros_like(count),
+    )
+
+
+def _init_outbox(cfg: EngineConfig) -> Outbox:
+    return make_empty_outbox(
+        cfg.num_hosts, cfg.sends_per_host_round,
+        jnp.zeros((cfg.world,), jnp.int32),
     )
 
 
@@ -694,6 +729,10 @@ class Engine:
         self.mesh = mesh
         self.run_chunk = None  # built by init_state (needs model pytree shapes)
         self._gear_chunks: dict[int, Any] = {}  # gear_cols -> jitted chunk
+        # (gear_cols, queue_capacity, send_budget) -> jitted chunk: the
+        # pressure plane's escalated programs (core/pressure.py). Bounded
+        # by the escalation ladders (a handful of rungs per axis).
+        self._resized_chunks: dict[tuple, Any] = {}
 
     def _jit_chunk(self, cfg: EngineConfig):
         """Build one jitted chunk program for `cfg` — shared by the
@@ -732,6 +771,62 @@ class Engine:
             )
             self._gear_chunks[gear_cols] = fn
         return fn(state, params)
+
+    def run_chunk_resized(
+        self, state: SimState, params: EngineParams, gear_cols: int,
+        queue_capacity: int, send_budget: int,
+    ):
+        """Run one chunk at an escalated shape: `queue_capacity` slots per
+        host and a `send_budget`-wide outbox (the pressure plane's
+        regrown programs, core/pressure.py), at merge gear `gear_cols`
+        (0 = full width). Base shapes route to the gear/full-width cache.
+
+        The resized config pins the knobs that would otherwise drift
+        with capacity, so the escalated trajectory stays bit-identical
+        to a run LAUNCHED at the final shape with the same pins:
+          - `microstep_limit` is fixed at the BASE config's effective
+            valve (the valve is a livelock bound, not a scheduler, but
+            letting it scale with capacity could cut a pathological
+            round at a different microstep across rungs);
+          - `max_round_inserts` scales with capacity only when the base
+            left it auto-sized (== base capacity), matching what the
+            driver would derive at the bigger shape.
+        Callable only after `init_state` (like `run_chunk`). A
+        `queue_capacity`/`send_budget` of 0 means the base shape (the
+        gears-only controller passes 0s — it never reads the state's
+        shapes), exactly like `gear_cols` 0 means full width."""
+        base = self.cfg
+        if queue_capacity in (0, base.queue_capacity) and send_budget in (
+            0, base.sends_per_host_round
+        ):
+            return self.run_chunk_gear(state, params, gear_cols)
+        key = (int(gear_cols), int(queue_capacity), int(send_budget))
+        fn = self._resized_chunks.get(key)
+        if fn is None:
+            fn = self._jit_chunk(self.resized_cfg(
+                gear_cols, queue_capacity, send_budget
+            ))
+            self._resized_chunks[key] = fn
+        return fn(state, params)
+
+    def resized_cfg(
+        self, gear_cols: int, queue_capacity: int, send_budget: int
+    ) -> EngineConfig:
+        """The escalated EngineConfig `run_chunk_resized` compiles (shared
+        so tests can assert the pinning rules)."""
+        base = self.cfg
+        return dataclasses.replace(
+            base,
+            queue_capacity=queue_capacity,
+            sends_per_host_round=send_budget,
+            gear_cols=gear_cols if 0 < gear_cols < send_budget else 0,
+            microstep_limit=base.effective_microstep_limit,
+            max_round_inserts=(
+                queue_capacity
+                if base.max_round_inserts == base.queue_capacity
+                else base.max_round_inserts
+            ),
+        )
 
     def build_capture_step(self):
         """Jitted single round returning (state, sent-outbox) for pcap
@@ -812,6 +907,7 @@ class Engine:
                 gear_shed=sh,
                 digest=sh,
                 rounds=rep,
+                pressure=sh if self.cfg.pressure_abort else None,
             ),
             trace=(
                 TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
@@ -962,14 +1058,20 @@ def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EnginePa
     # of this chunk is wasted work (the driver will discard the result and
     # replay from its snapshot one gear up), so the loop stops at the first
     # shed. gear_shed carries the psum'd GLOBAL count, so the condition is
-    # uniform across shards and the mesh exits together.
+    # uniform across shards and the mesh exits together. The pressure
+    # plane's first-drop abort (cfg.pressure_abort) is the same mechanism
+    # on the psum'd capacity-drop total: the driver either regrows and
+    # replays (escalate) or stops with honest artifacts (abort).
     shed0 = state.stats.gear_shed[0] if cfg.gear_active else None
+    press0 = state.stats.pressure[0] if cfg.pressure_abort else None
 
     def cond(carry):
         st, i = carry
         ok = (~st.done) & (i < cfg.rounds_per_chunk)
         if shed0 is not None:
             ok = ok & (st.stats.gear_shed[0] <= shed0)
+        if press0 is not None:
+            ok = ok & (st.stats.pressure[0] <= press0)
         return ok
 
     def body(carry):
@@ -995,8 +1097,10 @@ def _run_guarded_chunk(
 
     Runs at whatever merge gear `cfg.gear_cols` selects, with the same
     first-shed abort as `_run_chunk` (the hybrid driver snapshots before
-    the dispatch and replays one gear up on a shed)."""
+    the dispatch and replays one gear up on a shed), and the same
+    first-drop pressure abort when `cfg.pressure_abort` is set."""
     shed0 = st.stats.gear_shed[0] if cfg.gear_active else None
+    press0 = st.stats.pressure[0] if cfg.pressure_abort else None
 
     def cond(carry):
         stc, i = carry
@@ -1017,6 +1121,8 @@ def _run_guarded_chunk(
         )
         if shed0 is not None:
             ok = ok & (stc.stats.gear_shed[0] <= shed0)
+        if press0 is not None:
+            ok = ok & (stc.stats.pressure[0] <= press0)
         return ok
 
     def body(carry):
@@ -1148,6 +1254,21 @@ def _window_step(
         q_occ_hwm=jnp.maximum(st_x.stats.q_occ_hwm, occ),
         outbox_hwm=jnp.maximum(st_x.stats.outbox_hwm, ob_hwm[None]),
     )
+    if cfg.pressure_abort:
+        # pressure signal: the shard-local capacity-drop total (queue-push
+        # overflow + merge/merge_rows sheds in queue.dropped, alltoall
+        # block sheds, outbox overflow, per-host send-budget drops),
+        # psum'd so every shard carries the GLOBAL cumulative count and
+        # the chunk loop's first-drop abort stays mesh-uniform. Two [H]
+        # sums + one psum per round — noise next to the occ pass above.
+        local = (
+            jnp.sum(st_x.queue.dropped)
+            + jnp.sum(stats.pkts_budget_dropped)
+            + stats.a2a_shed[0]
+            + stats.ob_dropped[0]
+        )
+        total = lax.psum(local, axis) if axis else local
+        stats = stats._replace(pressure=total[None])
     min_used = _pmin(st_x.min_used_lat, axis)
     out = st_x._replace(
         now=jnp.where(done, st.now, window_end),
@@ -1203,6 +1324,7 @@ def _trace_round(
     vals[COL_NEXT_TIME] = jnp.min(q_next_time(st_x.queue))
     vals[COL_OB_HWM] = ob_hwm
     vals[COL_GEAR] = jnp.asarray(cfg.effective_gear_cols, jnp.int64)
+    vals[COL_CAP] = jnp.asarray(cfg.queue_capacity, jnp.int64)
     if cfg.faults_active:
         vals[COL_FAULTS_DROPPED] = jnp.sum(
             st_x.stats.faults_dropped - st0.stats.faults_dropped

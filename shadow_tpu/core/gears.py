@@ -151,11 +151,15 @@ class GearController:
 
 def run_adaptive_chunk(ctl: GearController, state, dispatch, rounds0=None):
     """One ACCEPTED chunk at the controller's gear, with shed-exact replay
-    — the loop every driver (sim.py, cosim.py, bench.py) shares.
+    — the gears-only face of the shared snapshot-replay loop, which now
+    lives in `core.pressure.ResilienceController` (the pressure plane
+    generalized this loop to arbitrate capacity regrows from the same
+    seam; with no pressure policy the controller reduces exactly to the
+    gear behavior shipped here in PR 4).
 
     `dispatch(state, gear)` runs one chunk program at that gear and
-    returns the new state (donation-safe: the pre-chunk snapshot below is
-    an independent device copy, so the dispatch may consume its input).
+    returns the new state (donation-safe: the pre-chunk snapshot is an
+    independent device copy, so the dispatch may consume its input).
     On a shed the chunk's entire result — queue, digests, counters, trace
     ring — is discarded by restoring the snapshot, and the SAME chunk
     re-runs one gear up; the top gear is the full send budget and cannot
@@ -173,33 +177,9 @@ def run_adaptive_chunk(ctl: GearController, state, dispatch, rounds0=None):
     `stats.outbox_hwm` is folded into the controller and RESET (a running
     max could never signal a downshift); callers wanting the run-wide
     high-water track the returned value."""
-    import jax
-    import numpy as np
+    from shadow_tpu.core.pressure import ResilienceController
 
-    from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
-
-    gear = ctl.gear
-    snap = snapshot_state(state) if gear < ctl.top else None
-    while True:
-        shed0 = int(np.asarray(jax.device_get(state.stats.gear_shed)).max())
-        state = dispatch(state, gear)
-        shed = (
-            int(np.asarray(jax.device_get(state.stats.gear_shed)).max())
-            - shed0
-        )
-        if shed <= 0:
-            break
-        # the discarded attempt's high-water names the burst that shed it:
-        # jump the replay straight to a gear that fits (read BEFORE the
-        # restore throws the aborted state away)
-        seen = int(np.asarray(jax.device_get(state.stats.outbox_hwm)).max())
-        gear = ctl.note_shed(seen)
-        state = restore_snapshot(snap)
-    hwm = int(np.asarray(jax.device_get(state.stats.outbox_hwm)).max())
-    advanced = rounds0 is None or int(state.stats.rounds) > rounds0
-    if advanced:
-        ctl.note_chunk(gear, hwm)
-    state = state._replace(
-        stats=state.stats._replace(outbox_hwm=state.stats.outbox_hwm * 0)
+    rc = ResilienceController(gearctl=ctl)
+    return rc.run_chunk(
+        state, lambda s, g, _cap, _budget: dispatch(s, g), rounds0=rounds0
     )
-    return state, gear, hwm

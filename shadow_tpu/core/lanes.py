@@ -161,6 +161,10 @@ STATE_LANES: dict[str, str] = {
     "trace.rows": "int64",
     "trace.cursor": "int64",
     **{f"stats.{f}": "int64" for f in _STATS_I64},
+    # pressure-abort signal (present only when the pressure policy is
+    # escalate/abort — core/pressure.py; the default drop policy carries
+    # None here and traces no pressure code)
+    "stats.pressure": "int64",
     "stats.digest": "uint64",
 }
 
@@ -176,6 +180,15 @@ STATS_EXPORT_EXEMPT: dict[str, str] = {
         "discarded and replayed from its pre-chunk snapshot, so the "
         "counter is structurally zero in any accepted final state; the "
         "gears{} block in sim-stats carries the replay accounting"
+    ),
+    "pressure": (
+        "transient pressure-abort control signal (core/pressure.py): a "
+        "dropping chunk is discarded and replayed at a grown shape "
+        "(escalate) or the run stops (abort), so the counter is "
+        "structurally zero in any escalate-accepted final state and "
+        "redundant with the per-category drop counters otherwise; the "
+        "pressure{} block in sim-stats carries the regrow/replay "
+        "accounting"
     ),
 }
 

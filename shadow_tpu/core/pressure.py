@@ -1,0 +1,566 @@
+"""Pressure plane: drop-free operation under capacity pressure.
+
+Every fixed-shape lane in the engine sheds under pressure — per-host
+event queues count push overflow into `queue.dropped` (ops/events.py),
+the exchange merge and the alltoall blocks shed into `queue.dropped` /
+`stats.a2a_shed`, and the per-host send budget drops into
+`stats.pkts_budget_dropped`. At the host counts ROADMAP item 1 targets,
+silent capacity pressure becomes the dominant failure mode, while the
+reference Shadow never drops an event. This module makes pressure a
+POLICY instead of a fate (`pressure:` config block, options.py):
+
+  drop      — today's semantics (default). No pressure code is traced;
+              the program is bit-identical to the pre-pressure engine.
+  escalate  — drop-free by construction. The chunk while_loop aborts
+              uniformly across the mesh at the first round where any
+              host would drop (the psum'd `stats.pressure` total, same
+              mechanism as `stats.gear_shed`); the driver restores the
+              pre-chunk device snapshot, migrates the state to a grown
+              shape — queue capacity C -> C' via the exactness-gated
+              `ops.events.migrate_queue`, and/or a wider outbox B' —
+              and replays the chunk. Accepted chunks carry ZERO drops,
+              so the accepted trajectory is bit-identical to a run
+              launched at the final shape (with the valve pins
+              `Engine.run_chunk_resized` documents).
+  abort     — loud failure. The same first-drop abort stops the run at
+              the dropping round; the driver exports honest artifacts
+              (the state INCLUDING the drop, flagged `pressure.aborted`)
+              instead of silently shedding for the rest of the horizon.
+
+`ResilienceController` below generalizes `core/gears.run_adaptive_chunk`
+into ONE snapshot-replay loop arbitrating both axes: merge-gear shifts
+(a too-narrow gear is a transient perf choice — replay one gear up) and
+capacity regrows (a too-small shape is a correctness hazard — replay at
+a grown shape). One cached jitted program exists per (gear, capacity,
+budget) triple (`Engine.run_chunk_resized`), the ladders are bounded
+(`max_capacity` is the HBM guard), and regrow is also PROACTIVE: at
+chunk boundaries the always-on `stats.q_occ_hwm` / `stats.outbox_hwm`
+high-waters trigger a grow BEFORE anything drops, so steady pressure
+costs one migration, not a replayed chunk.
+
+Graceful degradation when escalation itself fails: a grown program's
+compile/dispatch dying of RESOURCE_EXHAUSTED / XlaRuntimeError marks
+that rung (and everything above it) unusable and falls back one rung;
+when cornered — drops persist but no usable rung remains — the
+controller raises `PressureAbort` with the last good pre-chunk snapshot
+kept, so the drivers still export sim-stats/trace artifacts for the
+completed prefix (the PR 5 supervisor's graceful-abort posture).
+
+Determinism note (shadowlint control-plane rules apply): decisions here
+read CONCRETE device counters between dispatches and feed deterministic
+replay — no wall-clock, no RNG. A controller bug can cost replays or
+migrations, never correctness: accepted chunks are gated by the in-jit
+zero-drop condition, not by anything this module computes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+DEFAULT_MAX_CAPACITY_FACTOR = 8  # auto max_capacity = 8x the base slab
+DEFAULT_MAX_OUTBOX_FACTOR = 4  # auto max_outbox = 4x the base budget
+
+
+class PressureAbort(RuntimeError):
+    """The pressure policy stopped the run: `abort` saw its first drop,
+    or `escalate` was cornered (drops persist with no usable rung left).
+    The driver still owns a state to export honest artifacts from —
+    `ResilienceController.abort_export_state` documents which one."""
+
+
+def _is_oom(e: BaseException) -> bool:
+    """The grown-program failure signature: XLA's allocation failures
+    carry RESOURCE_EXHAUSTED (jaxlib raises the status name in the
+    message) or an out-of-memory text. Deliberately MESSAGE-based, not
+    type-based: every XlaRuntimeError flavor shares one Python type, and
+    treating a non-memory failure (INVALID_ARGUMENT, internal errors) as
+    an OOM would launder a real bug into rung-poisoning fallbacks — such
+    failures must propagate to the supervisor/driver instead."""
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def resolve_ladder(base: int, ceiling: int, growth: int) -> list[int]:
+    """Geometric shape ladder [base, base*g, ...] bounded by `ceiling`
+    (inclusive). The base rung is always present; a ceiling below the
+    base is a config error the options parser rejects upstream."""
+    base, ceiling, growth = int(base), int(ceiling), int(growth)
+    ladder = [base]
+    while ladder[-1] * growth <= ceiling:
+        ladder.append(ladder[-1] * growth)
+    return ladder
+
+
+class ResilienceController:
+    """The drivers' shared chunk loop: gear shifts + capacity regrows
+    from one snapshot-replay seam.
+
+    Construction:
+      gearctl   — a `core.gears.GearController` (or None: full width
+                  always). Gear decisions and accounting stay in the
+                  gear controller; this class only arbitrates WHEN a
+                  replay is a gear problem vs a capacity problem.
+      pressure  — a `config.options.PressureOptions` with an active
+                  policy (escalate/abort), or None (gears only — the
+                  exact `run_adaptive_chunk` behavior PR 4 shipped).
+      reshard   — optional callable(state) -> state applied after a
+                  migration (the mesh drivers pass a device_put onto
+                  their NamedSharding specs; eager-op outputs keep
+                  axis-0 sharding in simple cases but the specs are the
+                  contract).
+
+    `run_chunk(state, dispatch, rounds0=None)` mirrors
+    `run_adaptive_chunk`: dispatch(state, gear, capacity, budget) runs
+    one chunk program at that shape and may consume its input (the
+    pre-chunk snapshot is an independent device copy). Returns
+    (state, accepted_gear, chunk_outbox_hwm)."""
+
+    def __init__(
+        self,
+        *,
+        gearctl=None,
+        pressure=None,
+        queue_block: int = 0,
+        reshard=None,
+        log=None,
+    ):
+        self.gearctl = gearctl
+        self.pressure = pressure
+        self.queue_block = int(queue_block)
+        self._reshard = reshard
+        self._log = log
+        self.policy = pressure.policy if pressure is not None else "drop"
+        self.escalate = self.policy == "escalate"
+        self.abort_on_drop = self.policy == "abort"
+        # ladders resolve lazily from the FIRST state seen (the base
+        # shape lives in the state, and under a supervisor rewind the
+        # state is the only truth — see run_chunk's shape derivation)
+        self._cap_ladder: list[int] | None = None
+        self._box_ladder: list[int] | None = None
+        self._cap_poisoned: set[int] = set()  # rungs that OOM'd
+        self._box_poisoned: set[int] = set()
+        # accounting for sim-stats / BENCH
+        self.regrows = 0  # reactive shape migrations (drop -> replay)
+        self.proactive_regrows = 0  # headroom-driven boundary migrations
+        self.replays = 0  # chunks replayed after a pressure abort
+        self.oom_fallbacks = 0  # grown programs that OOM'd and fell back
+        self.aborted = False
+        self.last_error: str | None = None
+        self.ob_hwm_run = 0  # run-wide outbox high-water (per-chunk resets)
+        self._abort_state = None  # abort policy: the dropping state
+        self._last_snap = None  # escalate: last good pre-chunk snapshot
+
+    # ---- host-side counter reads ------------------------------------------
+
+    @staticmethod
+    def _pressure_total(state) -> int:
+        """Cumulative global capacity-drop total, read host-side. Uses
+        the psum'd device signal when present (policies escalate/abort)
+        and falls back to summing the category counters."""
+        import jax
+
+        s = state.stats
+        if getattr(s, "pressure", None) is not None:
+            return int(np.asarray(jax.device_get(s.pressure)).max())
+        return sum(ResilienceController._pressure_categories(state).values())
+
+    @staticmethod
+    def _pressure_categories(state) -> dict[str, int]:
+        """Per-category cumulative drop totals — the growth decision's
+        input (queue-side pressure grows the slab, outbox-side pressure
+        grows the send budget)."""
+        import jax
+
+        s = state.stats
+        return {
+            "queue": int(
+                np.asarray(jax.device_get(state.queue.dropped)).sum()
+            ),
+            "budget": int(
+                np.asarray(jax.device_get(s.pkts_budget_dropped)).sum()
+            ),
+            "a2a": int(np.asarray(jax.device_get(s.a2a_shed)).sum()),
+            "outbox": int(np.asarray(jax.device_get(s.ob_dropped)).sum()),
+        }
+
+    @classmethod
+    def raise_if_dropped(cls, state, baseline: dict | None = None):
+        """Raise PressureAbort naming the per-category drop deltas when
+        `state` carries capacity drops past `baseline` (None = zero) —
+        the one formatter the abort policy's two drivers share (the
+        modeled controller's in-loop check and the hybrid driver's
+        post-window check must report identically)."""
+        if cls._pressure_total(state) <= (
+            sum(baseline.values()) if baseline else 0
+        ):
+            return
+        cats = cls._pressure_categories(state)
+        base = baseline or {k: 0 for k in cats}
+        detail = ", ".join(
+            f"{k}+{v - base[k]}"
+            for k, v in sorted(cats.items())
+            if v > base[k]
+        )
+        raise PressureAbort(
+            f"pressure: abort policy hit its first capacity drop ({detail})"
+        )
+
+    # ---- ladders -----------------------------------------------------------
+
+    def _ensure_ladders(self, cap: int, budget: int):
+        if self._cap_ladder is not None:
+            return
+        p = self.pressure
+        max_cap = p.max_capacity or cap * DEFAULT_MAX_CAPACITY_FACTOR
+        max_box = p.max_outbox or budget * DEFAULT_MAX_OUTBOX_FACTOR
+        self._cap_ladder = resolve_ladder(cap, max_cap, p.growth_factor)
+        self._box_ladder = resolve_ladder(budget, max_box, p.growth_factor)
+
+    def _next_rung(self, ladder: list[int], cur: int, poisoned=()) -> int | None:
+        for rung in ladder:
+            if rung > cur and rung not in poisoned:
+                return rung
+        return None
+
+    def _say(self, msg: str):
+        if self._log is not None:
+            print(f"[pressure] {msg}", file=self._log)
+
+    # ---- migration ---------------------------------------------------------
+
+    def migrate(self, state, new_cap: int, new_budget: int):
+        """Re-seat `state` at (new_cap, new_budget): queue planes through
+        the exactness-gated grow ops, a fresh (empty) outbox at the new
+        width — migrations happen at chunk boundaries, where the
+        exchange has always just cleared the outbox, asserted here via
+        the cheap per-shard count word. The gear ladder follows a budget
+        change (the new full width becomes the ladder top, so the replay
+        loop keeps its cannot-shed terminal rung)."""
+        import jax
+
+        from shadow_tpu.core.engine import make_empty_outbox
+        from shadow_tpu.ops.events import migrate_queue
+
+        cap = state.queue.t.shape[1]
+        budget = state.outbox.t.shape[1]
+        if new_cap != cap:
+            state = state._replace(
+                queue=migrate_queue(state.queue, new_cap, self.queue_block)
+            )
+        if new_budget != budget:
+            assert (
+                int(np.asarray(jax.device_get(state.outbox.count)).sum()) == 0
+            ), "outbox migration outside a chunk boundary"
+            state = state._replace(
+                outbox=make_empty_outbox(
+                    state.outbox.t.shape[0], new_budget, state.outbox.count
+                )
+            )
+            if self.gearctl is not None:
+                g = self.gearctl
+                g.ladder = sorted(set(g.ladder) | {int(new_budget)})
+                if g.gear not in g.ladder:
+                    g.gear = g.top
+        if self._reshard is not None:
+            state = self._reshard(state)
+        return state
+
+    # ---- the chunk loop ----------------------------------------------------
+
+    def run_chunk(self, state, dispatch, rounds0=None):
+        """One ACCEPTED chunk, with shed-exact gear replay and drop-exact
+        capacity escalation from a single pre-chunk snapshot.
+
+        `dispatch(state, gear, capacity, budget)` runs one chunk program
+        at that shape (donation-safe). `rounds0` keeps the hybrid
+        drivers' zero-round guarded windows out of the gear controller,
+        exactly as `run_adaptive_chunk` documents.
+
+        Shapes are derived from the STATE, not from controller memory: a
+        supervisor rewind can hand back a pre-migration state, and the
+        state's own shapes are the only truth about which program runs."""
+        import jax
+
+        from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
+
+        gearctl = self.gearctl
+        gear = gearctl.gear if gearctl is not None else 0
+        pressured = self.pressure is not None
+        if pressured:
+            cap = state.queue.t.shape[1]
+            budget = state.outbox.t.shape[1]
+            if self.escalate:
+                self._ensure_ladders(cap, budget)
+        else:
+            cap = budget = 0
+        need_snap = (
+            gearctl is not None and gear < gearctl.top
+        ) or self.escalate
+        snap = snapshot_state(state) if need_snap else None
+        self._last_snap = snap
+        while True:
+            shed0 = int(
+                np.asarray(jax.device_get(state.stats.gear_shed)).max()
+            )
+            press0 = self._pressure_total(state) if pressured else 0
+            cats0 = self._pressure_categories(state) if pressured else None
+            try:
+                out = dispatch(state, gear, cap, budget)
+                jax.block_until_ready(out)
+            except (KeyboardInterrupt, SystemExit, PressureAbort):
+                raise
+            except Exception as e:
+                grown_cap = (
+                    self.escalate and cap > self._cap_ladder[0]
+                )
+                grown_box = (
+                    self.escalate and budget > self._box_ladder[0]
+                )
+                if (grown_cap or grown_box) and _is_oom(e):
+                    # a GROWN program could not compile/dispatch: which
+                    # axis blew the budget is unknowable from here, so
+                    # every axis currently above base falls back one
+                    # rung and its abandoned rungs (and everything
+                    # above — bigger only) are poisoned. The shrink is
+                    # fits-checked against the restored snapshot (the
+                    # state we actually rewind to): a lower rung the
+                    # live events no longer fit would silently truncate
+                    # them — the exact loss this plane exists to prevent
+                    # — so an unfitting fallback corners into a loud
+                    # PressureAbort instead (migrate_queue's shrink
+                    # contract, ops/events.py).
+                    self.oom_fallbacks += 1
+                    self.last_error = f"{type(e).__name__}: {e}"
+                    restored = restore_snapshot(snap)
+                    lower_cap, lower_box = cap, budget
+                    if grown_cap:
+                        import jax.numpy as jnp
+
+                        from shadow_tpu.ops.events import migration_fits
+
+                        for rung in self._cap_ladder:
+                            if rung >= cap:
+                                self._cap_poisoned.add(rung)
+                        lower_cap = next(
+                            (
+                                r
+                                for r in sorted(self._cap_ladder, reverse=True)
+                                if r < cap
+                                and r not in self._cap_poisoned
+                                and bool(jnp.all(
+                                    migration_fits(restored.queue, r)
+                                ))
+                            ),
+                            None,
+                        )
+                        if lower_cap is None:
+                            self.aborted = True
+                            raise PressureAbort(
+                                f"pressure: cornered — grown program "
+                                f"failed at capacity {cap} "
+                                f"({self.last_error}) and the live events "
+                                f"no longer fit any usable lower rung "
+                                f"(shrinking would silently truncate them)"
+                            ) from e
+                    if grown_box:
+                        for rung in self._box_ladder:
+                            if rung >= budget:
+                                self._box_poisoned.add(rung)
+                        lower_box = max(
+                            r for r in self._box_ladder
+                            if r < budget and r not in self._box_poisoned
+                        )
+                    self._say(
+                        f"grown program failed at (cap={cap}, "
+                        f"outbox={budget}) ({self.last_error}); falling "
+                        f"back to (cap={lower_cap}, outbox={lower_box})"
+                    )
+                    state = self.migrate(restored, lower_cap, lower_box)
+                    cap, budget = lower_cap, lower_box
+                    snap = snapshot_state(state)
+                    self._last_snap = snap
+                    continue
+                raise
+            shed = (
+                int(np.asarray(jax.device_get(out.stats.gear_shed)).max())
+                - shed0
+            )
+            if shed > 0:
+                # gear problem: the discarded attempt's high-water names
+                # the burst that shed it (read BEFORE the restore)
+                seen = int(
+                    np.asarray(jax.device_get(out.stats.outbox_hwm)).max()
+                )
+                gear = gearctl.note_shed(seen)
+                state = restore_snapshot(snap)
+                continue
+            if pressured:
+                delta = self._pressure_total(out) - press0
+                if delta > 0:
+                    if self.abort_on_drop:
+                        # honest stop AT the drop: the exported state
+                        # includes the dropping round, counters and all
+                        self.aborted = True
+                        self._abort_state = out
+                        self.raise_if_dropped(out, cats0)
+                    state, gear, cap, budget, snap = self._escalate_replay(
+                        out, cats0, snap, gear, cap, budget
+                    )
+                    continue
+            break
+        state = out
+        hwm = int(np.asarray(jax.device_get(state.stats.outbox_hwm)).max())
+        self.ob_hwm_run = max(self.ob_hwm_run, hwm)
+        advanced = rounds0 is None or int(state.stats.rounds) > rounds0
+        if gearctl is not None and advanced:
+            gearctl.note_chunk(gear, hwm)
+        state = state._replace(
+            stats=state.stats._replace(
+                outbox_hwm=state.stats.outbox_hwm * 0
+            )
+        )
+        if self.escalate:
+            state = self._proactive(state, hwm)
+        self._last_snap = None
+        # the gear this chunk was ACCEPTED at — note_chunk above may have
+        # already moved the controller for the NEXT chunk (heartbeats and
+        # gear histograms pair against what actually ran)
+        return state, gear, hwm
+
+    def _escalate_replay(self, aborted, cats0, snap, gear, cap, budget):
+        """A chunk attempt dropped: pick the grown shape from the aborted
+        attempt's per-category deltas, restore the pre-chunk snapshot,
+        migrate, and hand the loop the new shape. Raises PressureAbort
+        when cornered (a dropping axis cannot grow)."""
+        from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
+
+        cats = self._pressure_categories(aborted)
+        queue_side = cats["queue"] > cats0["queue"]
+        box_side = (
+            cats["budget"] > cats0["budget"]
+            or cats["a2a"] > cats0["a2a"]
+            or cats["outbox"] > cats0["outbox"]
+        )
+        new_cap, new_budget = cap, budget
+        if queue_side:
+            up = self._next_rung(self._cap_ladder, cap, self._cap_poisoned)
+            if up is None:
+                self.aborted = True
+                self.last_error = (
+                    f"queue pressure at capacity {cap} with no usable rung "
+                    f"left (ladder {self._cap_ladder}, poisoned "
+                    f"{sorted(self._cap_poisoned)})"
+                )
+                raise PressureAbort(f"pressure: cornered — {self.last_error}")
+            new_cap = up
+        if box_side:
+            up = self._next_rung(self._box_ladder, budget, self._box_poisoned)
+            if up is None:
+                self.aborted = True
+                self.last_error = (
+                    f"outbox pressure at budget {budget} with no usable "
+                    f"rung left (ladder {self._box_ladder}, poisoned "
+                    f"{sorted(self._box_poisoned)})"
+                )
+                raise PressureAbort(f"pressure: cornered — {self.last_error}")
+            new_budget = up
+        if (new_cap, new_budget) == (cap, budget):
+            # drops grew but no category moved past its entry value —
+            # cannot happen by construction (delta > 0 implies some
+            # category grew); guard against it anyway, loudly
+            self.aborted = True
+            raise PressureAbort(
+                "pressure: drop detected but no growth axis identified"
+            )
+        self.regrows += 1
+        self.replays += 1
+        self._say(
+            f"capacity drop at (cap={cap}, outbox={budget}); replaying "
+            f"chunk at (cap={new_cap}, outbox={new_budget})"
+        )
+        state = self.migrate(restore_snapshot(snap), new_cap, new_budget)
+        snap = snapshot_state(state)
+        self._last_snap = snap
+        return state, gear, new_cap, new_budget, snap
+
+    def _proactive(self, state, chunk_hwm: int):
+        """Boundary regrow BEFORE anything drops: the always-on
+        occupancy high-water crossing the headroom threshold grows the
+        queue; a chunk whose outbox high-water FILLED the budget grows
+        the outbox (hwm == budget means one more send next chunk would
+        be a budget drop — the gear controller's exactly-filled rule,
+        applied to the shape)."""
+        import jax
+        import math
+
+        p = self.pressure
+        if not p.headroom:
+            return state
+        cap = state.queue.t.shape[1]
+        budget = state.outbox.t.shape[1]
+        new_cap, new_budget = cap, budget
+        occ = int(np.asarray(jax.device_get(state.stats.q_occ_hwm)).max())
+        if occ >= math.ceil(p.headroom * cap):
+            up = self._next_rung(self._cap_ladder, cap, self._cap_poisoned)
+            if up is not None:
+                new_cap = up
+        if chunk_hwm >= budget:
+            up = self._next_rung(self._box_ladder, budget, self._box_poisoned)
+            if up is not None:
+                new_budget = up
+        if (new_cap, new_budget) != (cap, budget):
+            self.proactive_regrows += 1
+            self._say(
+                f"proactive regrow: occupancy hwm {occ}/{cap}, outbox hwm "
+                f"{chunk_hwm}/{budget} -> (cap={new_cap}, "
+                f"outbox={new_budget})"
+            )
+            state = self.migrate(state, new_cap, new_budget)
+        return state
+
+    # ---- abort/export ------------------------------------------------------
+
+    def abort_export_state(self):
+        """State the driver should export artifacts from after a
+        PressureAbort: under the abort policy, the dropping state itself
+        (the honest record — it includes the drop that stopped the run);
+        under escalate-cornered, a fresh copy of the last good pre-chunk
+        snapshot (the failed attempts were discarded). None when neither
+        exists (abort before any chunk ran) — then the in-hand state is
+        all there is."""
+        from shadow_tpu.core.checkpoint import restore_snapshot
+
+        if self._abort_state is not None:
+            return self._abort_state
+        if self._last_snap is not None:
+            return restore_snapshot(self._last_snap)
+        return None
+
+    def current_shape(self, state) -> tuple[int, int]:
+        """(queue_capacity, send_budget) of a state — the heartbeat's
+        `cap=` source."""
+        return state.queue.t.shape[1], state.outbox.t.shape[1]
+
+    def report(self) -> dict:
+        """JSON-able summary for sim-stats / BENCH rows."""
+        out: dict[str, Any] = {
+            "policy": self.policy,
+            "regrows": self.regrows,
+            "proactive_regrows": self.proactive_regrows,
+            "replays": self.replays,
+            "oom_fallbacks": self.oom_fallbacks,
+        }
+        if self._cap_ladder is not None:
+            out["capacity_ladder"] = list(self._cap_ladder)
+            out["outbox_ladder"] = list(self._box_ladder)
+        if self._cap_poisoned:
+            out["capacity_poisoned"] = sorted(self._cap_poisoned)
+        if self._box_poisoned:
+            out["outbox_poisoned"] = sorted(self._box_poisoned)
+        if self.aborted:
+            out["aborted"] = True
+        if self.last_error:
+            out["last_error"] = self.last_error
+        return out

@@ -178,6 +178,15 @@ class ChunkSupervisor:
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # XlaRuntimeError, aborts, anything
+                from shadow_tpu.core.pressure import PressureAbort
+
+                if isinstance(e, PressureAbort):
+                    # a pressure-policy stop is a deterministic DECISION,
+                    # not a transient dispatch failure: retrying would
+                    # reproduce it max_retries times and then launder it
+                    # into a SupervisorAbort — let the driver's pressure
+                    # handler see it instead
+                    raise
                 self.last_error = f"{type(e).__name__}: {e}"
                 attempt += 1
                 self.retries += 1

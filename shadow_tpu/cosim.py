@@ -50,6 +50,7 @@ import yaml
 from shadow_tpu.config.options import ConfigError, ConfigOptions
 from shadow_tpu.core import engine as eng
 from shadow_tpu.core.engine import Engine, EngineParams
+from shadow_tpu.core.pressure import PressureAbort
 from shadow_tpu.core.supervisor import SupervisorAbort
 from shadow_tpu.host import CpuHost, HostConfig
 from shadow_tpu.host.sockets import NetPacket
@@ -155,6 +156,21 @@ class HybridSimulation:
                 "(program) simulations — the CPU plane cannot pause live "
                 "processes; use loss_windows, or model the hosts"
             )
+        # pressure plane: the hybrid driver supports drop (default) and
+        # abort (first-drop stop with honest artifacts). Escalate is
+        # rejected loudly — the bridge's injection programs and byte
+        # stores are compiled/keyed against the device queue shape, and a
+        # mid-window capacity migration cannot re-seat the CPU plane's
+        # staged state; model the hosts (the Simulation driver escalates)
+        # or size the hybrid slab up front (it already auto-rooms to
+        # >= 256 slots).
+        if cfg.pressure.policy == "escalate":
+            raise ConfigError(
+                "pressure: escalate is not supported on hybrid (program) "
+                "simulations — the CPU bridge cannot migrate staged state "
+                "across queue shapes; use policy drop/abort or model the "
+                "hosts"
+            )
         if (cfg.faults.supervisor.enabled
                 and cfg.faults.supervisor.checkpoint_file is not None):
             # same principle as crashes above: the hybrid supervisor runs
@@ -220,6 +236,9 @@ class HybridSimulation:
                 s.bw_up_bits > 0 or s.bw_down_bits > 0 for s in self.specs
             ),
             fault_loss_windows=self._fault_sched.loss_windows,
+            # pressure plane: abort policy traces the first-drop stop
+            # into the guarded loop (escalate was rejected above)
+            pressure_abort=cfg.pressure.active,
         )
         self.mesh = None
         if world > 1:
@@ -484,6 +503,7 @@ class HybridSimulation:
         # end-of-run constraints, core/checkpoint.save_checkpoint_hybrid)
         self._supervisor = None
         self._aborted = False
+        self._pressure_aborted = False
         if cfg.faults.supervisor.enabled:
             from shadow_tpu.core.supervisor import ChunkSupervisor
 
@@ -640,6 +660,18 @@ class HybridSimulation:
                     self.state = good
                 self._aborted = True
                 break
+            except PressureAbort as e:
+                # pressure abort policy: the in-hand state IS the honest
+                # record (the guarded loop stopped at the dropping round;
+                # the drop is in the exported counters)
+                print(f"[pressure] aborting run: {e}", file=log)
+                if self._tracer is not None:
+                    self._tracer.drain(
+                        self.state.trace,
+                        wall_t0=t_rounds, wall_t1=time.monotonic(),
+                    )
+                self._pressure_aborted = True
+                break
             if self._tracer is not None:
                 self._tracer.drain(
                     self.state.trace,
@@ -745,6 +777,14 @@ class HybridSimulation:
             self.state = run(self.state)
         else:
             self.state = self._supervisor.run_chunk(self.state, run)
+        if self.cfg.pressure.active:
+            # abort policy (the only active pressure policy the hybrid
+            # driver admits): the guarded loop stopped at the first
+            # dropping round — stop the run with the drop in the record
+            # (the shared formatter keeps the two drivers' reports equal)
+            from shadow_tpu.core.pressure import ResilienceController
+
+            ResilienceController.raise_if_dropped(self.state)
 
     def _order_seq(self, gid: int) -> int:
         """Fresh per-host order counter for qdisc-reordered injections."""
@@ -973,6 +1013,27 @@ class HybridSimulation:
                 else {}
             ),
             **({"aborted": True} if self._aborted else {}),
+            **(
+                {
+                    "pressure": {
+                        "policy": self.cfg.pressure.policy,
+                        "capacity": self.state.queue.t.shape[1],
+                        "outbox": self.state.outbox.t.shape[1],
+                        **(
+                            {"aborted": True}
+                            if self._pressure_aborted else {}
+                        ),
+                    },
+                    "pressure_regrows": 0,
+                    "pressure_replays": 0,
+                }
+                if self.cfg.pressure.active
+                else {}
+            ),
+            **(
+                {"pressure_aborted": True, "aborted": True}
+                if self._pressure_aborted else {}
+            ),
             **(
                 {"poisoned": True}
                 if self._supervisor is not None and self._supervisor.poisoned

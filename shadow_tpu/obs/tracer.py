@@ -56,6 +56,7 @@ TRACE_FIELDS = (
     "faults_dropped", # fault-plane drops this round (delta, this shard)
     "faults_delayed", # fault-plane delays this round (delta, this shard)
     "hosts_down",     # hosts inside a crash window at this round's end
+    "cap",            # active per-host queue capacity (pressure plane)
 )
 TRACE_COLS = len(TRACE_FIELDS)
 (
@@ -76,6 +77,7 @@ TRACE_COLS = len(TRACE_FIELDS)
     COL_FAULTS_DROPPED,
     COL_FAULTS_DELAYED,
     COL_HOSTS_DOWN,
+    COL_CAP,
 ) = range(TRACE_COLS)
 
 
@@ -318,6 +320,7 @@ class RoundTracer:
             "faults_dropped": _sum(COL_FAULTS_DROPPED),
             "faults_delayed": _sum(COL_FAULTS_DELAYED),
             "hosts_down_max": _max(COL_HOSTS_DOWN),
+            "cap_max": _max(COL_CAP),
         }
 
     def gear_histogram(self) -> dict:

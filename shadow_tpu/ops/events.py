@@ -705,6 +705,123 @@ def bq_push_many(
     )
 
 
+# --------------------------------------------------------------------------
+# capacity migration (the pressure plane's escalation primitive)
+# --------------------------------------------------------------------------
+
+
+def migrate_queue(q, new_capacity: int, block: int = 0):
+    """Re-seat a queue's events into a slab of `new_capacity` slots per
+    host — the pressure plane's escalation primitive (core/pressure.py)
+    and the cross-capacity checkpoint-restore path.
+
+    Exactness argument (gated by tests/test_pressure.py): slot POSITIONS
+    are unobservable — pops select by the (time, order) total key over
+    the whole slab, pushes/drops depend only on the free-slot COUNT, and
+    the digest folds popped keys — so any slab holding the same event
+    multiset with the same capacity behaves bit-identically. Growth pads
+    empty columns (TIME_MAX/ORDER_MAX sentinels) after the existing
+    slots; shrink first compacts live events to the front (stable in
+    column order) then truncates the now-empty tail. The result is
+    therefore indistinguishable from a queue BUILT at `new_capacity`
+    carrying the same events.
+
+    Caller contract on shrink: every live event must fit
+    (`q_len(q) <= new_capacity` per host) — slots holding real events
+    must never truncate. This function is pure/traceable, so the loud
+    refusal lives in the host-side callers (core/pressure.py,
+    core/checkpoint.py); see `migration_fits`.
+
+    `block` > 0 returns a `BucketQueue` with freshly rebuilt caches
+    (migration is a rebuild point, like the exchange merge); 0 returns a
+    flat `EventQueue`. Works on either input queue type."""
+    qf = as_flat(q)
+    h, c = qf.t.shape
+    new_capacity = int(new_capacity)
+    if new_capacity < 1:
+        raise ValueError(f"new_capacity must be >= 1, got {new_capacity}")
+    if block < 0 or (block and new_capacity % block):
+        raise ValueError(
+            f"block={block} must be 0 (flat) or divide new_capacity="
+            f"{new_capacity} evenly"
+        )
+    t, order, kind, payload = qf.t, qf.order, qf.kind, qf.payload
+    if new_capacity < c:
+        # compact live slots to the front, stable in column order (jax
+        # sorts are stable), so the truncated tail is all-empty whenever
+        # the caller's occupancy contract holds
+        live = t != TIME_MAX
+        key = jnp.where(
+            live,
+            jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[None, :], (h, c)),
+            jnp.int32(c),
+        )
+        idx = jnp.argsort(key, axis=1)
+        t = jnp.take_along_axis(t, idx, axis=1)
+        order = jnp.take_along_axis(order, idx, axis=1)
+        kind = jnp.take_along_axis(kind, idx, axis=1)
+        payload = jnp.take_along_axis(payload, idx[:, :, None], axis=1)
+        t = t[:, :new_capacity]
+        order = order[:, :new_capacity]
+        kind = kind[:, :new_capacity]
+        payload = payload[:, :new_capacity]
+    elif new_capacity > c:
+        pad = new_capacity - c
+        t = jnp.concatenate(
+            [t, jnp.full((h, pad), TIME_MAX, jnp.int64)], axis=1
+        )
+        order = jnp.concatenate(
+            [order, jnp.full((h, pad), ORDER_MAX, jnp.int64)], axis=1
+        )
+        kind = jnp.concatenate(
+            [kind, jnp.zeros((h, pad), jnp.int32)], axis=1
+        )
+        payload = jnp.concatenate(
+            [payload, jnp.zeros((h, pad, payload.shape[-1]), jnp.int32)],
+            axis=1,
+        )
+    out = EventQueue(t=t, order=order, kind=kind, payload=payload,
+                     dropped=qf.dropped)
+    if block:
+        return bucket_rebuild(out, block)
+    return out
+
+
+def grow_queue(q: EventQueue, new_capacity: int) -> EventQueue:
+    """`migrate_queue` restricted to growth (C' > C) on a flat queue —
+    the escalation fast path: live slots keep their columns, new empty
+    columns append (no compaction pass)."""
+    if new_capacity <= q.t.shape[1]:
+        raise ValueError(
+            f"grow_queue: new_capacity={new_capacity} must exceed the "
+            f"current capacity {q.t.shape[1]}"
+        )
+    return migrate_queue(q, new_capacity, block=0)
+
+
+def grow_bucket_queue(
+    q: BucketQueue, new_capacity: int, block: int = 0
+) -> BucketQueue:
+    """`grow_queue` for the two-level queue: pad the flat planes, then
+    rebuild the (bt, bo, bfill) caches wholesale for the new block grid
+    (migration is a rebuild point — trusting grown caches would leave
+    the new blocks' minima unset)."""
+    if new_capacity <= q.t.shape[1]:
+        raise ValueError(
+            f"grow_bucket_queue: new_capacity={new_capacity} must exceed "
+            f"the current capacity {q.t.shape[1]}"
+        )
+    return migrate_queue(q, new_capacity, block=block or q.block)
+
+
+def migration_fits(q, new_capacity: int):
+    """Per-host predicate: every live event fits in `new_capacity` slots
+    (bool[H]). Hosts where this is False would lose events on shrink —
+    the host-side refusal check `migrate_queue`'s shrink contract
+    requires (pure, so callers can read it off-device with one sum)."""
+    return q_len(q) <= jnp.int32(int(new_capacity))
+
+
 # ---- queue-kind dispatchers (trace-time: the queue type is static) --------
 
 

@@ -351,16 +351,20 @@ def heartbeat_line(
     *,
     fault: tuple[int, int] | None = None,
     gear: int | None = None,
+    cap: int | None = None,
     rep: tuple[int, int] | None = None,
 ) -> str:
     """The `[heartbeat]` progress line, shared by the Simulation run loop
     and the campaign driver so tools/parse_shadow.py has ONE format to
     track. Optional fields ride along in a fixed order (faults, gear,
-    rep, then ratio); lines without them are byte-identical to the older
-    formats, which the parser keeps reading (gated by literal-line
-    tests). `rep` is (replicas done, total) on ensemble campaign runs."""
+    cap, rep, then ratio); lines without them are byte-identical to the
+    older formats, which the parser keeps reading (gated by literal-line
+    tests). `cap` is the ACTIVE per-host queue capacity on pressure-plane
+    runs (escalation regrows it mid-run); `rep` is (replicas done, total)
+    on ensemble campaign runs."""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
     gear_f = f"gear={gear} " if gear is not None else ""
+    cap_f = f"cap={cap} " if cap is not None else ""
     rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
     return (
         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
@@ -371,6 +375,7 @@ def heartbeat_line(
         f"ici_bytes={ici_bytes} q_hwm={q_hwm} "
         f"{fault_f}"
         f"{gear_f}"
+        f"{cap_f}"
         f"{rep_f}"
         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
         f"{resource_heartbeat()}"
@@ -428,6 +433,48 @@ class Simulation:
                 "faults: the cpu-reference scheduler does not model the "
                 "fault plane; run the tpu scheduler or drop the faults block"
             )
+        # pressure plane (core/pressure.py): validated here so every
+        # unsupported combination fails at build, not mid-run
+        press = cfg.pressure
+        if press.active:
+            if ex.scheduler == "cpu-reference":
+                raise ConfigError(
+                    "pressure: the cpu-reference scheduler does not model "
+                    "the pressure plane; run the tpu scheduler or keep "
+                    "policy: drop"
+                )
+            if any(h.pcap_enabled for h in self.hosts):
+                raise ConfigError(
+                    "pressure: escalate/abort are not supported with pcap "
+                    "capture (the single-round capture loop has no "
+                    "snapshot-replay seam); disable pcap or keep "
+                    "policy: drop"
+                )
+        if press.policy == "escalate":
+            if ex.merge_rows > 0:
+                raise ConfigError(
+                    "pressure: escalate cannot cure a merge_rows bound "
+                    "(its shed is positional, not capacity-sized) — drop "
+                    "merge_rows or keep policy: drop/abort"
+                )
+            if ex.a2a_block > 0:
+                raise ConfigError(
+                    "pressure: escalate cannot cure an explicit "
+                    "a2a_block's sheds (the resized programs scale only "
+                    "the AUTO block with the send budget) — drop "
+                    "a2a_block (auto-sizing follows escalation) or keep "
+                    "policy: drop/abort"
+                )
+            if press.max_capacity and press.max_capacity < qcap:
+                raise ConfigError(
+                    f"pressure.max_capacity={press.max_capacity} is below "
+                    f"the configured queue capacity {qcap}"
+                )
+            if press.max_outbox and press.max_outbox < send_budget:
+                raise ConfigError(
+                    f"pressure.max_outbox={press.max_outbox} is below the "
+                    f"configured send budget {send_budget}"
+                )
         self.engine_cfg = EngineConfig(
             num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
@@ -461,6 +508,10 @@ class Simulation:
             fault_crash_windows=self._fault_sched.crash_windows,
             fault_loss_windows=self._fault_sched.loss_windows,
             fault_queue_clear=self._fault_sched.queue_clear,
+            # pressure plane: escalate/abort trace the first-drop abort
+            # condition into the chunk loop; drop (default) leaves the
+            # program bit-identical to the pre-pressure engine
+            pressure_abort=press.active,
         )
         # occupancy-adaptive merge gears (core/gears.py): resolved against
         # the (possibly auto-sized) send budget; [] = disabled
@@ -471,6 +522,7 @@ class Simulation:
         except ValueError as e:
             raise ConfigError(f"experimental.merge_gears: {e}") from e
         self._gearctl = None  # built per run()
+        self._pressctl = None  # ResilienceController when pressure is active
         self._ob_hwm_run = 0  # run-wide outbox high-water (gear runs reset
         # the device counter per chunk, so the run max is tracked here)
         mesh = None
@@ -577,19 +629,42 @@ class Simulation:
             os.makedirs(cfg.observability.profile_dir, exist_ok=True)
             jax.profiler.start_trace(cfg.observability.profile_dir)
         gearctl = None
-        if self._gear_ladder and capture is None:
-            # adaptive merge gears: each chunk dispatches at the width the
-            # controller picked from last chunk's outbox-send high-water;
-            # a shed (exact, in-jit) discards the chunk and replays it one
-            # gear up from a pre-chunk snapshot — results are bit-identical
-            # to full width by construction (core/gears.py). The capture
-            # path stays full-width: its single-round dispatches re-sync
-            # every round anyway, so there is no sort to amortize.
-            from shadow_tpu.core.gears import GearController, run_adaptive_chunk
+        resilience = None
+        pressure_on = cfg.pressure.active
+        if (self._gear_ladder or pressure_on) and capture is None:
+            # the shared snapshot-replay seam (core/pressure.py): adaptive
+            # merge gears dispatch at the width the controller picked from
+            # last chunk's outbox-send high-water — a shed (exact, in-jit)
+            # discards the chunk and replays it one gear up — and the
+            # pressure plane's escalate policy regrows queue/outbox shapes
+            # and replays at the first capacity drop, so accepted chunks
+            # are bit-identical to full width at the final shape. The
+            # capture path stays full-width/fixed-shape: its single-round
+            # dispatches re-sync every round anyway (pressure policies are
+            # rejected with capture at build time).
+            from shadow_tpu.core.gears import GearController
+            from shadow_tpu.core.pressure import ResilienceController
 
-            gearctl = GearController(self._gear_ladder)
+            gearctl = (
+                GearController(self._gear_ladder) if self._gear_ladder
+                else None
+            )
             self._gearctl = gearctl
-            self._run_adaptive_chunk = run_adaptive_chunk
+            reshard = None
+            if self.engine.mesh is not None:
+                specs = jax.tree.map(
+                    lambda s: jax.sharding.NamedSharding(self.engine.mesh, s),
+                    self.engine.state_specs(),
+                )
+                reshard = lambda st: jax.device_put(st, specs)  # noqa: E731
+            resilience = ResilienceController(
+                gearctl=gearctl,
+                pressure=cfg.pressure if pressure_on else None,
+                queue_block=self.engine_cfg.queue_block,
+                reshard=reshard,
+                log=log,
+            )
+            self._pressctl = resilience if pressure_on else None
         sup = None
         if cfg.faults.supervisor.enabled and capture is None:
             # crash-resilient supervisor (core/supervisor.py): periodic
@@ -633,15 +708,38 @@ class Simulation:
 
         def _chunk_step(st):
             nonlocal last_gear
-            if gearctl is not None:
-                st, lg, hwm = self._run_adaptive_chunk(
-                    gearctl, st,
-                    lambda s, g: self.engine.run_chunk_gear(s, self.params, g),
+            if resilience is not None:
+                st, lg, hwm = resilience.run_chunk(
+                    st,
+                    lambda s, g, c, b: self.engine.run_chunk_resized(
+                        s, self.params, g, c, b
+                    ),
                 )
-                last_gear = lg
+                last_gear = lg if gearctl is not None else None
                 self._ob_hwm_run = max(self._ob_hwm_run, hwm)
                 return st
             return self.engine.run_chunk(st, self.params)
+
+        def _pressure_abort(e, t_chunk):
+            # the pressure policy stopped the run: abort exports the
+            # dropping state itself (the honest record — the drop is in
+            # the counters), escalate-cornered exports the last good
+            # pre-chunk snapshot. Either way the artifacts cover exactly
+            # what the exported state saw.
+            print(f"[pressure] aborting run: {e}", file=log)
+            good = resilience.abort_export_state()
+            if good is not None:
+                self.state = good
+            if tracer is not None:
+                jax.block_until_ready(self.state)
+                tracer.drain(
+                    self.state.trace,
+                    wall_t0=t_chunk, wall_t1=time.monotonic(),
+                )
+                tracer.truncate_to_round(int(self.state.stats.rounds))
+            self._pressure_aborted = True
+
+        from shadow_tpu.core.pressure import PressureAbort
 
         try:
             while not bool(self.state.done):
@@ -654,6 +752,9 @@ class Simulation:
 
                     try:
                         self.state = sup.run_chunk(self.state, _chunk_step)
+                    except PressureAbort as e:
+                        _pressure_abort(e, t_chunk)
+                        break
                     except SupervisorAbort as e:
                         # graceful abort: export the completed prefix from
                         # the supervisor's snapshot, not the in-hand state
@@ -674,7 +775,11 @@ class Simulation:
                         self._aborted = True
                         break
                 else:
-                    self.state = _chunk_step(self.state)
+                    try:
+                        self.state = _chunk_step(self.state)
+                    except PressureAbort as e:
+                        _pressure_abort(e, t_chunk)
+                        break
                 if tracer is not None:
                     # pair the drained rounds with the true wall span of
                     # this dispatch (block: async dispatch would pin the
@@ -705,10 +810,16 @@ class Simulation:
                         fd = int(np.asarray(self.state.stats.faults_dropped).sum())
                         fy = int(np.asarray(self.state.stats.faults_delayed).sum())
                         fault = (fd, fy)
+                    # cap= rides along only on pressure-plane runs (the
+                    # ACTIVE capacity — escalation regrows it mid-run)
+                    cap = (
+                        self.state.queue.t.shape[1]
+                        if pressure_on else None
+                    )
                     print(
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
-                            fault=fault, gear=last_gear,
+                            fault=fault, gear=last_gear, cap=cap,
                         ),
                         file=log,
                     )
@@ -852,6 +963,26 @@ class Simulation:
         }
         if self._gearctl is not None:
             report["gears"] = self._gearctl.report()
+        if self._pressctl is not None:
+            rc = self._pressctl
+            report["pressure"] = {
+                **rc.report(),
+                # the shapes the run ENDED at (escalation regrows them;
+                # fixed-shape runs echo the configured values)
+                "capacity": self.state.queue.t.shape[1],
+                "outbox": self.state.outbox.t.shape[1],
+                "base_capacity": self.engine_cfg.queue_capacity,
+                "base_outbox": self.engine_cfg.sends_per_host_round,
+            }
+            # flat counters for trend tooling (bench rows, parse_shadow
+            # consumers) — same numbers as the block above
+            report["pressure_regrows"] = (
+                rc.regrows + rc.proactive_regrows
+            )
+            report["pressure_replays"] = rc.replays
+            if getattr(self, "_pressure_aborted", False):
+                report["pressure_aborted"] = True
+                report["aborted"] = True
         sup = getattr(self, "_supervisor", None)
         if sup is not None:
             report["supervisor"] = sup.report()

@@ -35,6 +35,7 @@ def build_sim(
     faults: dict | None = None,
     bootstrap_end: int = 0,
     rounds_per_chunk: int = 64,
+    microstep_limit: int = 0,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -70,6 +71,7 @@ def build_sim(
         sends_per_host_round=sends_budget,
         max_round_inserts=qcap,
         rounds_per_chunk=rounds_per_chunk,
+        microstep_limit=microstep_limit,
         world=world,
         use_codel=use_codel,
         cpu_delay_ns=cpu_delay_ns,

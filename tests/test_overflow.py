@@ -16,12 +16,14 @@ MS = 1_000_000
 SEC = 1_000_000_000
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_native_ok = __import__(
-    "shadow_tpu.native_plane", fromlist=["ensure_built"]
-).ensure_built()
+from tests.subproc import native_plane_skip_reason
+
+# toolchain-unavailable OR the shim-cannot-load (exit-97) container
+# (tests/subproc.py native_plane_skip_reason classifies the signature)
+_native_skip = native_plane_skip_reason()
 
 
-@pytest.mark.skipif(not _native_ok, reason="native toolchain unavailable")
+@pytest.mark.skipif(_native_skip is not None, reason=str(_native_skip))
 def test_thread_slot_exhaustion_is_eagain_and_recovers():
     """IPC_MAX_THREADS (32) bounds concurrent managed threads: the excess
     pthread_create calls fail with EAGAIN, and creation works again after

@@ -15,12 +15,12 @@ import pytest
 from shadow_tpu.host import CpuHost, HostConfig
 from shadow_tpu.host.network import CpuNetwork
 
-pytestmark = pytest.mark.skipif(
-    not __import__(
-        "shadow_tpu.native_plane", fromlist=["ensure_built"]
-    ).ensure_built(),
-    reason="native toolchain unavailable",
-)
+from tests.subproc import native_plane_skip_reason
+
+# toolchain-unavailable OR the shim-cannot-load (exit-97) container
+# (tests/subproc.py native_plane_skip_reason classifies the signature)
+_skip = native_plane_skip_reason()
+pytestmark = pytest.mark.skipif(_skip is not None, reason=str(_skip))
 
 from shadow_tpu.native_plane import spawn_native  # noqa: E402
 

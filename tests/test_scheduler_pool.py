@@ -62,10 +62,12 @@ def test_serial_vs_parallel_byte_identical():
     """The determinism gate (reference determinism suite, two schedulers):
     the SAME native workload on 1 worker vs 4 workers produces
     byte-identical process output and host counters."""
-    from shadow_tpu.native_plane import ensure_built, spawn_native
+    from shadow_tpu.native_plane import spawn_native
+    from tests.subproc import native_plane_skip_reason
 
-    if not ensure_built():
-        pytest.skip("native toolchain unavailable")
+    reason = native_plane_skip_reason()
+    if reason is not None:
+        pytest.skip(reason)
     repo = os.path.join(os.path.dirname(__file__), "..")
     udp_echo = os.path.join(repo, "native", "build", "test_udp_echo")
     udp_client = os.path.join(repo, "native", "build", "test_udp_client")
@@ -195,10 +197,12 @@ def test_per_host_pool_exception_propagates():
 def test_serial_vs_per_host_byte_identical():
     """Determinism gate for the thread-per-host policy: same workload,
     serial vs per-host threads, byte-identical output."""
-    from shadow_tpu.native_plane import ensure_built, spawn_native
+    from shadow_tpu.native_plane import spawn_native
+    from tests.subproc import native_plane_skip_reason
 
-    if not ensure_built():
-        pytest.skip("native toolchain unavailable")
+    reason = native_plane_skip_reason()
+    if reason is not None:
+        pytest.skip(reason)
     repo = os.path.join(os.path.dirname(__file__), "..")
     udp_echo = os.path.join(repo, "native", "build", "test_udp_echo")
     udp_client = os.path.join(repo, "native", "build", "test_udp_client")

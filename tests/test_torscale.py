@@ -16,12 +16,12 @@ import yaml
 from shadow_tpu.config.options import ConfigOptions
 from shadow_tpu.cosim import HybridSimulation
 
-pytestmark = pytest.mark.skipif(
-    not __import__(
-        "shadow_tpu.native_plane", fromlist=["ensure_built"]
-    ).ensure_built(),
-    reason="native toolchain unavailable",
-)
+from tests.subproc import native_plane_skip_reason
+
+# toolchain-unavailable OR the shim-cannot-load (exit-97) container
+# (tests/subproc.py native_plane_skip_reason classifies the signature)
+_skip = native_plane_skip_reason()
+pytestmark = pytest.mark.skipif(_skip is not None, reason=str(_skip))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

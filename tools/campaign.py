@@ -165,6 +165,13 @@ class Campaign:
                 "program); set general.parallelism to 1 or shard the "
                 "campaign across processes"
             )
+        if base_cfg.pressure.active:
+            raise ConfigError(
+                "campaign: pressure escalate/abort are not supported with "
+                "the ensemble plane this round (a capacity migration "
+                "would have to re-seat every replica's slab mid-campaign);"
+                " keep pressure: drop and size replica capacities up front"
+            )
         if base_cfg.experimental.merge_gears:
             raise ConfigError(
                 "campaign: experimental.merge_gears is not supported with "

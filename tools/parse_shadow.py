@@ -30,6 +30,9 @@ HEARTBEAT_RE = re.compile(
     r"(?:faults=(?P<faults_dropped>\d+)/(?P<faults_delayed>\d+) )?"
     # PR 4 adaptive-exchange field (only emitted on merge_gears runs)
     r"(?:gear=(?P<gear>\d+) )?"
+    # PR 8 pressure-plane field (only emitted on pressure runs): the
+    # ACTIVE per-host queue capacity (escalation regrows it mid-run)
+    r"(?:cap=(?P<cap>\d+) )?"
     # PR 6 ensemble-campaign field (only emitted by tools/campaign.py):
     # rep=<replicas done>/<total replicas>
     r"(?:rep=(?P<rep_done>\d+)/(?P<rep_total>\d+) )?"
