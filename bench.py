@@ -539,7 +539,20 @@ def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
     # leg the free ground truth).
     digests = _np.asarray(s.digest).reshape(r_count, -1)
     rounds_arr = _np.asarray(s.rounds).reshape(r_count)
+    # memory observatory: one post-run sample (modeled fallback on CPU)
+    # so the config-8 BENCH row carries an hbm block like every other.
+    # The ensemble leg's params live on the campaign engine (the solo
+    # leg passes them explicitly).
+    from shadow_tpu.obs.memory import (
+        MemoryMonitor, modeled_shard_bytes, tree_bytes,
+    )
+
+    live_params = params if params is not None else c.engine._params
+    memmon = MemoryMonitor([jax.devices()[0]])
+    memmon.sample(modeled_bytes=modeled_shard_bytes(state, live_params))
     return {
+        "hbm": memmon.report(),
+        "state_bytes": tree_bytes(state),
         "leg": leg,
         "replicas": r_count,
         "rpc": rpc,
@@ -694,6 +707,22 @@ def measure_campaign(small: bool, wall_budget_s: float = 120.0) -> dict:
         "queue_occupancy_hwm": ens["queue_occupancy_hwm"],
         "outbox_send_hwm": ens["outbox_send_hwm"],
     })
+    if "hbm" in ens:
+        # R replicas multiply the state. Deliberately NOT under the
+        # `total_bytes` key other rows use (their figure is per-shard
+        # state+params from static_model; this one is the stacked
+        # replica-state total) — a shared key with different semantics
+        # would poison cross-row diffs in tools/bench_compare.py.
+        row["hbm"] = {
+            **ens["hbm"],
+            "model": {
+                "stacked_state_bytes": ens.get("state_bytes"),
+                "per_replica_state_bytes": (
+                    ens.get("state_bytes", 0) // max(r_count, 1)
+                ),
+                "replicas": r_count,
+            },
+        }
     ok_solos = [w for w in solos if "skipped" not in w]
     if ok_solos:
         # rate ratio over the measured solos (fair even when some solo
@@ -751,6 +780,26 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     from shadow_tpu.core.gears import GearController
     from shadow_tpu.core.pressure import PressureAbort, ResilienceController
     from shadow_tpu.core.supervisor import SupervisorAbort
+
+    # HBM observatory (obs/memory.py): per-shard live sampling folded
+    # into the BENCH row's `hbm` block — peak bytes per shard, the
+    # static model's predicted bytes, and headroom where the backend
+    # has an allocator limit (CPU backends fall back to the exact
+    # modeled live bytes, so the high-water is honest, never zero).
+    # Sampling is one memory_stats call + a metadata pytree walk per
+    # chunk — noise-floor cost; the per-rung compiled ledger is NOT
+    # computed here (it recompiles programs, which would perturb the
+    # measured window).
+    from shadow_tpu.obs.memory import (
+        MemoryMonitor, modeled_shard_bytes, static_model,
+    )
+
+    memmon = MemoryMonitor([jax.devices()[0]])
+
+    def _sample_memory(st):
+        memmon.sample(modeled_bytes=modeled_shard_bytes(
+            st, params, sim.engine_cfg.world
+        ))
 
     gearctl = GearController(sim._gear_ladder) if sim._gear_ladder else None
     # the shared snapshot-replay loop (core/pressure.py): gears and/or
@@ -827,6 +876,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     state = step(state)  # compile + first chunk (controller starts at top)
     compile_s = time.monotonic() - t0
     tracer.drain(state.trace, wall_t0=t0, wall_t1=time.monotonic())
+    _sample_memory(state)
     if gearctl is not None:
         # pre-warm the LOWER gear programs outside the timed window: the
         # controller reaches them only a few chunks in, and their
@@ -846,6 +896,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         t_c = time.monotonic()
         state = step(state)
         tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
+        _sample_memory(state)
         if time.monotonic() - t0 >= wall_budget_s:
             break
     wall = max(time.monotonic() - t0, 1e-9)
@@ -871,6 +922,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
             t_c = time.monotonic()
             state = step(state)
             tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
+            _sample_memory(state)
         wall = max(time.monotonic() - t0, 1e-9)
         sim_adv = int(state.now) / 1e9
         ev_adv = int(jax.device_get(state.stats.events).sum())
@@ -953,6 +1005,21 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         },
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
+        # HBM block (memory observatory): per-shard peak bytes + the
+        # static model's prediction + headroom — the BENCH/MULTICHIP
+        # telemetry ROADMAP item 1 asks for; tools/bench_compare.py
+        # diffs it across rounds
+        "hbm": {
+            **memmon.report(),
+            "model": {
+                k: v
+                for k, v in static_model(
+                    sim.engine_cfg, state, params
+                ).items()
+                if k in ("components", "state_bytes", "params_bytes",
+                         "total_bytes", "per_host_bytes")
+            },
+        },
         **({"aborted": True} if sup_aborted else {}),
         **({"pressure_aborted": True} if press_aborted else {}),
     }

@@ -402,6 +402,22 @@ class ObservabilityOptions:
     # jax.named_scope annotations (shadow_microsteps / shadow_exchange /
     # shadow_merge) labeling the hot regions. None = off.
     profile_dir: str | None = None
+    # HBM & capacity observatory (obs/memory.py + docs/architecture.md
+    # "Memory observatory"): sample device.memory_stats() per shard at
+    # chunk boundaries (per-shard HBM high-water; modeled fallback where
+    # the backend has no allocator stats), add the static byte model +
+    # live telemetry as a `memory{}` block to sim-stats, an `hbm=` field
+    # to heartbeat lines, gauges to the Prometheus export, and a
+    # wall-clock memory counter track to the Chrome trace. Pure host-side
+    # observer: NO traced code changes — digests and the compiled
+    # programs are byte-identical on or off.
+    memory: bool = False
+    # also compile-and-read `Compiled.memory_analysis()` for every chunk
+    # program the run's engine cached (the per-rung ledger in the
+    # memory{} block). Reading the analysis needs a fresh lower+compile
+    # per rung at report time — skip it on huge configs where recompiles
+    # hurt more than the ledger helps.
+    memory_ledger: bool = True
 
     @staticmethod
     def from_dict(d: dict[str, Any] | None) -> "ObservabilityOptions":
@@ -411,6 +427,8 @@ class ObservabilityOptions:
             trace_file=d.pop("trace_file", "trace.json"),
             metrics_file=d.pop("metrics_file", "metrics.prom"),
             profile_dir=d.pop("profile_dir", None),
+            memory=bool(d.pop("memory", False)),
+            memory_ledger=bool(d.pop("memory_ledger", True)),
         )
         # null disables an export; a non-null value must be a usable path
         # (str(None) would silently produce a file literally named "None")
@@ -465,6 +483,15 @@ class PressureOptions:
     # outbox once a chunk's send high-water FILLS the budget). 0
     # disables proactive regrow (escalation stays purely reactive).
     headroom: float = 0.85
+    # memory-informed escalation (obs/memory.py MemoryGuard): a candidate
+    # rung is refused BEFORE dispatch when its predicted extra footprint
+    # (static-model delta x the replay's snapshot+state concurrency) x
+    # this safety factor exceeds the device's MEASURED headroom
+    # (memory_stats bytes_limit - bytes_in_use) — replacing the
+    # OOM-round-trip discovery with a poisoned rung. Inert where no
+    # allocator limit is measurable (CPU backends) or until the first
+    # sample lands. >= 1.0.
+    memory_safety_factor: float = 1.25
 
     @property
     def active(self) -> bool:
@@ -479,6 +506,7 @@ class PressureOptions:
             max_outbox=int(d.pop("max_outbox", 0)),
             growth_factor=int(d.pop("growth_factor", 2)),
             headroom=float(d.pop("headroom", 0.85)),
+            memory_safety_factor=float(d.pop("memory_safety_factor", 1.25)),
         )
         if p.policy not in ("drop", "escalate", "abort"):
             raise ConfigError(
@@ -499,6 +527,12 @@ class PressureOptions:
             raise ConfigError(
                 f"pressure.headroom must be in [0, 1] (0 disables "
                 f"proactive regrow), got {p.headroom}"
+            )
+        if p.memory_safety_factor < 1.0:
+            raise ConfigError(
+                f"pressure.memory_safety_factor must be >= 1.0 (a factor "
+                f"below 1 would admit rungs past measured headroom), "
+                f"got {p.memory_safety_factor}"
             )
         if d:
             raise ConfigError(f"unknown pressure options: {sorted(d)}")
@@ -742,7 +776,11 @@ class CampaignOptions:
     # (null disables)
     ledger_file: str | None = "campaign-ledger.json"
     bisect: bool = True
-    # replica-count guard: a campaign multiplies state HBM by R
+    # replica-COUNT cap (cheap parse-time line of defense). The real HBM
+    # guard is memory-informed at build time: tools/campaign.py computes
+    # R x per-replica state bytes (obs/memory.py exact accounting)
+    # against the measured device capacity and rejects with the
+    # predicted numbers.
     max_replicas: int = 64
 
     @property

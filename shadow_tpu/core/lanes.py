@@ -169,6 +169,69 @@ STATE_LANES: dict[str, str] = {
 }
 
 # ---------------------------------------------------------------------------
+# Shape formulas for the registered SimState carry paths, consumed by the
+# memory observatory (shadow_tpu/obs/memory.py): dtype widths come from
+# STATE_LANES above, shapes from here, so the static HBM byte model has
+# exactly ONE source to drift from. Dimension tokens (resolved by the
+# observatory against a concrete EngineConfig):
+#
+#   H   hosts per shard (num_hosts / world)
+#   C   queue_capacity (per-host event slots)
+#   NB  bucket-cache blocks = C // queue_block (planes absent on flat
+#       queues — queue_block == 0 drops the queue.bt/bo/bfill entries)
+#   P   EVENT_PAYLOAD_WORDS (ops/events.py)
+#   SB  sends_per_host_round (outbox columns)
+#   S   the per-shard element of a [world]-sharded plane (always 1)
+#   R   trace_rounds (ring rows; plane absent when 0)
+#   F   len(TRACE_FIELDS) (obs/tracer.py ring columns)
+#
+# Integer entries are literal dimensions. Stage A stays jax-free: tokens
+# only, no imports. tests/test_memory.py asserts this dict covers
+# STATE_LANES exactly and that the formula bytes equal the real carry
+# leaves' bytes on built engine states (flat/bucketed x trace x pressure).
+# ---------------------------------------------------------------------------
+
+_STATS_PER_HOST = (
+    "events", "pkts_sent", "pkts_lost", "pkts_unreachable",
+    "pkts_codel_dropped", "pkts_delivered", "monotonic_violations",
+    "pkts_budget_dropped", "faults_dropped", "faults_delayed", "q_occ_hwm",
+)
+_STATS_PER_SHARD = (
+    "ob_dropped", "a2a_shed", "microsteps", "bq_rebuilds", "popk_deferred",
+    "ici_bytes", "outbox_hwm", "gear_shed", "pressure",
+)
+
+STATE_LANE_SHAPES: dict[str, tuple] = {
+    "now": (),
+    "done": (),
+    "queue.t": ("H", "C"),
+    "queue.order": ("H", "C"),
+    "queue.kind": ("H", "C"),
+    "queue.payload": ("H", "C", "P"),
+    "queue.dropped": ("H",),
+    "queue.bt": ("H", "NB"),
+    "queue.bo": ("H", "NB"),
+    "queue.bfill": ("H", "NB"),
+    "rng.s": ("H", 4),
+    "seq": ("H",),
+    "sent_round": ("H",),
+    "cpu_busy_until": ("H",),
+    "min_used_lat": (),
+    "outbox.dst": ("H", "SB"),
+    "outbox.t": ("H", "SB"),
+    "outbox.order": ("H", "SB"),
+    "outbox.kind": ("H", "SB"),
+    "outbox.payload": ("H", "SB", "P"),
+    "outbox.count": ("S",),
+    "trace.rows": ("S", "R", "F"),
+    "trace.cursor": ("S",),
+    **{f"stats.{f}": ("H",) for f in _STATS_PER_HOST},
+    **{f"stats.{f}": ("S",) for f in _STATS_PER_SHARD},
+    "stats.digest": ("H",),
+    "stats.rounds": (),
+}
+
+# ---------------------------------------------------------------------------
 # Stats fields that are deliberately NOT exported in sim-stats.json
 # (rule R3 requires every Stats field to be either read by
 # shadow_tpu/sim.py stats_report or listed here with a reason).

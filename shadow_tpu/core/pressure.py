@@ -110,6 +110,15 @@ class ResilienceController:
                   their NamedSharding specs; eager-op outputs keep
                   axis-0 sharding in simple cases but the specs are the
                   contract).
+      memory    — optional `obs.memory.MemoryGuard`: pre-dispatch
+                  admission for grown rungs. A candidate whose
+                  predicted extra footprint (static-model delta x
+                  replay concurrency x safety factor) exceeds the
+                  device's MEASURED headroom is refused/poisoned
+                  BEFORE dispatch — the escalation then corners into
+                  the same loud PressureAbort an OOM round-trip would
+                  have forced, minus the wasted compile+dispatch.
+                  Inert when no allocator limit is measurable.
 
     `run_chunk(state, dispatch, rounds0=None)` mirrors
     `run_adaptive_chunk`: dispatch(state, gear, capacity, budget) runs
@@ -125,12 +134,14 @@ class ResilienceController:
         queue_block: int = 0,
         reshard=None,
         log=None,
+        memory=None,
     ):
         self.gearctl = gearctl
         self.pressure = pressure
         self.queue_block = int(queue_block)
         self._reshard = reshard
         self._log = log
+        self.memory = memory  # obs.memory.MemoryGuard | None
         self.policy = pressure.policy if pressure is not None else "drop"
         self.escalate = self.policy == "escalate"
         self.abort_on_drop = self.policy == "abort"
@@ -146,6 +157,11 @@ class ResilienceController:
         self.proactive_regrows = 0  # headroom-driven boundary migrations
         self.replays = 0  # chunks replayed after a pressure abort
         self.oom_fallbacks = 0  # grown programs that OOM'd and fell back
+        self.memory_refusals = 0  # rungs the memory guard refused pre-dispatch
+        # last fully-refused proactive pick (cap, budget, headroom) — a
+        # near-limit run re-triggers the same pick every boundary, and
+        # an unchanged refusal must not re-count/re-log per chunk
+        self._proactive_refused: tuple | None = None
         self.aborted = False
         self.last_error: str | None = None
         self.ob_hwm_run = 0  # run-wide outbox high-water (per-chunk resets)
@@ -474,6 +490,7 @@ class ResilienceController:
             raise PressureAbort(
                 "pressure: drop detected but no growth axis identified"
             )
+        self._admit_or_corner(cap, budget, new_cap, new_budget)
         self.regrows += 1
         self.replays += 1
         self._say(
@@ -484,6 +501,85 @@ class ResilienceController:
         snap = snapshot_state(state)
         self._last_snap = snap
         return state, gear, new_cap, new_budget, snap
+
+    def _admit_or_corner(self, cap, budget, new_cap, new_budget):
+        """Memory-informed pre-dispatch admission (obs/memory.MemoryGuard):
+        a grown rung whose predicted footprint exceeds measured headroom
+        is poisoned BEFORE its compile+dispatch — and since every higher
+        rung needs strictly more bytes, a refusal corners the escalation
+        immediately, exactly as an exhausted ladder does. No-op without a
+        guard or without a measured allocator limit."""
+        if self.memory is None or (new_cap, new_budget) == (cap, budget):
+            return
+        ok, need, headroom = self.memory.admit(
+            cap, budget, new_cap, new_budget
+        )
+        if ok:
+            return
+        self.memory_refusals += 1
+        if new_cap != cap:
+            for rung in self._cap_ladder:
+                if rung >= new_cap:
+                    self._cap_poisoned.add(rung)
+        if new_budget != budget:
+            for rung in self._box_ladder:
+                if rung >= new_budget:
+                    self._box_poisoned.add(rung)
+        self.aborted = True
+        self.last_error = (
+            f"memory guard refused rung (cap={new_cap}, "
+            f"outbox={new_budget}) before dispatch: predicted need "
+            f"{need} bytes (static-model delta x replay concurrency x "
+            f"safety {self.memory.safety_factor}) exceeds measured "
+            f"headroom {headroom} bytes"
+        )
+        raise PressureAbort(f"pressure: cornered — {self.last_error}")
+
+    def _admitted_proactive(self, cap, budget, new_cap, new_budget):
+        """Proactive-growth admission. Unlike the reactive case (both
+        axes DROPPED, so partial growth would just drop again), a
+        proactive regrow is purely opportunistic — when the combined
+        growth does not fit measured headroom, each single axis is
+        retried alone, so an affordable queue-only (or outbox-only)
+        migration still happens instead of the run later eating a
+        reactive drop + replayed chunk. A full refusal just skips the
+        boundary regrow (nothing has dropped yet). Returns the admitted
+        shape."""
+        if self.memory is None or (new_cap, new_budget) == (cap, budget):
+            return new_cap, new_budget
+        candidates = [(new_cap, new_budget)]
+        for cand in ((new_cap, budget), (cap, new_budget)):
+            if cand != (cap, budget) and cand not in candidates:
+                candidates.append(cand)
+        need_all = headroom = None
+        for i, cand in enumerate(candidates):
+            ok, need, headroom = self.memory.admit(cap, budget, *cand)
+            if i == 0:
+                need_all = need  # the COMBINED requirement, for the log
+            if ok:
+                if cand != (new_cap, new_budget):
+                    self.memory_refusals += 1
+                    self._say(
+                        f"memory guard trimmed proactive regrow "
+                        f"(cap={new_cap}, outbox={new_budget}) -> "
+                        f"(cap={cand[0]}, outbox={cand[1]}): the combined "
+                        f"growth exceeds measured headroom"
+                    )
+                return cand
+        # full refusal. A near-limit run re-triggers the same proactive
+        # pick at EVERY boundary; count/log the refusal only when the
+        # situation changed (new shape, or headroom moved) so
+        # memory_refusals stays a decision count, not a chunk count.
+        key = (new_cap, new_budget, headroom)
+        if key != self._proactive_refused:
+            self._proactive_refused = key
+            self.memory_refusals += 1
+            self._say(
+                f"memory guard skipped proactive regrow to "
+                f"(cap={new_cap}, outbox={new_budget}): predicted need "
+                f"{need_all} bytes > measured headroom {headroom} bytes"
+            )
+        return cap, budget
 
     def _proactive(self, state, chunk_hwm: int):
         """Boundary regrow BEFORE anything drops: the always-on
@@ -510,6 +606,9 @@ class ResilienceController:
             up = self._next_rung(self._box_ladder, budget, self._box_poisoned)
             if up is not None:
                 new_budget = up
+        new_cap, new_budget = self._admitted_proactive(
+            cap, budget, new_cap, new_budget
+        )
         if (new_cap, new_budget) != (cap, budget):
             self.proactive_regrows += 1
             self._say(
@@ -552,6 +651,12 @@ class ResilienceController:
             "replays": self.replays,
             "oom_fallbacks": self.oom_fallbacks,
         }
+        if self.memory_refusals:
+            out["memory_refusals"] = self.memory_refusals
+        if self.memory is not None and self.memory.monitor is not None:
+            hb = self.memory.monitor.headroom_bytes()
+            if hb is not None:
+                out["headroom_bytes"] = hb
         if self._cap_ladder is not None:
             out["capacity_ladder"] = list(self._cap_ladder)
             out["outbox_ladder"] = list(self._box_ladder)

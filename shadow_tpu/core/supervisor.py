@@ -96,7 +96,22 @@ class ChunkSupervisor:
         save_fn=None,
         pre_dispatch_snapshot: bool = False,
         log=None,
+        memory=None,
+        memory_modeled_fn=None,
     ):
+        # optional obs.memory.MemoryMonitor: sampled at the moment a
+        # dispatch FAILS, so the retry log and report() pin each failure
+        # against the live HBM picture (an OOM-flavored failure with the
+        # allocator near its limit reads very differently from one with
+        # headroom to spare). `memory_modeled_fn` () -> int supplies the
+        # modeled per-shard bytes where the backend has no allocator
+        # stats (obs/memory.modeled_shard_bytes — metadata-only, so it
+        # is safe even when the failed dispatch consumed buffers by
+        # donation); without it a stat-less failure sample would record
+        # zeros and clobber the monitor's last-sample telemetry.
+        self.memory = memory
+        self._memory_modeled_fn = memory_modeled_fn
+        self.failure_memory: dict | None = None
         self.snapshot_every = max(int(snapshot_every_chunks), 1)
         self.max_retries = int(max_retries)
         # clamp: a negative base would make time.sleep raise mid-recovery
@@ -188,6 +203,19 @@ class ChunkSupervisor:
                     # handler see it instead
                     raise
                 self.last_error = f"{type(e).__name__}: {e}"
+                if self.memory is not None:
+                    try:
+                        modeled = (
+                            self._memory_modeled_fn()
+                            if self._memory_modeled_fn is not None else None
+                        )
+                        self.memory.sample(modeled_bytes=modeled)
+                        self.failure_memory = {
+                            "bytes_in_use": list(self.memory.last),
+                            "headroom_bytes": self.memory.headroom_bytes(),
+                        }
+                    except Exception:  # telemetry must never mask the
+                        pass  # failure being handled
                 attempt += 1
                 self.retries += 1
                 if attempt > self.max_retries:
@@ -288,4 +316,8 @@ class ChunkSupervisor:
             "aborted": self.aborted,
             **({"poisoned": True} if self.poisoned else {}),
             **({"last_error": self.last_error} if self.last_error else {}),
+            **(
+                {"failure_memory": self.failure_memory}
+                if self.failure_memory else {}
+            ),
         }

@@ -494,6 +494,18 @@ class HybridSimulation:
         self._gearctl = GearController(ladder) if ladder else None
         self._last_gear = None
         self._ob_hwm_run = 0
+        # HBM observatory (obs/memory.py): per-shard live sampling after
+        # each guarded device dispatch. Host-side observer only — the
+        # traced programs are byte-identical with this on or off.
+        self._memmon = None
+        if cfg.observability.memory:
+            from shadow_tpu.obs.memory import MemoryMonitor
+
+            devs = (
+                list(self.mesh.devices.flat) if self.mesh is not None
+                else [jax.devices()[0]]
+            )
+            self._memmon = MemoryMonitor(devs)
         self._clear_caps = jax.jit(_clear_caps, donate_argnums=0)
         # crash-resilient supervisor, per-dispatch mode: the CPU plane
         # advances between device dispatches and cannot roll back, so
@@ -513,6 +525,11 @@ class HybridSimulation:
                 backoff_base_s=cfg.faults.supervisor.backoff_base_ms / 1000.0,
                 pre_dispatch_snapshot=True,
                 log=sys.stderr,
+                memory=self._memmon,
+                memory_modeled_fn=(
+                    self._modeled_shard_bytes if self._memmon is not None
+                    else None
+                ),
             )
 
     # ---- egress staging ----------------------------------------------------
@@ -677,6 +694,13 @@ class HybridSimulation:
                     self.state.trace,
                     wall_t0=t_rounds, wall_t1=time.monotonic(),
                 )
+            if self._memmon is not None:
+                t_s = time.monotonic()
+                shard_bytes = self._memmon.sample(
+                    modeled_bytes=self._modeled_shard_bytes(), wall_t=t_s
+                )
+                if self._tracer is not None:
+                    self._tracer.note_memory(t_s, shard_bytes)
             with self.perf.time("drain_captures"):
                 self._drain_captures()
             windows += 1
@@ -699,11 +723,16 @@ class HybridSimulation:
                         f"{int(np.asarray(_s.faults_dropped).sum())}/"
                         f"{int(np.asarray(_s.faults_delayed).sum())} "
                     )
+                hbm_f = (
+                    f"hbm={self._memmon.hwm_bytes()} "
+                    if self._memmon is not None else ""
+                )
                 print(
                     f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s windows={windows} "
                     f"{fault_f}"
                     f"{gear_f}"
+                    f"{hbm_f}"
                     f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                     f"{simmod.resource_heartbeat()}",
                     file=log,
@@ -946,6 +975,15 @@ class HybridSimulation:
             for k in dead:  # lost to device-side drop (loss/budget/codel)
                 del store[k]
 
+    def _modeled_shard_bytes(self) -> int:
+        """The memory monitor's modeled fallback where the backend
+        reports no allocator stats (obs/memory.py owns the formula)."""
+        from shadow_tpu.obs.memory import modeled_shard_bytes
+
+        return modeled_shard_bytes(
+            self.state, self.params, self.engine_cfg.world
+        )
+
     # ---- outputs -----------------------------------------------------------
 
     def stats_report(self) -> dict:
@@ -1044,7 +1082,20 @@ class HybridSimulation:
                 if self._tracer is not None
                 else {}
             ),
+            **(
+                {"memory": self._memory_report()}
+                if self._memmon is not None
+                else {}
+            ),
         }
+
+    def _memory_report(self) -> dict:
+        from shadow_tpu.obs.memory import observatory_report
+
+        return observatory_report(
+            self.engine, self.state, self.params, self._memmon,
+            ledger=self.cfg.observability.memory_ledger,
+        )
 
     def write_outputs(self, data_dir: str | None = None, report: dict | None = None) -> str:
         data_dir = data_dir or self.cfg.general.data_directory

@@ -11,8 +11,11 @@ from shadow_tpu.obs.strace import StraceLogger
 from shadow_tpu.obs.perf import PerfTimers
 from shadow_tpu.obs.simlog import SimLogger, format_sim_time
 from shadow_tpu.obs.tracer import ReplicaTracer, RoundTracer, TraceRing
+from shadow_tpu.obs.memory import MemoryGuard, MemoryMonitor
 
 __all__ = [
+    "MemoryGuard",
+    "MemoryMonitor",
     "PcapWriter",
     "PerfTimers",
     "ReplicaTracer",

@@ -130,6 +130,10 @@ class RoundTracer:
         self._chunks: list[dict] = []  # wall spans paired with round counts
         self._rows: list[np.ndarray] = []  # [world, n, F] per drain
         self._wall0: float | None = None  # wall origin for the trace
+        # wall-clock HBM samples (obs/memory.py MemoryMonitor, sampled at
+        # chunk boundaries): (wall_t, (per-shard bytes,)) — exported as a
+        # counter track on the wall-clock timeline + Prometheus gauges
+        self._memory: list[tuple[float, tuple[int, ...]]] = []
 
     # ---- collection --------------------------------------------------------
 
@@ -208,6 +212,13 @@ class RoundTracer:
                     break
         return dropped
 
+    def note_memory(self, wall_t: float, per_shard_bytes) -> None:
+        """Record one live-memory sample (per-shard bytes_in_use) against
+        the wall clock. Pure observation — feeds only the exporters."""
+        self._memory.append(
+            (float(wall_t), tuple(int(b) for b in per_shard_bytes))
+        )
+
     @property
     def rounds(self) -> int:
         return self._cursor - self._origin - self.lost
@@ -275,6 +286,17 @@ class RoundTracer:
                 "dur": max((c["t1"] - c["t0"]) * 1e6, 1.0),
                 "pid": 2, "tid": 1,
                 "args": {"rounds": c["rounds"]},
+            })
+        # wall-clock HBM counter track (obs/memory.py samples): Chrome's
+        # "C" events render a stacked per-shard area under the chunk track
+        if self._memory and self._wall0 is None:
+            self._wall0 = self._memory[0][0]
+        for t, shards in self._memory:
+            ev.append({
+                "name": "hbm_bytes", "cat": "memory", "ph": "C",
+                "ts": (t - (self._wall0 or 0.0)) * 1e6,
+                "pid": 2, "tid": 1,
+                "args": {f"shard{s}": b for s, b in enumerate(shards)},
             })
         return {
             "traceEvents": ev,
@@ -395,6 +417,25 @@ class RoundTracer:
                "events/packets delayed by injected faults")
         metric("hosts_down_max", "gauge", t["hosts_down_max"],
                "max hosts simultaneously inside a crash window")
+        if self._memory:
+            last = self._memory[-1][1]
+            peak = [
+                max(s[i] for _, s in self._memory)
+                for i in range(len(last))
+            ]
+            metric("hbm_bytes_in_use", "gauge", max(last),
+                   "per-shard live bytes at the last memory sample (max)")
+            metric("hbm_peak_bytes", "gauge", max(peak),
+                   "per-shard HBM high-water across the run (max)")
+            for s in range(len(last)):
+                lines.append(
+                    f'shadow_tpu_shard_hbm_bytes_in_use{{shard="{s}"}} '
+                    f"{last[s]}"
+                )
+                lines.append(
+                    f'shadow_tpu_shard_hbm_peak_bytes{{shard="{s}"}} '
+                    f"{peak[s]}"
+                )
         if rows.shape[1] > 0:
             metric("sim_time_ns", "gauge",
                    int(rows[0, -1, COL_WINDOW_END]),

@@ -321,7 +321,9 @@ def clear_popped(q, popped: PoppedK, m):
         order=new_order,
         bt=jnp.where(touched, nbt, q.bt),
         bo=jnp.where(touched, nbo, q.bo),
-        bfill=q.bfill - jnp.sum(cleared3.astype(jnp.int32), axis=2),
+        # dtype pinned (see block_minima): sum promotion must not widen
+        # the i32 cache
+        bfill=q.bfill - jnp.sum(cleared3, axis=2, dtype=jnp.int32),
     )
 
 
@@ -464,7 +466,11 @@ def block_minima(t, order, num_blocks: int):
     o3 = order.reshape(h, num_blocks, b)
     bt = jnp.min(t3, axis=2)
     bo = jnp.min(jnp.where(t3 == bt[:, :, None], o3, ORDER_MAX), axis=2)
-    bfill = jnp.sum((t3 != TIME_MAX).astype(jnp.int32), axis=2)
+    # dtype pinned: numpy-style sum promotion would widen the i32 bfill
+    # cache to i64 (the registry drift the memory observatory surfaced —
+    # lanes.py registers queue.bfill as int32 and the byte model charges it
+    # as such)
+    bfill = jnp.sum(t3 != TIME_MAX, axis=2, dtype=jnp.int32)
     return bt, bo, bfill
 
 

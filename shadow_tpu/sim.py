@@ -352,19 +352,23 @@ def heartbeat_line(
     fault: tuple[int, int] | None = None,
     gear: int | None = None,
     cap: int | None = None,
+    hbm: int | None = None,
     rep: tuple[int, int] | None = None,
 ) -> str:
     """The `[heartbeat]` progress line, shared by the Simulation run loop
     and the campaign driver so tools/parse_shadow.py has ONE format to
     track. Optional fields ride along in a fixed order (faults, gear,
-    cap, rep, then ratio); lines without them are byte-identical to the
-    older formats, which the parser keeps reading (gated by literal-line
-    tests). `cap` is the ACTIVE per-host queue capacity on pressure-plane
-    runs (escalation regrows it mid-run); `rep` is (replicas done, total)
-    on ensemble campaign runs."""
+    cap, hbm, rep, then ratio); lines without them are byte-identical to
+    the older formats, which the parser keeps reading (gated by
+    literal-line tests). `cap` is the ACTIVE per-host queue capacity on
+    pressure-plane runs (escalation regrows it mid-run); `hbm` is the
+    per-shard HBM high-water in bytes (memory observatory runs —
+    obs/memory.py, the reference's per-host allocated-memory heartbeat);
+    `rep` is (replicas done, total) on ensemble campaign runs."""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
     gear_f = f"gear={gear} " if gear is not None else ""
     cap_f = f"cap={cap} " if cap is not None else ""
+    hbm_f = f"hbm={hbm} " if hbm is not None else ""
     rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
     return (
         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
@@ -376,6 +380,7 @@ def heartbeat_line(
         f"{fault_f}"
         f"{gear_f}"
         f"{cap_f}"
+        f"{hbm_f}"
         f"{rep_f}"
         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
         f"{resource_heartbeat()}"
@@ -628,6 +633,25 @@ class Simulation:
         if profiling:
             os.makedirs(cfg.observability.profile_dir, exist_ok=True)
             jax.profiler.start_trace(cfg.observability.profile_dir)
+        monitor = None
+        if cfg.observability.memory:
+            # HBM observatory (obs/memory.py): per-shard live sampling at
+            # chunk boundaries. Host-side observer only — the traced
+            # programs are byte-identical with this on or off.
+            from shadow_tpu.obs.memory import MemoryMonitor, modeled_shard_bytes
+
+            devs = (
+                list(self.engine.mesh.devices.flat)
+                if self.engine.mesh is not None
+                else [jax.devices()[0]]
+            )
+            monitor = MemoryMonitor(devs)
+            self._memmon = monitor
+            # the modeled fallback, recomputed per sample: escalation
+            # regrows the state's shapes mid-run
+            self._modeled_shard_bytes = lambda: modeled_shard_bytes(
+                self.state, self.params, self.engine_cfg.world
+            )
         gearctl = None
         resilience = None
         pressure_on = cfg.pressure.active
@@ -657,12 +681,24 @@ class Simulation:
                     self.engine.state_specs(),
                 )
                 reshard = lambda st: jax.device_put(st, specs)  # noqa: E731
+            memguard = None
+            if pressure_on and monitor is not None:
+                # memory-informed escalation: predicted-vs-measured rung
+                # admission BEFORE dispatch (obs/memory.py MemoryGuard;
+                # inert until a sample measures an allocator limit)
+                from shadow_tpu.obs.memory import MemoryGuard
+
+                memguard = MemoryGuard(
+                    self.engine_cfg, monitor,
+                    safety_factor=cfg.pressure.memory_safety_factor,
+                )
             resilience = ResilienceController(
                 gearctl=gearctl,
                 pressure=cfg.pressure if pressure_on else None,
                 queue_block=self.engine_cfg.queue_block,
                 reshard=reshard,
                 log=log,
+                memory=memguard,
             )
             self._pressctl = resilience if pressure_on else None
         sup = None
@@ -700,6 +736,11 @@ class Simulation:
                 checkpoint_path=ckpt,
                 save_fn=_save if ckpt else None,
                 log=log,
+                memory=monitor,
+                memory_modeled_fn=(
+                    self._modeled_shard_bytes if monitor is not None
+                    else None
+                ),
             )
             self._supervisor = sup
             sup.note_state(self.state)
@@ -789,6 +830,14 @@ class Simulation:
                         self.state.trace,
                         wall_t0=t_chunk, wall_t1=time.monotonic(),
                     )
+                if monitor is not None:
+                    t_s = time.monotonic()
+                    shard_bytes = monitor.sample(
+                        modeled_bytes=self._modeled_shard_bytes(),
+                        wall_t=t_s,
+                    )
+                    if tracer is not None:
+                        tracer.note_memory(t_s, shard_bytes)
                 chunks += 1
                 now_ns = int(self.state.now)
                 wall = time.monotonic() - t0
@@ -816,10 +865,15 @@ class Simulation:
                         self.state.queue.t.shape[1]
                         if pressure_on else None
                     )
+                    # hbm= rides along only on memory-observatory runs:
+                    # the per-shard HBM high-water so far (bytes)
+                    hbm = (
+                        monitor.hwm_bytes() if monitor is not None else None
+                    )
                     print(
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
-                            fault=fault, gear=last_gear, cap=cap,
+                            fault=fault, gear=last_gear, cap=cap, hbm=hbm,
                         ),
                         file=log,
                     )
@@ -983,6 +1037,16 @@ class Simulation:
             if getattr(self, "_pressure_aborted", False):
                 report["pressure_aborted"] = True
                 report["aborted"] = True
+        memmon = getattr(self, "_memmon", None)
+        if memmon is not None:
+            # HBM observatory block (obs/memory.py): static byte model +
+            # per-rung compiled ledger + per-shard live high-water
+            from shadow_tpu.obs.memory import observatory_report
+
+            report["memory"] = observatory_report(
+                self.engine, self.state, self.params, memmon,
+                ledger=self.cfg.observability.memory_ledger,
+            )
         sup = getattr(self, "_supervisor", None)
         if sup is not None:
             report["supervisor"] = sup.report()

@@ -265,10 +265,12 @@ def test_engine_digest_flat_vs_bucketed(model, hosts, stop, kw):
 def test_checkpoint_roundtrip_bucketed(tmp_path):
     """Checkpoint restore is a cache-rebuild point: a bucketed sim resumed
     from a snapshot must finish with the same digest as an uninterrupted
-    run, and a flat-queue checkpoint must not restore into a bucketed sim
-    (different engine config => guard refuses). Runs in a subprocess
-    (tests/subproc.py): this is a compiled-Simulation leg, the shape that
-    intermittently heap-corrupts in-process on this box."""
+    run. A different BLOCK size is a capacity shape since the pressure
+    plane's cross-capacity restore (PR 8): the load migrates and the
+    resumed run still matches; only a layout-KIND change (bucketed ->
+    flat) refuses. Runs in a subprocess (tests/subproc.py): this is a
+    compiled-Simulation leg, the shape that intermittently heap-corrupts
+    in-process on this box."""
     from tests.subproc import run_isolated_json
 
     out = run_isolated_json('''
@@ -327,14 +329,23 @@ assert (np.asarray(q.bfill) == np.asarray(bfill)).all()
 c.run(progress=False)
 digest_c = c.stats_report()["determinism_digest"]
 
-d = Simulation(cfg(block=8), world=1)  # different layout: refuse loudly
+# a different BLOCK size migrates (capacity shape, PR 8) and the resumed
+# run must still land on the uninterrupted digest
+d = Simulation(cfg(block=8), world=1)
+load_checkpoint(ckpt, d)
+d.run(progress=False)
+digest_d = d.stats_report()["determinism_digest"]
+
+# a layout-KIND change (bucketed checkpoint -> flat sim) refuses loudly
+e = Simulation(cfg(block=0), world=1)
 refused = False
 try:
-    load_checkpoint(ckpt, d)
+    load_checkpoint(ckpt, e)
 except CheckpointError:
     refused = True
 print(json.dumps({"digest_a": digest_a, "digest_c": digest_c,
-                  "refused": refused}))
+                  "digest_d": digest_d, "refused": refused}))
 ''', str(tmp_path / "bq.npz"))
     assert out["digest_c"] == out["digest_a"]
+    assert out["digest_d"] == out["digest_a"]
     assert out["refused"]

@@ -55,21 +55,45 @@ def test_alltoall_exchange_matches_gather():
 
 def test_alltoall_exchange_tgen_tcp_mesh_invariant():
     """The TCP workload (bursty, retransmitting) over the all-to-all
-    exchange stays bit-identical to the single-device run."""
-    hosts = mk_hosts(8, {"flow_segs": 24, "flows": 2, "cwnd_cap": 8,
-                         "rto_min": "100 ms"})
-    stop = 20_000_000_000
-    _, s1, r1 = run_sim(
-        "tgen_tcp", hosts, stop, world=1, loss=0.05, latency=10_000_000,
-        sends_budget=24, qcap=64,
-    )
-    _, sa, ra = run_sim(
-        "tgen_tcp", hosts, stop, world=8, loss=0.05, latency=10_000_000,
-        sends_budget=24, qcap=64, exchange="alltoall",
-    )
-    assert np.array_equal(np.asarray(s1.digest), np.asarray(sa.digest))
-    assert int(np.asarray(sa.a2a_shed).sum()) == 0
-    assert r1 == ra
+    exchange stays bit-identical to the single-device run.
+
+    Subprocess-isolated (tests/subproc.py): this is THE tier-1
+    process-killer on this box — PR 7/8/9 all measured whole-suite runs
+    segfaulting at exactly this leg (the documented jaxlib-0.4.37
+    corruption, re-verified on unmodified HEAD each time), which turned
+    one environment flake into DOTS_PASSED=0 for the entire gate. In a
+    subprocess the corruption signature classifies as a skip (with
+    retry + evidence) instead of killing pytest; a real divergence
+    still fails loudly — the child's asserts surface as an ordinary
+    rc=1 with output, which run_isolated never masks."""
+    from tests.subproc import run_isolated_json
+
+    out = run_isolated_json('''
+import json
+import numpy as np
+from tests.engine_harness import mk_hosts, run_sim
+
+hosts = mk_hosts(8, {"flow_segs": 24, "flows": 2, "cwnd_cap": 8,
+                     "rto_min": "100 ms"})
+stop = 20_000_000_000
+_, s1, r1 = run_sim(
+    "tgen_tcp", hosts, stop, world=1, loss=0.05, latency=10_000_000,
+    sends_budget=24, qcap=64,
+)
+_, sa, ra = run_sim(
+    "tgen_tcp", hosts, stop, world=8, loss=0.05, latency=10_000_000,
+    sends_budget=24, qcap=64, exchange="alltoall",
+)
+print(json.dumps({
+    "digest_equal": bool(np.array_equal(np.asarray(s1.digest),
+                                        np.asarray(sa.digest))),
+    "a2a_shed": int(np.asarray(sa.a2a_shed).sum()),
+    "report_equal": r1 == ra,
+}))
+''', timeout=560)
+    assert out["digest_equal"]
+    assert out["a2a_shed"] == 0
+    assert out["report_equal"]
 
 
 def test_sharding_invariance_under_shaping_and_codel():

@@ -1,0 +1,952 @@
+"""HBM & capacity observatory (shadow_tpu/obs/memory.py, PR 9).
+
+Gates, mirroring the ISSUE acceptance:
+  - the static byte model is single-source: STATE_LANE_SHAPES covers
+    STATE_LANES exactly, and every registered plane's formula bytes
+    EQUAL the live carry leaf's bytes across flat/bucketed x trace x
+    pressure shapes;
+  - static-model totals agree with `Compiled.memory_analysis()` within
+    tolerance on echo+phold CPU configs (and with jax.eval_shape avals
+    exactly, via resized_avals);
+  - observer exactness: digests/events/drops are bit-identical with the
+    observatory sampling interleaved vs absent, across models x queue
+    layouts x K x world (the observatory adds NO traced code — the
+    jaxpr fingerprint gate in tools/lint pins the stronger program-level
+    claim);
+  - the pressure plane refuses a predicted-OOM rung BEFORE dispatch
+    (fake memory_stats), and admits growth when headroom suffices;
+  - tools/hbm_report.py CLI smoke (+ --check), subprocess-isolated per
+    the documented jaxlib-0.4.37 corruption posture;
+  - heartbeat `hbm=` round-trips through parse_shadow --strict.
+
+Engine-harness legs run in-process (the stable path on this box);
+compiled-Simulation legs go through tests/subproc.py."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.config.options import ConfigError, PressureOptions
+from shadow_tpu.core import Engine
+from shadow_tpu.core import lanes
+from shadow_tpu.core.pressure import PressureAbort, ResilienceController
+from shadow_tpu.obs import memory as M
+from tests.engine_harness import build_sim, mk_hosts
+
+MS = 1_000_000
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _build(model, hosts, stop, pressure_abort=False, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, rounds_per_chunk=16, **kw
+    )
+    if pressure_abort:
+        cfg = dataclasses.replace(cfg, pressure_abort=True)
+    mesh = None
+    if cfg.world > 1:
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[: cfg.world]), ("hosts",)
+        )
+    eng = Engine(cfg, m, mesh)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    return cfg, eng, state, params
+
+
+def _leaf_at(state, path):
+    obj = state
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# static model: single-source registry coverage + formula == carry bytes
+# ---------------------------------------------------------------------------
+
+
+def test_shape_registry_covers_state_lanes_exactly():
+    assert set(lanes.STATE_LANE_SHAPES) == set(lanes.STATE_LANES), (
+        "STATE_LANE_SHAPES and STATE_LANES must cover the same paths — "
+        "the byte model has exactly one source to drift from"
+    )
+
+
+@pytest.mark.parametrize(
+    "queue_block,trace,pressure",
+    [(0, 0, False), (8, 16, False), (0, 16, True), (8, 0, True)],
+    ids=["flat", "bucketed+trace", "flat+trace+pressure", "bucketed+pressure"],
+)
+def test_formula_bytes_equal_carry_leaves(queue_block, trace, pressure):
+    """Every registered plane's formula bytes == the live carry leaf's
+    bytes (exact, not tolerance): the strong single-source gate."""
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=32, queue_block=queue_block,
+        trace_rounds=trace, pressure_abort=pressure,
+    )
+    dims = M.dims_of_config(cfg)
+    comps = M.registered_component_bytes(dims)
+    seen = set()
+    for comp, paths in comps.items():
+        for path, want in paths.items():
+            leaf = _leaf_at(state, path)
+            assert M.leaf_nbytes(leaf) == want, (
+                f"{path}: formula {want} != leaf {M.leaf_nbytes(leaf)} "
+                f"({leaf.shape} {leaf.dtype})"
+            )
+            seen.add(path)
+    # absent-plane logic: bucket caches only on bucketed queues, trace
+    # ring only when tracing, stats.pressure only under escalate/abort
+    assert ("queue.bt" in seen) == bool(queue_block)
+    assert ("trace.rows" in seen) == bool(trace)
+    assert ("stats.pressure" in seen) == pressure
+
+
+def test_static_model_totals_and_per_host():
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    sm = M.static_model(cfg, state, params)
+    # measured state total (metadata walk) must equal registered formula
+    # total + the unregistered planes it reports
+    assert sm["state_bytes"] == sm["registered_bytes"] + sum(
+        sm["unregistered"].values()
+    )
+    assert sm["state_bytes_measured"] == sm["state_bytes"]
+    assert sm["total_bytes"] == sm["state_bytes"] + sm["params_bytes"]
+    assert sm["per_host_bytes"] * cfg.num_hosts <= sm["total_bytes"]
+    # replica scaling multiplies state, not params
+    sm4 = M.static_model(cfg, state, params, replicas=4)
+    assert sm4["state_bytes"] == 4 * sm["state_bytes"]
+    assert sm4["params_bytes"] == sm["params_bytes"]
+
+
+def test_static_model_follows_grown_state():
+    """After an escalation regrow the model prices the state's ACTUAL
+    shapes (dims_of_state), not the config's base — measured and
+    formula totals stay equal."""
+    from shadow_tpu.ops.events import migrate_queue
+
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    grown = state._replace(
+        queue=migrate_queue(state.queue, 32, cfg.queue_block)
+    )
+    sm = M.static_model(cfg, grown, params)
+    assert sm["state_bytes"] == sm["state_bytes_measured"]
+    dims32 = M.dims_of(
+        hosts_per_shard=cfg.hosts_per_shard, queue_capacity=32,
+        send_budget=cfg.sends_per_host_round,
+    )
+    assert sm["components"]["queue"] == sum(
+        M.registered_component_bytes(dims32)["queue"].values()
+    )
+
+
+def test_state_bytes_at_scales_with_shape():
+    cfg, *_ = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    base = M.state_bytes_at(cfg, 16, cfg.sends_per_host_round)
+    grown_q = M.state_bytes_at(cfg, 32, cfg.sends_per_host_round)
+    grown_b = M.state_bytes_at(cfg, 16, 2 * cfg.sends_per_host_round)
+    assert grown_q > base and grown_b > base
+    # queue growth delta is exactly the queue planes' delta
+    dims16 = M.dims_of(hosts_per_shard=cfg.hosts_per_shard,
+                       queue_capacity=16, send_budget=cfg.sends_per_host_round)
+    dims32 = M.dims_of(hosts_per_shard=cfg.hosts_per_shard,
+                       queue_capacity=32, send_budget=cfg.sends_per_host_round)
+    dq = (
+        sum(M.registered_component_bytes(dims32)["queue"].values())
+        - sum(M.registered_component_bytes(dims16)["queue"].values())
+    )
+    assert grown_q - base == dq
+
+
+# ---------------------------------------------------------------------------
+# compiled ledger: memory_analysis + eval_shape agreement
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case", ["echo", "phold"])
+def test_static_model_vs_memory_analysis(case):
+    """ISSUE acceptance: the static-model total agrees with
+    `Compiled.memory_analysis()` argument bytes within the documented
+    tolerance (10% — XLA pads/aligns, the model counts raw lanes) on
+    echo+phold CPU configs."""
+    if case == "echo":
+        hosts = (
+            [dict(host_id=0, name="server", start_time=0,
+                  model_args={"role": "server"})]
+            + [dict(host_id=i, name=f"c{i}", start_time=0,
+                    model_args={"role": "client", "peer": "server",
+                                "interval": "20 ms", "size_bytes": 256})
+               for i in range(1, 5)]
+        )
+        cfg, eng, state, params = _build("udp_echo", hosts, 200_000_000,
+                                         qcap=16)
+    else:
+        cfg, eng, state, params = _build(
+            "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+            200_000_000, qcap=16,
+        )
+    led = M.compiled_ledger(eng, state, params)
+    base = led["base"]
+    assert "argument_bytes" in base, base
+    sm = M.static_model(cfg, state, params)
+    rel = abs(sm["total_bytes"] - base["argument_bytes"]) / base[
+        "argument_bytes"
+    ]
+    assert rel < 0.10, (sm["total_bytes"], base)
+    # peak decomposition present and sane
+    assert base["peak_bytes"] >= base["temp_bytes"]
+
+
+def test_resized_avals_match_formula_delta():
+    """`resized_avals` (jax.eval_shape through the real migration ops)
+    re-seats the state at a grown shape whose registered-plane bytes
+    match the formula at that shape exactly."""
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    avals = M.resized_avals(state, 32, 16, cfg.queue_block)
+    dims = M.dims_of(
+        hosts_per_shard=cfg.hosts_per_shard, queue_capacity=32,
+        send_budget=16, queue_block=cfg.queue_block,
+        trace_rounds=cfg.trace_rounds, pressure=cfg.pressure_abort,
+    )
+    comps = M.registered_component_bytes(dims)
+    for path, want in {**comps["queue"], **comps["outbox"]}.items():
+        assert M.leaf_nbytes(_leaf_at(avals, path)) == want, path
+
+
+def test_ledger_covers_cached_rungs():
+    """After run_chunk_resized compiled a rung, the ledger reports it
+    (lowered at ITS shape) alongside the base program."""
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    from shadow_tpu.core.checkpoint import snapshot_state
+    from shadow_tpu.ops.events import migrate_queue
+
+    grown = snapshot_state(state)._replace(
+        queue=migrate_queue(state.queue, 32, cfg.queue_block)
+    )
+    out = eng.run_chunk_resized(grown, params, 0, 32, cfg.sends_per_host_round)
+    jax.block_until_ready(out)
+    led = M.compiled_ledger(eng, state, params)
+    keys = set(led)
+    assert "base" in keys
+    rung = [k for k in keys if k.startswith("cap=32/")]
+    assert rung, keys
+    assert "argument_bytes" in led[rung[0]]
+    # the grown rung's arguments are strictly bigger than the base's
+    assert led[rung[0]]["argument_bytes"] > led["base"]["argument_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# observer exactness: sampling cannot move a digest
+# ---------------------------------------------------------------------------
+
+_ECHO_HOSTS = (
+    [dict(host_id=0, name="server", start_time=0,
+          model_args={"role": "server"})]
+    + [dict(host_id=i, name=f"c{i}", start_time=0,
+            model_args={"role": "client", "peer": "server",
+                        "interval": "4 ms", "size_bytes": 2000})
+       for i in range(1, 5)]
+)
+
+_OBS_CASES = {
+    # pairwise coverage of model x layout x K (the observatory is
+    # host-side only, so the property is structural; world=8 below)
+    "echo-flat-k1": ("udp_echo", _ECHO_HOSTS, 200_000_000,
+                     dict(bw_bits=2_000_000, loss=0.05)),
+    "echo-bucketed-k4": ("udp_echo", _ECHO_HOSTS, 200_000_000,
+                         dict(bw_bits=2_000_000, loss=0.05,
+                              queue_block=8, microstep_events=4)),
+    "phold-bucketed-k1": ("phold",
+                          mk_hosts(8, {"mean_delay": "20 ms",
+                                       "population": 3}),
+                          300_000_000, dict(loss=0.1, queue_block=8)),
+    "phold-flat-k4": ("phold",
+                      mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+                      300_000_000, dict(loss=0.1, microstep_events=4)),
+    "tgen-flat-k1": ("tgen_tcp",
+                     mk_hosts(5, {"flow_segs": 8, "flows": 1, "cwnd_cap": 8,
+                                  "rto_min": "100 ms"}),
+                     1_500_000_000,
+                     dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+    "tgen-bucketed-k4": ("tgen_tcp",
+                         mk_hosts(5, {"flow_segs": 8, "flows": 1,
+                                      "cwnd_cap": 8, "rto_min": "100 ms"}),
+                         1_500_000_000,
+                         dict(loss=0.05, latency=10_000_000,
+                              sends_budget=16, queue_block=8,
+                              microstep_events=4)),
+}
+
+
+def _run_engine(model, hosts, stop, monitor=None, world=1, **kw):
+    cfg, eng, state, params = _build(model, hosts, stop, world=world, **kw)
+    chunks = 0
+    while not bool(np.asarray(jax.device_get(state.done)).all()):
+        state = eng.run_chunk(state, params)
+        if monitor is not None:
+            # the full observatory surface between chunks: live sample
+            # (modeled fallback), static model, shape predictor
+            jax.block_until_ready(state)
+            monitor.sample(modeled_bytes=(
+                M.tree_bytes(state) + M.tree_bytes(params)
+            ) // cfg.world)
+            M.static_model(cfg, state, params)
+            M.state_bytes_at(cfg, 2 * cfg.queue_capacity,
+                             cfg.sends_per_host_round)
+        chunks += 1
+        assert chunks < 500
+    s = jax.device_get(state.stats)
+    drops = (
+        int(np.asarray(jax.device_get(state.queue.dropped)).sum()),
+        int(np.asarray(s.pkts_budget_dropped).sum()),
+        int(np.asarray(s.pkts_lost).sum()),
+        int(np.asarray(s.ob_dropped).sum()),
+        int(np.asarray(s.a2a_shed).sum()),
+    )
+    return (
+        np.asarray(s.digest).copy(),
+        int(np.asarray(s.events).sum()),
+        drops,
+        monitor,
+    )
+
+
+@pytest.mark.parametrize("case", sorted(_OBS_CASES), ids=sorted(_OBS_CASES))
+def test_observer_exactness(case):
+    model, hosts, stop, kw = _OBS_CASES[case]
+    d0, ev0, drops0, _ = _run_engine(model, hosts, stop, monitor=None, **kw)
+    mon = M.MemoryMonitor([jax.devices()[0]])
+    d1, ev1, drops1, mon = _run_engine(
+        model, hosts, stop, monitor=mon, **kw
+    )
+    np.testing.assert_array_equal(d0, d1)
+    assert ev0 == ev1 and drops0 == drops1
+    assert mon.count > 0 and mon.hwm_bytes() > 0
+    assert mon.source == "modeled"  # CPU backend has no allocator stats
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_observer_exactness_world8():
+    hosts = mk_hosts(16, {"mean_delay": "20 ms", "population": 3})
+    kw = dict(loss=0.1, queue_block=8, microstep_events=4)
+    d0, ev0, drops0, _ = _run_engine(
+        "phold", hosts, 300_000_000, monitor=None, world=8, **kw
+    )
+    mon = M.MemoryMonitor(list(jax.devices()[:8]))
+    d1, ev1, drops1, mon = _run_engine(
+        "phold", hosts, 300_000_000, monitor=mon, world=8, **kw
+    )
+    np.testing.assert_array_equal(d0, d1)
+    assert ev0 == ev1 and drops0 == drops1
+    assert len(mon.peak) == 8 and all(p > 0 for p in mon.peak)
+
+
+# ---------------------------------------------------------------------------
+# live monitor + guard units (fake memory_stats)
+# ---------------------------------------------------------------------------
+
+
+def _fake_stats(used, limit):
+    return lambda d: {
+        "bytes_in_use": used, "peak_bytes_in_use": used,
+        "bytes_limit": limit,
+    }
+
+
+def test_monitor_device_source_and_headroom():
+    mon = M.MemoryMonitor(
+        devices=[object()], stats_fn=_fake_stats(600, 1000)
+    )
+    mon.sample()
+    assert mon.source == "device"
+    assert mon.headroom_bytes() == 400
+    assert mon.hwm_bytes() == 600
+    rep = mon.report()
+    assert rep["limit_bytes"] == 1000 and rep["headroom_bytes"] == 400
+
+
+def test_monitor_modeled_fallback():
+    mon = M.MemoryMonitor(devices=[object()], stats_fn=lambda d: None)
+    mon.sample(modeled_bytes=1234)
+    assert mon.source == "modeled"
+    assert mon.headroom_bytes() is None  # no limit -> guard inert
+    assert mon.hwm_bytes() == 1234
+
+
+def test_guard_admit_math():
+    cfg, *_ = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    need = M.MemoryGuard(cfg, None).predicted_need_bytes(16, 8, 32, 8)
+    delta = M.state_bytes_at(cfg, 32, 8) - M.state_bytes_at(cfg, 16, 8)
+    assert need == int(delta * 2 * 1.25)
+    # no monitor / no limit: admit everything
+    ok, _, headroom = M.MemoryGuard(cfg, None).admit(16, 8, 32, 8)
+    assert ok and headroom is None
+    # tight measured headroom: refuse
+    mon = M.MemoryMonitor([object()], stats_fn=_fake_stats(990, 1000))
+    mon.sample()
+    ok, need2, headroom = M.MemoryGuard(cfg, mon).admit(16, 8, 32, 8)
+    assert not ok and headroom == 10 and need2 == need
+    # roomy headroom: admit
+    mon2 = M.MemoryMonitor([object()], stats_fn=_fake_stats(0, 1 << 40))
+    mon2.sample()
+    ok, *_ = M.MemoryGuard(cfg, mon2).admit(16, 8, 32, 8)
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# pressure plane: pre-dispatch rung refusal
+# ---------------------------------------------------------------------------
+
+_PRESSURED = (
+    "phold",
+    mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+    300_000_000,
+    dict(loss=0.1, qcap=4),
+)
+
+
+def _pressured_build():
+    model, hosts, stop, kw = _PRESSURED
+    return _build(model, hosts, stop, pressure_abort=True, **kw)
+
+
+def test_controller_refuses_predicted_oom_rung_before_dispatch():
+    """ISSUE acceptance: a candidate rung whose predicted footprint
+    exceeds measured headroom x safety is refused/poisoned BEFORE
+    dispatch — no grown program is ever compiled or dispatched."""
+    cfg, eng, state, params = _pressured_build()
+    mon = M.MemoryMonitor([object()], stats_fn=_fake_stats(999, 1000))
+    mon.sample()
+    rc = ResilienceController(
+        pressure=PressureOptions(policy="escalate", max_capacity=64),
+        memory=M.MemoryGuard(cfg, mon),
+    )
+    dispatched_shapes = []
+
+    def dispatch(s, g, c, b):
+        dispatched_shapes.append((c, b))
+        return eng.run_chunk_resized(s, params, g, c, b)
+
+    with pytest.raises(PressureAbort, match="memory guard refused"):
+        while not bool(state.done):
+            state, _, _ = rc.run_chunk(state, dispatch)
+    assert rc.memory_refusals >= 1
+    # nothing beyond the base shape was ever dispatched
+    base = (cfg.queue_capacity, cfg.sends_per_host_round)
+    assert set(dispatched_shapes) == {base}, dispatched_shapes
+    rep = rc.report()
+    assert rep["memory_refusals"] >= 1
+    assert rep["headroom_bytes"] == 1
+    assert rep["capacity_poisoned"]
+    assert rc.abort_export_state() is not None
+
+
+def test_controller_admits_growth_with_headroom():
+    """With roomy measured headroom the guard is admission-only: the
+    escalation proceeds, the run finishes drop-free, and the accepted
+    digests match the unguarded escalate run bit-for-bit."""
+    cfg, eng, state, params = _pressured_build()
+
+    def run(with_guard):
+        cfg2, eng2, st, pr = _pressured_build()
+        mem = None
+        if with_guard:
+            mon = M.MemoryMonitor(
+                [object()], stats_fn=_fake_stats(0, 1 << 40)
+            )
+            mon.sample()
+            mem = M.MemoryGuard(cfg2, mon)
+        rc = ResilienceController(
+            pressure=PressureOptions(policy="escalate", max_capacity=64),
+            memory=mem,
+        )
+        while not bool(st.done):
+            st, _, _ = rc.run_chunk(
+                st, lambda s, g, c, b: eng2.run_chunk_resized(s, pr, g, c, b)
+            )
+        return st, rc
+
+    st_g, rc_g = run(True)
+    st_p, rc_p = run(False)
+    assert rc_g.regrows + rc_g.proactive_regrows > 0
+    assert rc_g.memory_refusals == 0
+    assert int(np.asarray(jax.device_get(st_g.queue.dropped)).sum()) == 0
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(st_g.stats.digest)),
+        np.asarray(jax.device_get(st_p.stats.digest)),
+    )
+
+
+def test_proactive_refusal_skips_quietly():
+    """A refused PROACTIVE regrow (nothing dropped yet) skips the
+    boundary migration and the run continues at the current shape."""
+    model, hosts, stop, _ = _PRESSURED
+    cfg, eng, state, params = _build(
+        model, hosts, stop, pressure_abort=True, loss=0.1, qcap=16,
+    )
+    mon = M.MemoryMonitor([object()], stats_fn=_fake_stats(999, 1000))
+    mon.sample()
+    rc = ResilienceController(
+        # headroom 0.01: any nonzero occupancy triggers a proactive
+        # grow attempt at every boundary — each must be refused
+        pressure=PressureOptions(policy="escalate", max_capacity=64,
+                                 headroom=0.01),
+        memory=M.MemoryGuard(cfg, mon),
+    )
+    while not bool(state.done):
+        state, _, _ = rc.run_chunk(
+            state, lambda s, g, c, b: eng.run_chunk_resized(s, params, g, c, b)
+        )
+    assert rc.memory_refusals >= 1
+    assert rc.proactive_regrows == 0
+    assert not rc.aborted
+    assert state.queue.t.shape[1] == 16  # never grew
+
+
+def test_proactive_admission_trims_to_single_axis():
+    """When the COMBINED proactive growth exceeds headroom but one axis
+    alone fits, the affordable single-axis migration still happens
+    (review finding: skipping both wasted the cheap boundary regrow)."""
+    cfg, *_ = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    base_cap, base_box = 16, cfg.sends_per_host_round
+    probe = M.MemoryGuard(cfg, None)
+    need_q = probe.predicted_need_bytes(base_cap, base_box, 32, base_box)
+    need_both = probe.predicted_need_bytes(base_cap, base_box, 32,
+                                           2 * base_box)
+    assert need_q < need_both
+    # headroom fits the queue-only growth, not the combined one
+    mon = M.MemoryMonitor(
+        [object()], stats_fn=_fake_stats(0, need_q + (need_both - need_q) // 2)
+    )
+    mon.sample()
+    rc = ResilienceController(
+        pressure=PressureOptions(policy="escalate"),
+        memory=M.MemoryGuard(cfg, mon),
+    )
+    got = rc._admitted_proactive(base_cap, base_box, 32, 2 * base_box)
+    assert got == (32, base_box)
+    assert rc.memory_refusals == 1
+    # nothing fits: skip entirely, never abort
+    mon2 = M.MemoryMonitor([object()], stats_fn=_fake_stats(0, 1))
+    mon2.sample()
+    rc2 = ResilienceController(
+        pressure=PressureOptions(policy="escalate"),
+        memory=M.MemoryGuard(cfg, mon2),
+    )
+    assert rc2._admitted_proactive(base_cap, base_box, 32, 2 * base_box) \
+        == (base_cap, base_box)
+    assert rc2.memory_refusals == 1 and not rc2.aborted
+
+
+def test_supervisor_failure_memory_uses_modeled_fallback():
+    """On backends with no allocator stats the failure-time sample must
+    carry the MODELED bytes, not zeros (review finding)."""
+    from shadow_tpu.core.supervisor import ChunkSupervisor
+
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    mon = M.MemoryMonitor([object()], stats_fn=lambda d: None)
+    sup = ChunkSupervisor(
+        snapshot_every_chunks=1, max_retries=2, backoff_base_s=0.0,
+        memory=mon,
+        memory_modeled_fn=lambda: M.modeled_shard_bytes(state, params),
+    )
+    sup.note_state(state)
+    calls = {"n": 0}
+
+    def dispatch(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient dispatch failure")
+        return eng.run_chunk(st, params)
+
+    sup.run_chunk(state, dispatch)
+    fm = sup.report()["failure_memory"]
+    assert fm["bytes_in_use"] == [M.modeled_shard_bytes(state, params)]
+    assert fm["bytes_in_use"][0] > 0
+
+
+def test_supervisor_records_failure_memory():
+    from shadow_tpu.core.supervisor import ChunkSupervisor
+
+    cfg, eng, state, params = _build(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 2}),
+        200_000_000, qcap=16,
+    )
+    mon = M.MemoryMonitor([object()], stats_fn=_fake_stats(700, 1000))
+    sup = ChunkSupervisor(
+        snapshot_every_chunks=1, max_retries=2, backoff_base_s=0.0,
+        memory=mon,
+    )
+    sup.note_state(state)
+    calls = {"n": 0}
+
+    def dispatch(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient dispatch failure")
+        return eng.run_chunk(st, params)
+
+    out = sup.run_chunk(state, dispatch)
+    assert int(np.asarray(jax.device_get(out.stats.rounds))) > 0
+    rep = sup.report()
+    assert rep["retries"] == 1
+    assert rep["failure_memory"]["bytes_in_use"] == [700]
+    assert rep["failure_memory"]["headroom_bytes"] == 300
+
+
+# ---------------------------------------------------------------------------
+# tracer exports: wall-clock memory track + Prometheus gauges
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_memory_track_and_gauges():
+    from shadow_tpu.obs.tracer import RoundTracer
+
+    tr = RoundTracer(8)
+    tr.note_memory(100.0, [1000, 2000])
+    tr.note_memory(101.0, [1500, 1800])
+    chrome = tr.to_chrome_trace()
+    counters = [e for e in chrome["traceEvents"]
+                if e.get("ph") == "C" and e["name"] == "hbm_bytes"]
+    assert len(counters) == 2
+    assert counters[0]["args"] == {"shard0": 1000, "shard1": 2000}
+    assert counters[1]["ts"] > counters[0]["ts"]
+    text = tr.to_metrics_text()
+    assert "shadow_tpu_hbm_peak_bytes 2000" in text
+    assert 'shadow_tpu_shard_hbm_bytes_in_use{shard="1"} 1800' in text
+    # without samples, no memory metrics appear (schema unchanged)
+    assert "hbm" not in RoundTracer(8).to_metrics_text()
+
+
+# ---------------------------------------------------------------------------
+# campaign byte guard
+# ---------------------------------------------------------------------------
+
+
+def _campaign_dict():
+    return {
+        "general": {"stop_time": "2 s", "seed": 1},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_queue_capacity": 8, "rounds_per_chunk": 8},
+        "campaign": {"seeds": [1, 2], "ledger_file": None},
+        "hosts": {"n": {"count": 4, "network_node_id": 0,
+                  "processes": [{"model": "phold",
+                                 "model_args": {"population": 2,
+                                                "mean_delay": "100 ms"}}]}},
+    }
+
+
+def test_campaign_replica_byte_guard():
+    from tools.campaign import build_campaign
+
+    c = build_campaign(_campaign_dict(), capacity_bytes=1 << 40)
+    assert c.per_replica_bytes > 0
+    # R x per-replica state + nonzero shared params
+    assert c.predicted_bytes > 2 * c.per_replica_bytes
+    with pytest.raises(ConfigError, match="predicted"):
+        build_campaign(_campaign_dict(),
+                       capacity_bytes=c.per_replica_bytes)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat hbm= round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_hbm_strict_roundtrip(tmp_path):
+    from shadow_tpu.sim import heartbeat_line
+    from tools.parse_shadow import parse_heartbeats
+
+    lines = [
+        heartbeat_line(2_000_000_000, 3.0, 99, 80, 40, 4096, 7,
+                       hbm=1 << 20),
+        heartbeat_line(2_000_000_000, 3.0, 99, 80, 40, 4096, 7,
+                       gear=4, cap=32, hbm=12345, rep=(1, 2)),
+        heartbeat_line(2_000_000_000, 3.0, 99, 80, 40, 4096, 7),
+    ]
+    p = tmp_path / "hb.log"
+    p.write_text("\n".join(lines) + "\n")
+    parsed = parse_heartbeats(str(p), strict=True)
+    assert parsed[0]["hbm"] == 1 << 20
+    assert parsed[1]["hbm"] == 12345 and parsed[1]["cap"] == 32
+    assert "hbm" not in parsed[2]
+
+
+# ---------------------------------------------------------------------------
+# subprocess legs: Simulation on/off exactness + hbm_report CLI
+# ---------------------------------------------------------------------------
+
+
+_SIM_WORKER = '''
+import io, json, os, sys
+import numpy as np
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+mem = sys.argv[1] == "on"
+tmp = sys.argv[2]
+cfg = ConfigOptions.from_dict({
+    "general": {"stop_time": "3 s", "seed": 1,
+                "heartbeat_interval": "1 s",
+                "data_directory": tmp},
+    "network": {"graph": {"type": "1_gbit_switch"}},
+    "experimental": {"event_queue_capacity": 16,
+                     "rounds_per_chunk": 8},
+    "observability": {"trace": True, "memory": mem},
+    "hosts": {"n": {"count": 16, "network_node_id": 0,
+              "processes": [{"model": "phold",
+                             "model_args": {"population": 2,
+                                            "mean_delay": "100 ms"}}]}},
+})
+log = io.StringIO()
+sim = Simulation(cfg, world=1)
+r = sim.run(progress=False, log=log)
+sim.write_outputs(report=r)
+hb = [l for l in log.getvalue().splitlines() if "[heartbeat]" in l]
+out = {
+    "digest": r["determinism_digest"],
+    "events": r["events_processed"],
+    "drops": [r["queue_overflow_dropped"],
+              r["packets_budget_dropped"], r["packets_lost"]],
+    "heartbeat": hb[0] if hb else "",
+    "has_memory": "memory" in r,
+}
+if mem:
+    trace = json.load(open(os.path.join(tmp, "trace.json")))
+    out["mem_track"] = len([e for e in trace["traceEvents"]
+                            if e.get("ph") == "C"
+                            and e.get("name") == "hbm_bytes"])
+    prom = open(os.path.join(tmp, "metrics.prom")).read()
+    out["prom_has_hbm"] = "shadow_tpu_hbm_peak_bytes" in prom
+    m = r["memory"]
+    out.update(source=m["source"], samples=m["samples"],
+               hwm=m["per_shard_hwm_bytes"],
+               ledger_base=m["ledger"]["base"],
+               model_total=m["model"]["total_bytes"])
+print(json.dumps(out))
+'''
+
+
+def test_simulation_memory_on_off_bit_identical(tmp_path):
+    """Full-driver leg: observability.memory on vs off — digests, event
+    counts, and drop counters bit-identical; the on-run's artifacts
+    carry the memory{} block, hbm= heartbeats, the Chrome-trace memory
+    track, and Prometheus gauges. One Simulation per subprocess
+    (compiled Simulation runs are this box's corruption magnet, and
+    two in one process is its worst shape — tests/subproc.py)."""
+    from tests.subproc import run_isolated_json
+
+    on = run_isolated_json(
+        _SIM_WORKER, "on", str(tmp_path / "mem_on"), timeout=420
+    )
+    off = run_isolated_json(
+        _SIM_WORKER, "off", str(tmp_path / "mem_off"), timeout=420
+    )
+    assert on["digest"] == off["digest"]
+    assert on["events"] == off["events"]
+    assert on["drops"] == off["drops"]
+    assert on["source"] == "modeled" and on["samples"] > 0
+    assert all(b > 0 for b in on["hwm"])
+    assert "argument_bytes" in on["ledger_base"]
+    assert on["model_total"] > 0
+    assert "hbm=" in on["heartbeat"]
+    assert "hbm=" not in off["heartbeat"]
+    assert on["mem_track"] > 0
+    assert on["prom_has_hbm"]
+    assert not off["has_memory"]
+    # strict-parse the live heartbeat through the format gate
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    m = HEARTBEAT_RE.search(on["heartbeat"])
+    assert m and int(m.group("hbm")) == max(on["hwm"])
+
+
+def test_hybrid_memory_observatory():
+    """The cosim driver's observatory leg: a hybrid (program-host) run
+    with observability.memory on carries the memory{} block, hbm= in the
+    windows-form heartbeat, and a digest identical to the memory-off
+    run. Subprocess-isolated like every compiled-Simulation leg."""
+    from tests.subproc import run_isolated_json
+
+    worker = '''
+import io, json, sys
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.cosim import HybridSimulation
+
+mem = sys.argv[1] == "on"
+cfg = ConfigOptions.from_dict({
+    "general": {"stop_time": "2 s", "seed": 7,
+                "heartbeat_interval": "500 ms"},
+    "network": {"graph": {"type": "1_gbit_switch"}},
+    "observability": {"memory": mem, "memory_ledger": False},
+    "hosts": {
+        "server": {"network_node_id": 0,
+                   "processes": [{"path": "udp_echo_server",
+                                  "args": ["port=9000"]}]},
+        "client": {"network_node_id": 0,
+                   "processes": [{"path": "udp_ping",
+                                  "args": ["server=server", "port=9000",
+                                           "count=3"],
+                                  "expected_final_state": {"exited": 0}}]},
+    },
+})
+log = io.StringIO()
+sim = HybridSimulation(cfg)
+r = sim.run(log=log)
+hb = [l for l in log.getvalue().splitlines() if "[heartbeat]" in l]
+print(json.dumps({
+    "digest": r["determinism_digest"],
+    "delivered": r["packets_delivered"],
+    "failures": r["process_failures"],
+    "heartbeat": hb[0] if hb else "",
+    "memory": r.get("memory"),
+}))
+'''
+    on = run_isolated_json(worker, "on", timeout=420)
+    off = run_isolated_json(worker, "off", timeout=420)
+    assert on["failures"] == 0 and off["failures"] == 0
+    assert on["digest"] == off["digest"]
+    assert on["delivered"] == off["delivered"]
+    m = on["memory"]
+    assert m is not None and off["memory"] is None
+    assert m["source"] == "modeled" and m["samples"] > 0
+    assert max(m["per_shard_hwm_bytes"]) > 0
+    assert m["model"]["total_bytes"] > 0
+    assert "ledger" not in m  # memory_ledger: false skips recompiles
+    if on["heartbeat"]:  # windows-form heartbeat carries hbm=
+        assert "hbm=" in on["heartbeat"]
+        from tools.parse_shadow import HEARTBEAT_RE
+
+        assert HEARTBEAT_RE.search(on["heartbeat"])
+
+
+def _skip_on_corruption(proc, what):
+    from tests.subproc import HEAP_CORRUPTION_RCS
+
+    if proc.returncode in HEAP_CORRUPTION_RCS and not proc.stdout.strip():
+        pytest.skip(
+            f"{what}: known jaxlib corruption signature "
+            f"rc={proc.returncode} (CHANGES.md env notes)"
+        )
+
+
+def test_hbm_report_cli_smoke(tmp_path):
+    """`tools/hbm_report.py --json` on a tiny config: per-component
+    breakdown + a positive max-hosts figure; then `--check` (which
+    self-classifies the corruption signature) must exit 0."""
+    cfg_yaml = tmp_path / "tiny.yaml"
+    cfg_yaml.write_text("""
+general: {stop_time: 2 s, seed: 1}
+network: {graph: {type: 1_gbit_switch}}
+experimental: {event_queue_capacity: 16, rounds_per_chunk: 8}
+hosts:
+  n:
+    count: 8
+    network_node_id: 0
+    processes:
+      - model: phold
+        model_args: {population: 2, mean_delay: 100 ms}
+""")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "tools/hbm_report.py", str(cfg_yaml), "--json"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO,
+    )
+    _skip_on_corruption(proc, "hbm_report --json")
+    assert proc.returncode == 0, proc.stderr[-800:]
+    blob = json.loads(proc.stdout)
+    assert blob["model"]["components"]["queue"] > 0
+    assert blob["ledger"]["base"]["argument_bytes"] > 0
+    assert blob["plan"]["max_hosts_per_device"] > 0
+    assert blob["planner"]["per_host_bytes"] > 0
+
+    proc = subprocess.run(
+        [sys.executable, "tools/hbm_report.py", str(cfg_yaml), "--check"],
+        capture_output=True, text=True, timeout=640, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-800:])
+    assert "ok" in proc.stdout or "SKIP" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# bench_compare unit
+# ---------------------------------------------------------------------------
+
+
+def test_bench_compare_flags_regressions(tmp_path):
+    from tools.bench_compare import main as bc_main
+
+    old = {"parsed": {"metric": "m1", "value": 10.0,
+                      "hbm": {"per_shard_hwm_bytes": [1000]}}}
+    new_ok = {"parsed": {"metric": "m1", "value": 9.5,
+                         "hbm": {"per_shard_hwm_bytes": [1040]}}}
+    new_bad = {"parsed": {"metric": "m1", "value": 8.0,
+                          "hbm": {"per_shard_hwm_bytes": [2000]}}}
+    p_old = tmp_path / "old.json"
+    p_ok = tmp_path / "ok.json"
+    p_bad = tmp_path / "bad.json"
+    p_old.write_text(json.dumps(old))
+    p_ok.write_text(json.dumps(new_ok))
+    p_bad.write_text(json.dumps(new_bad))
+    assert bc_main([str(p_old), str(p_ok)]) == 0
+    assert bc_main([str(p_old), str(p_bad)]) == 1
+    # a tracked metric disappearing is a regression
+    p_empty = tmp_path / "empty.json"
+    p_empty.write_text(json.dumps({"parsed": {"metric": "m2", "value": 1}}))
+    assert bc_main([str(p_old), str(p_empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# example config parses
+# ---------------------------------------------------------------------------
+
+
+def test_example_memory_yaml_parses():
+    from shadow_tpu.config.options import load_config
+
+    cfg = load_config(os.path.join(_REPO, "examples", "memory.yaml"))
+    assert cfg.observability.memory
+    assert cfg.pressure.policy == "escalate"
+    assert cfg.pressure.memory_safety_factor >= 1.0
+
+
+def test_memory_safety_factor_validated():
+    from shadow_tpu.config.options import PressureOptions
+
+    with pytest.raises(ConfigError, match="memory_safety_factor"):
+        PressureOptions.from_dict(
+            {"policy": "escalate", "memory_safety_factor": 0.5}
+        )

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Diff two BENCH / MULTICHIP JSONs and flag perf or HBM regressions.
+
+The BENCH_r01 -> r05 trajectory had no comparator: every round's verdict
+was eyeballed. This tool makes the comparison mechanical:
+
+  python tools/bench_compare.py OLD.json NEW.json [--threshold 0.10]
+                                [--hbm-threshold 0.10] [--json]
+
+Accepted file shapes (auto-detected, mixable):
+  - the recorded BENCH wrapper  {"n", "cmd", "rc", "tail", "parsed": row}
+  - a raw bench row             {"metric", "value", "unit", ..., "hbm"?}
+  - a list of either
+  - the MULTICHIP wrapper       {"n_devices", "rc", "ok", ...} — rc/ok
+    compared, plus its "hbm" block when present
+
+Verdicts (rc 1 if any REGRESSION, else 0):
+  - perf: metric value dropped more than --threshold relative
+    (metrics are throughput-style — higher is better)
+  - hbm: per-shard peak bytes (or model-predicted bytes where no peak
+    was recorded) grew more than --hbm-threshold relative
+  - a metric present in OLD but missing from NEW is a regression
+    (silently dropping a tracked workload is how coverage rots)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _rows(blob) -> dict[str, dict]:
+    """Normalize a loaded JSON blob to {metric_name: row}."""
+    out: dict[str, dict] = {}
+    items = blob if isinstance(blob, list) else [blob]
+    for item in items:
+        if not isinstance(item, dict):
+            continue
+        if "parsed" in item and isinstance(item["parsed"], dict):
+            item = {**item["parsed"],
+                    **({"hbm": item["hbm"]} if "hbm" in item else {})}
+        if "metric" in item:
+            out[str(item["metric"])] = item
+        elif "n_devices" in item:
+            out[f"multichip_{item['n_devices']}dev"] = item
+    return out
+
+
+def _hbm_peak(row: dict) -> int | None:
+    """Comparable HBM figure of one row: the recorded per-shard peak,
+    else the model-predicted per-shard bytes."""
+    hbm = row.get("hbm")
+    if not isinstance(hbm, dict):
+        return None
+    peaks = hbm.get("per_shard_hwm_bytes")
+    if peaks:
+        return max(int(p) for p in peaks)
+    model = hbm.get("model") or {}
+    if model.get("total_bytes"):
+        return int(model["total_bytes"])
+    return None
+
+
+def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
+    findings: list[dict] = []
+
+    def add(kind, metric, severity, detail):
+        findings.append({"kind": kind, "metric": metric,
+                         "severity": severity, "detail": detail})
+
+    for name, o in sorted(old.items()):
+        n = new.get(name)
+        if n is None:
+            add("coverage", name, "regression",
+                "metric present in OLD but missing from NEW")
+            continue
+        ov, nv = o.get("value"), n.get("value")
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+                and ov > 0:
+            rel = (nv - ov) / ov
+            if rel < -threshold:
+                add("perf", name, "regression",
+                    f"value {ov} -> {nv} ({rel * 100:+.1f}%, threshold "
+                    f"-{threshold * 100:.0f}%)")
+            elif rel > threshold:
+                add("perf", name, "improvement",
+                    f"value {ov} -> {nv} ({rel * 100:+.1f}%)")
+        elif ov is not None and nv is None:
+            add("perf", name, "regression",
+                f"OLD recorded value {ov}, NEW recorded none "
+                f"(skipped: {n.get('skipped') or n.get('solo_leg_skipped')})")
+        if "ok" in o and "ok" in n and bool(o["ok"]) and not bool(n["ok"]):
+            add("multichip", name, "regression",
+                f"ok {o['ok']} -> {n['ok']} (rc {n.get('rc')})")
+        oh, nh = _hbm_peak(o), _hbm_peak(n)
+        if oh and nh and oh > 0:
+            rel = (nh - oh) / oh
+            if rel > hbm_threshold:
+                add("hbm", name, "regression",
+                    f"per-shard HBM peak {oh} -> {nh} B "
+                    f"({rel * 100:+.1f}%, threshold "
+                    f"+{hbm_threshold * 100:.0f}%)")
+            elif rel < -hbm_threshold:
+                add("hbm", name, "improvement",
+                    f"per-shard HBM peak {oh} -> {nh} B "
+                    f"({rel * 100:+.1f}%)")
+        elif oh and nh is None:
+            # OLD carried HBM telemetry, NEW lost it: coverage warning
+            # (not a hard regression — older rows predate the block)
+            add("hbm", name, "warning",
+                "OLD carried an hbm block, NEW has none")
+    for name in sorted(set(new) - set(old)):
+        add("coverage", name, "info", "new metric (no baseline)")
+    return findings
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="relative perf-drop threshold (default 0.10)")
+    p.add_argument("--hbm-threshold", type=float, default=0.10,
+                   help="relative HBM-growth threshold (default 0.10)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    with open(args.old) as f:
+        old = _rows(json.load(f))
+    with open(args.new) as f:
+        new = _rows(json.load(f))
+    if not old:
+        print(f"bench_compare: no comparable rows in {args.old}",
+              file=sys.stderr)
+        return 2
+    findings = compare(old, new, args.threshold, args.hbm_threshold)
+    regressions = [f for f in findings if f["severity"] == "regression"]
+    if args.json:
+        print(json.dumps({
+            "findings": findings,
+            "regressions": len(regressions),
+            "ok": not regressions,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"[{f['severity']:<11}] {f['kind']:<9} {f['metric']}: "
+                  f"{f['detail']}")
+        print(f"{len(regressions)} regression(s), "
+              f"{len(findings)} finding(s)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

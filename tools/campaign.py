@@ -142,7 +142,10 @@ class Campaign:
     """Built campaign: the vmapped ensemble plus everything the run loop
     and ledger need. Build via `build_campaign(config_dict)`."""
 
-    def __init__(self, base_cfg: ConfigOptions, base_dict: dict):
+    def __init__(
+        self, base_cfg: ConfigOptions, base_dict: dict,
+        capacity_bytes: int | None = None,
+    ):
         from shadow_tpu.core.ensemble import build_ensemble
         from shadow_tpu.sim import Simulation, config_is_hybrid
 
@@ -191,6 +194,33 @@ class Campaign:
         self.num_real = sims[0]._num_real
         self.model = sims[0].model
         self.rounds_per_chunk = sims[0].engine_cfg.rounds_per_chunk
+        # memory-informed replica guard (obs/memory.py): R x the
+        # per-replica state bytes (exact metadata accounting of the solo
+        # state — every state plane is stacked R times) plus the shared
+        # broadcast params must fit the device. This replaces the old
+        # comment-only HBM rationale on campaign.max_replicas with
+        # predicted numbers; the parse-time replica-COUNT cap stays as
+        # the cheap first line. `capacity_bytes` overrides the probed
+        # device capacity (tests inject small fakes); None + no
+        # measurable capacity skips the check (nothing to size against).
+        from shadow_tpu.obs.memory import device_capacity_bytes, tree_bytes
+
+        per_replica = tree_bytes(sims[0].state)
+        shared = tree_bytes(sims[0].params)
+        predicted = per_replica * len(self.specs) + shared
+        if capacity_bytes is None:
+            capacity_bytes = device_capacity_bytes()
+        self.predicted_bytes = predicted
+        self.per_replica_bytes = per_replica
+        if capacity_bytes is not None and predicted > capacity_bytes:
+            raise ConfigError(
+                f"campaign: {len(self.specs)} replicas need a predicted "
+                f"{predicted} bytes of device memory ({len(self.specs)} x "
+                f"{per_replica} per-replica state + {shared} shared "
+                f"params), over the device capacity {capacity_bytes} "
+                f"bytes — shard the campaign across processes or shrink "
+                f"the replica axes (static model: shadow_tpu/obs/memory.py)"
+            )
         self.engine, self.state = build_ensemble(
             self.model,
             [(s.engine.cfg, s.state, s.params) for s in sims],
@@ -211,8 +241,13 @@ class Campaign:
         )
 
 
-def build_campaign(config_dict: dict) -> Campaign:
-    return Campaign(ConfigOptions.from_dict(config_dict), config_dict)
+def build_campaign(
+    config_dict: dict, capacity_bytes: int | None = None
+) -> Campaign:
+    return Campaign(
+        ConfigOptions.from_dict(config_dict), config_dict,
+        capacity_bytes=capacity_bytes,
+    )
 
 
 def run_campaign(

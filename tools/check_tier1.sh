@@ -16,6 +16,13 @@
 # exit code only when the pytest stage passed, so the primary signal
 # stays pytest's.
 #
+# Optional stage: TIER1_HBM=1 runs the memory-observatory cross-check
+# (tools/hbm_report.py --check: the static byte model must agree with
+# Compiled.memory_analysis within tolerance, and every registered lane's
+# formula bytes must equal the live carry leaf's). The compiled leg runs
+# in a worker subprocess and self-classifies the known jaxlib corruption
+# signature as SKIP (soak.py posture).
+#
 # Optional third stage: TIER1_CAMPAIGN=1 runs the ensemble-plane smoke
 # (tools/campaign.py --smoke: an A/A control campaign that must hold +
 # a forced-divergence A/B campaign whose bisection must agree with the
@@ -61,6 +68,14 @@ if [ -n "${TIER1_SOAK:-}" ]; then
   soak_rc=$?
   echo "SOAK_RC=$soak_rc"
   [ "$rc" -eq 0 ] && rc=$soak_rc
+fi
+if [ -n "${TIER1_HBM:-}" ]; then
+  echo "== hbm predicted-vs-measured check (TIER1_HBM) =="
+  timeout -k 10 "${TIER1_HBM_TIMEOUT:-630}" \
+    env JAX_PLATFORMS=cpu python tools/hbm_report.py --check
+  hbm_rc=$?
+  echo "HBM_RC=$hbm_rc"
+  [ "$rc" -eq 0 ] && rc=$hbm_rc
 fi
 if [ -n "${TIER1_CAMPAIGN:-}" ]; then
   echo "== campaign smoke (TIER1_CAMPAIGN) =="

@@ -33,6 +33,9 @@ HEARTBEAT_RE = re.compile(
     # PR 8 pressure-plane field (only emitted on pressure runs): the
     # ACTIVE per-host queue capacity (escalation regrows it mid-run)
     r"(?:cap=(?P<cap>\d+) )?"
+    # PR 9 memory-observatory field (only emitted when
+    # observability.memory is on): per-shard HBM high-water, bytes
+    r"(?:hbm=(?P<hbm>\d+) )?"
     # PR 6 ensemble-campaign field (only emitted by tools/campaign.py):
     # rep=<replicas done>/<total replicas>
     r"(?:rep=(?P<rep_done>\d+)/(?P<rep_total>\d+) )?"
