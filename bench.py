@@ -742,6 +742,34 @@ def measure_campaign(small: bool, wall_budget_s: float = 120.0) -> dict:
     return row
 
 
+def _bench_network(sim, state, s, netcol) -> dict:
+    """The BENCH row's compact network{} block: the SAME shared assembly
+    sim-stats uses (obs/netobs.assemble_network_report), compacted to
+    the diffable bench shape — rows cannot drift from sim-stats."""
+    import numpy as _np
+
+    from shadow_tpu.obs.netobs import (
+        assemble_network_report, bench_network_block, node_map,
+    )
+
+    n = sim._num_real
+    import jax as _jax
+
+    model_view = _jax.tree.map(
+        lambda a: _np.asarray(a)[:n], _jax.device_get(state.model)
+    )
+    return bench_network_block(assemble_network_report(
+        stats=s,
+        num_real=n,
+        rounds=int(s.rounds),
+        node_of=node_map(sim.hosts, n),
+        model=sim.model,
+        model_state=model_view,
+        flow_ledger=sim.engine_cfg.flow_ledger_active,
+        collector=netcol,
+    ))
+
+
 def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     """Run one BASELINE config; returns the JSON-able result row."""
     if n == 8:
@@ -768,11 +796,24 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     # multi-second 256-512-round chunk; the block_until_ready was already
     # there) — well under the run-to-run noise floor.
     cfg_dict.setdefault("observability", {})["trace"] = True
+    # network observatory (PR 10): measured-in like the tracer — digests
+    # are bit-identical with it on (tests/test_netobs.py), its in-jit
+    # cost is a handful of [H] masks+sums per event, and the BENCH row
+    # gains the network{} block (timer-event share, FCT p50/p99, link
+    # hot-spot) tools/bench_compare.py diffs for flow-behavior
+    # regressions, not just wall-clock ones.
+    cfg_dict["observability"]["network"] = True
     cfg = ConfigOptions.from_dict(cfg_dict)
     t_build = time.monotonic()
     sim = Simulation(cfg, world=1)
     state, params, engine = sim.state, sim.params, sim.engine
     tracer = RoundTracer(sim.engine_cfg.rounds_per_chunk)
+    from shadow_tpu.obs.netobs import FlowCollector
+
+    netcol = (
+        FlowCollector(sim.engine_cfg.flow_records)
+        if sim.engine_cfg.flow_ledger_active else None
+    )
     # adaptive merge gears (PR 4): when the config opts in, drive chunks
     # through the same shed-exact controller loop the Simulation driver
     # uses — the BENCH row then carries the gear histogram (chunks per
@@ -876,6 +917,8 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     state = step(state)  # compile + first chunk (controller starts at top)
     compile_s = time.monotonic() - t0
     tracer.drain(state.trace, wall_t0=t0, wall_t1=time.monotonic())
+    if netcol is not None:
+        netcol.drain(state.flows)
     _sample_memory(state)
     if gearctl is not None:
         # pre-warm the LOWER gear programs outside the timed window: the
@@ -896,6 +939,8 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         t_c = time.monotonic()
         state = step(state)
         tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
+        if netcol is not None:
+            netcol.drain(state.flows)
         _sample_memory(state)
         if time.monotonic() - t0 >= wall_budget_s:
             break
@@ -911,6 +956,8 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         sim2 = Simulation(cfg, world=1)
         state = sim2.state
         tracer = RoundTracer(sim.engine_cfg.rounds_per_chunk)  # fresh cursor
+        if netcol is not None:
+            netcol = FlowCollector(sim.engine_cfg.flow_records)
         if sup is not None:
             # re-arm on the FRESH state: without this, a dispatch failure
             # in the rerun loop would restore the finished first run's
@@ -922,6 +969,8 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
             t_c = time.monotonic()
             state = step(state)
             tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
+            if netcol is not None:
+                netcol.drain(state.flows)
             _sample_memory(state)
         wall = max(time.monotonic() - t0, 1e-9)
         sim_adv = int(state.now) / 1e9
@@ -930,8 +979,17 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         # chunks that succeeded after the supervisor's snapshot were
         # already drained, but the exported state rewound past them —
         # drop their rows so the row's trace-derived numbers cover
-        # exactly the rewound prefix (truncate_to_round docs this)
+        # exactly the rewound prefix (truncate_to_round docs this); the
+        # flow collector follows the same contract against the rewound
+        # state's OWN ledger cursor, or the row's network{} block would
+        # report flows the exported prefix never completed
         tracer.truncate_to_round(int(state.stats.rounds))
+        if netcol is not None:
+            import numpy as _np_t
+
+            netcol.truncate_to_cursor(
+                _np_t.asarray(jax.device_get(state.flows.cursor))
+            )
     value = (ev_adv / wall) if "events_per" in metric else (sim_adv / wall)
     # event-density telemetry (the K-way microstep's target): how many
     # dispatches a round serializes into, and how many events each
@@ -1005,6 +1063,12 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         },
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
+        # network block (network observatory, PR 10): the timer-vs-packet
+        # event share ROADMAP item 2's timer-wheel decision gates on, the
+        # FCT distribution, and the per-link hot-spot — diffed by
+        # tools/bench_compare.py so flow-behavior regressions fail the
+        # comparison even when wall-clock holds
+        "network": _bench_network(sim, state, s, netcol),
         # HBM block (memory observatory): per-shard peak bytes + the
         # static model's prediction + headroom — the BENCH/MULTICHIP
         # telemetry ROADMAP item 1 asks for; tools/bench_compare.py

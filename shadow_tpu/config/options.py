@@ -412,6 +412,23 @@ class ObservabilityOptions:
     # observer: NO traced code changes — digests and the compiled
     # programs are byte-identical on or off.
     memory: bool = False
+    # Network observatory (obs/netobs.py + docs/architecture.md "Network
+    # observatory"): in-jit event-class accounting (timer/packet/app),
+    # a per-shard flow-completion ledger ring (FCT distributions + a
+    # Perfetto flow track), host-side per-link counter folds, and
+    # per-round safe-window critical-path telemetry — a `network{}`
+    # block in sim-stats, `ek=`/`fct=` heartbeat fields, and extra
+    # trace-ring columns. Observer contract: digests/events/drops are
+    # bit-identical on or off; with it OFF no observatory code is traced
+    # and the default program is byte-unchanged (tests/test_netobs.py +
+    # the jaxpr fingerprint gate).
+    network: bool = False
+    # flow-ledger ring capacity in records PER SHARD (sized so a chunk's
+    # completions rarely wrap; a wrap overwrites the oldest records,
+    # counted by the collector, while the fl_* stats lanes stay exact).
+    # Only models with a flow port (tgen_tcp) carry a ledger; 0 disables
+    # the ledger entirely (event classes + safe window still run).
+    network_flows: int = 4096
     # also compile-and-read `Compiled.memory_analysis()` for every chunk
     # program the run's engine cached (the per-rung ledger in the
     # memory{} block). Reading the analysis needs a fresh lower+compile
@@ -428,8 +445,16 @@ class ObservabilityOptions:
             metrics_file=d.pop("metrics_file", "metrics.prom"),
             profile_dir=d.pop("profile_dir", None),
             memory=bool(d.pop("memory", False)),
+            network=bool(d.pop("network", False)),
+            network_flows=int(d.pop("network_flows", 4096)),
             memory_ledger=bool(d.pop("memory_ledger", True)),
         )
+        if o.network_flows < 0:
+            raise ConfigError(
+                f"observability.network_flows must be >= 0 (0 = no flow "
+                f"ledger, event classes and safe-window only), "
+                f"got {o.network_flows}"
+            )
         # null disables an export; a non-null value must be a usable path
         # (str(None) would silently produce a file literally named "None")
         for f in ("trace_file", "metrics_file", "profile_dir"):

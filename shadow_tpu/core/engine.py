@@ -106,6 +106,15 @@ from shadow_tpu.obs.tracer import (
     COL_FAULTS_DROPPED,
     COL_HOSTS_DOWN,
 )
+from shadow_tpu.obs.tracer import (
+    COL_BIND_SHARD,
+    COL_EC_APP,
+    COL_EC_PKT,
+    COL_EC_TIMER,
+    COL_FLOWS,
+)
+from shadow_tpu.obs.netobs import FlowLedger, make_flow_ledger
+from shadow_tpu.ops.events import kind_in
 from shadow_tpu.core.faults import (
     FaultParams,
     LAT_SCALE,
@@ -205,6 +214,26 @@ class Stats(NamedTuple):
     # chunk loop's first-drop abort condition is uniform on every shard.
     # Structurally zero in any state an escalate run accepts.
     pressure: Any = None  # i64[world] | None
+    # Network observatory lanes (obs/netobs.py; None unless cfg.netobs —
+    # the default program carries none of them and stays byte-identical).
+    # Event-class accounting: executed events bucketed as timer (the
+    # model's declared timer_kinds), packet (KIND_PKT flag), or app (the
+    # rest). ec_timer + ec_pkt + ec_app == sum(events) by construction —
+    # the reconciliation tests/net_report.py --check pin.
+    ec_timer: Any = None  # i64[world] | None
+    ec_pkt: Any = None  # i64[world] | None
+    ec_app: Any = None  # i64[world] | None
+    # Flow-ledger totals (None unless cfg.flow_ledger_active): cumulative
+    # completions/bytes/retransmits counted INDEPENDENTLY of the ring
+    # cursor path, so ledger-vs-counters reconciliation is a real check
+    # and stays exact across ring wraps.
+    fl_done: Any = None  # i64[world] | None
+    fl_bytes: Any = None  # i64[world] | None
+    fl_rtx: Any = None  # i64[world] | None
+    # Safe-window telemetry (None unless cfg.netobs): rounds where THIS
+    # shard's local min event time bound the all-reduce-min barrier
+    # (ties to the lowest shard id) — the critical-path/straggler view.
+    win_bound: Any = None  # i64[world] | None
 
 
 class SimState(NamedTuple):
@@ -228,6 +257,12 @@ class SimState(NamedTuple):
     # round's own values and feeds nothing back, so enabling it cannot
     # change digests, events, or drop counters.
     trace: Any = None  # TraceRing | None
+    # flow-completion ledger (obs/netobs.py): None unless
+    # cfg.flow_ledger_active. Same contract as the trace ring — written
+    # in-jit at model flow completion (the FlowDone port), drained at
+    # chunk boundaries, observes values the handler already computed,
+    # feeds nothing back into scheduling.
+    flows: Any = None  # FlowLedger | None
 
 
 class EngineParams(NamedTuple):
@@ -393,6 +428,23 @@ class EngineConfig:
     # (policy drop, the default) traces no pressure code at all: the
     # program is bit-identical to the pre-pressure engine.
     pressure_abort: bool = False
+    # Network observatory (obs/netobs.py; observability.network): when
+    # True the round body classifies every executed event as timer /
+    # packet / app into per-shard stats lanes, tracks the shard that
+    # bound each round's safe-window barrier, and (with flow_records > 0)
+    # appends flow-completion records to a per-shard ledger ring. All of
+    # it observes values the round already computes and feeds nothing
+    # back, so digests/events/drops are bit-identical on or off; False
+    # (the default) traces NO observatory code — the program stays
+    # byte-identical to before the observatory existed.
+    netobs: bool = False
+    # flow-ledger ring capacity in records per shard (0 = no ledger in
+    # the carry — models without a flow port, or the observatory off).
+    # The drivers size it from observability.network_flows and drain at
+    # chunk boundaries; a burst past capacity overwrites the OLDEST
+    # records, counted by the FlowCollector (never silent), while the
+    # fl_* stats lanes keep exact totals regardless.
+    flow_records: int = 0
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -441,6 +493,16 @@ class EngineConfig:
             raise ValueError(
                 f"fault window counts must be >= 0, got crash="
                 f"{self.fault_crash_windows} loss={self.fault_loss_windows}"
+            )
+        if self.flow_records < 0:
+            raise ValueError(
+                f"flow_records={self.flow_records} must be >= 0 (0 = no "
+                f"ledger)"
+            )
+        if self.flow_records and not self.netobs:
+            raise ValueError(
+                "flow_records > 0 requires netobs=True (the flow ledger "
+                "is a network-observatory instrument)"
             )
 
     @property
@@ -501,6 +563,12 @@ class EngineConfig:
         return self.fault_crash_windows > 0 and self.fault_queue_clear
 
     @property
+    def flow_ledger_active(self) -> bool:
+        """True iff the flow-completion ledger is traced into the round
+        body (network observatory on AND a ring capacity declared)."""
+        return self.netobs and self.flow_records > 0
+
+    @property
     def gear_active(self) -> bool:
         """True iff this program runs a TRUNCATED merge (shed detection,
         gear-abort chunk condition, and the sliced exchange are traced in
@@ -533,6 +601,9 @@ def _init_stats(cfg: EngineConfig) -> Stats:
     def zi():
         return jnp.zeros((h,), jnp.int64)
 
+    def zw():
+        return jnp.zeros((cfg.world,), jnp.int64)
+
     return Stats(
         events=zi(),
         pkts_sent=zi(),
@@ -559,6 +630,15 @@ def _init_stats(cfg: EngineConfig) -> Stats:
             jnp.zeros((cfg.world,), jnp.int64) if cfg.pressure_abort
             else None
         ),
+        # network-observatory lanes: absent (None) unless the observatory
+        # is traced in — a distinct buffer per field (donation rule above)
+        ec_timer=zw() if cfg.netobs else None,
+        ec_pkt=zw() if cfg.netobs else None,
+        ec_app=zw() if cfg.netobs else None,
+        fl_done=zw() if cfg.flow_ledger_active else None,
+        fl_bytes=zw() if cfg.flow_ledger_active else None,
+        fl_rtx=zw() if cfg.flow_ledger_active else None,
+        win_bound=zw() if cfg.netobs else None,
     )
 
 
@@ -908,10 +988,21 @@ class Engine:
                 digest=sh,
                 rounds=rep,
                 pressure=sh if self.cfg.pressure_abort else None,
+                ec_timer=sh if self.cfg.netobs else None,
+                ec_pkt=sh if self.cfg.netobs else None,
+                ec_app=sh if self.cfg.netobs else None,
+                fl_done=sh if self.cfg.flow_ledger_active else None,
+                fl_bytes=sh if self.cfg.flow_ledger_active else None,
+                fl_rtx=sh if self.cfg.flow_ledger_active else None,
+                win_bound=sh if self.cfg.netobs else None,
             ),
             trace=(
                 TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
                 else None
+            ),
+            flows=(
+                FlowLedger(rows=sh, cursor=sh)
+                if self.cfg.flow_ledger_active else None
             ),
         )
 
@@ -1021,6 +1112,11 @@ class Engine:
                 trace=(
                     make_trace_ring(cfg.world, cfg.trace_rounds)
                     if cfg.trace_rounds
+                    else None
+                ),
+                flows=(
+                    make_flow_ledger(cfg.world, cfg.flow_records)
+                    if cfg.flow_ledger_active
                     else None
                 ),
             )
@@ -1183,6 +1279,27 @@ def _window_step(
     )
     host_gid = shard_start + jnp.arange(h_local, dtype=jnp.int64)
 
+    # ---- safe-window telemetry (network observatory): which shard's
+    # local min event time bound this round's all-reduce-min barrier —
+    # the critical-path shard (ties to the lowest shard id, so the value
+    # is deterministic and identical on every shard). One extra local
+    # min + pmin per round, traced only when the observatory is on.
+    bind_shard = None
+    if cfg.netobs:
+        nb_lmin = jnp.min(
+            _effective_next(cfg, st, _hold_faults(cfg, params))
+        )
+        if axis:
+            nb_gmin = _pmin(nb_lmin, axis)
+            me = lax.axis_index(axis).astype(jnp.int64)
+            bind_shard = _pmin(
+                jnp.where(nb_lmin == nb_gmin, me, jnp.int64(cfg.world)),
+                axis,
+            )
+        else:
+            me = jnp.int64(0)
+            bind_shard = jnp.int64(0)
+
     # ---- 3: microsteps (no collectives inside — shards proceed independently)
     if cfg.effective_microstep_events > 1:
         # K-way fold: the valve is a PER-HOST executed-event vector, bound
@@ -1254,6 +1371,13 @@ def _window_step(
         q_occ_hwm=jnp.maximum(st_x.stats.q_occ_hwm, occ),
         outbox_hwm=jnp.maximum(st_x.stats.outbox_hwm, ob_hwm[None]),
     )
+    if cfg.netobs:
+        # this shard bound the barrier this round (done-rounds are not
+        # scheduling rounds and do not count, mirroring stats.rounds)
+        stats = stats._replace(
+            win_bound=stats.win_bound
+            + jnp.where(done | (me != bind_shard), 0, 1)[None]
+        )
     if cfg.pressure_abort:
         # pressure signal: the shard-local capacity-drop total (queue-push
         # overflow + merge/merge_rows sheds in queue.dropped, alltoall
@@ -1280,7 +1404,7 @@ def _window_step(
         out = out._replace(
             trace=_trace_round(
                 cfg, st, st_m, st_x, window_end, done, steps, occ, ob_hwm,
-                params.faults,
+                params.faults, bind_shard=bind_shard,
             )
         )
     if capture:
@@ -1290,7 +1414,7 @@ def _window_step(
 
 def _trace_round(
     cfg: EngineConfig, st0: SimState, st_m: SimState, st_x: SimState,
-    window_end, done, steps, occ, ob_hwm, faults=None,
+    window_end, done, steps, occ, ob_hwm, faults=None, bind_shard=None,
 ):
     """Append this round's record to the in-scan trace ring.
 
@@ -1338,6 +1462,16 @@ def _trace_round(
             faults, jnp.broadcast_to(window_end, (h,))
         )
         vals[COL_HOSTS_DOWN] = jnp.sum(down, dtype=jnp.int64)
+    if cfg.netobs:
+        # network-observatory columns (netobs-off traced runs keep zeros
+        # here — the columns exist so recorded traces stay positional)
+        vals[COL_EC_TIMER] = delta(lambda s: s.ec_timer)
+        vals[COL_EC_PKT] = delta(lambda s: s.ec_pkt)
+        vals[COL_EC_APP] = delta(lambda s: s.ec_app)
+        if cfg.flow_ledger_active:
+            vals[COL_FLOWS] = delta(lambda s: s.fl_done)
+        if bind_shard is not None:
+            vals[COL_BIND_SHARD] = bind_shard
     row = jnp.stack([jnp.asarray(v, jnp.int64) for v in vals])
     # the cursor is a registered i64 lane (core/lanes.py); the slice index
     # stays i64 rather than narrowing the lane value (shadowlint R2)
@@ -1407,6 +1541,25 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
 
     is_pkt = (ev.kind & KIND_PKT) != 0
 
+    if cfg.netobs:
+        # event-class accounting (obs/netobs.py): every EXECUTED event —
+        # the same `active` mask stats.events counts, so the class sums
+        # reconcile exactly with the event total — buckets as packet
+        # (engine KIND_PKT flag), timer (the model's declared
+        # timer_kinds), or app (the rest). Three [H] masks + sums per
+        # event; traced only when the observatory is on.
+        cls_timer = active & ~is_pkt & kind_in(
+            ev.kind & KIND_MASK, tuple(getattr(model, "timer_kinds", ()))
+        )
+        stats = stats._replace(
+            ec_timer=stats.ec_timer
+            + jnp.sum(cls_timer, dtype=jnp.int64)[None],
+            ec_pkt=stats.ec_pkt
+            + jnp.sum(active & is_pkt, dtype=jnp.int64)[None],
+            ec_app=stats.ec_app
+            + jnp.sum(active & ~is_pkt & ~cls_timer, dtype=jnp.int64)[None],
+        )
+
     if cfg.shaping:
         needs_ingress = active & is_pkt & ((ev.kind & KIND_INGRESS_DONE) == 0)
 
@@ -1472,6 +1625,34 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
     seq = c.seq
     sent_round = c.sent_round
     tb_eg = c.tb_egress
+
+    # ---- flow-completion port (network observatory): the model's
+    # FlowDone record becomes one ledger entry (applied in a fused pass
+    # by _finish_microstep) and the fl_* totals advance on an
+    # INDEPENDENT path from the ring cursor, so reconciliation between
+    # the two is a real check. Not traced unless the ledger is on.
+    flow_list = []
+    if cfg.flow_ledger_active and out.flow is not None:
+        f = out.flow
+        fmask = f.mask & dispatch
+        fbytes = jnp.asarray(f.bytes, jnp.int64)
+        frtx = jnp.asarray(f.retransmits, jnp.int64)
+        stats = stats._replace(
+            fl_done=stats.fl_done + jnp.sum(fmask, dtype=jnp.int64)[None],
+            fl_bytes=stats.fl_bytes
+            + jnp.sum(jnp.where(fmask, fbytes, 0))[None],
+            fl_rtx=stats.fl_rtx
+            + jnp.sum(jnp.where(fmask, frtx, 0))[None],
+        )
+        flow_list.append((
+            fmask,
+            jnp.asarray(f.dst, jnp.int64),
+            jnp.asarray(f.flow, jnp.int64),
+            jnp.asarray(f.t_start, jnp.int64),
+            ev.t,  # completion time = this event's execution time
+            fbytes,
+            frtx,
+        ))
 
     # ---- local pushes (schedule_task_* analogue). All ports are applied
     # in ONE slab pass (push_many): sequential push_one calls each pay a
@@ -1661,6 +1842,7 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
         push_list,
         entries,
         used_lats,
+        flow_list,
     )
 
 
@@ -1672,9 +1854,48 @@ def _ev_carry_of(st: SimState) -> _EvCarry:
     )
 
 
-def _finish_microstep(st: SimState, c: _EvCarry, queue, ob_entries, used_lats):
-    """Apply a microstep's accumulated outbox appends (one fused slab pass),
-    fold the used-latency lookahead, and reassemble the SimState."""
+def _flow_append(cfg: EngineConfig, ledger: FlowLedger, host_gid, entries):
+    """Append a microstep's flow-completion entries to the per-shard
+    ledger ring, in chronological entry order with host-major slot
+    assignment inside each entry (an exclusive prefix-sum over the mask
+    gives every completing host its own slot — no collisions by
+    construction). Writes land at `cursor % R`; hosts beyond the mask
+    scatter to index R, which `mode="drop"` discards — counted later by
+    the FlowCollector against the monotone cursor, never silent."""
+    fr = cfg.flow_records
+    rows = ledger.rows[0]  # shard-local [R, F] plane
+    cur = ledger.cursor[0]
+    for mask, dst, fidx, t0, t1, fbytes, frtx in entries:
+        m64 = mask.astype(jnp.int64)
+        ofs = jnp.cumsum(m64) - m64  # exclusive prefix: per-host slot
+        n = jnp.sum(m64)
+        slot = (cur + ofs) % fr
+        # only the NEWEST fr completions of this entry get a live slot:
+        # with more than fr completions in ONE microstep (H > fr shards
+        # under synchronized FIN-ACKs) slots would wrap WITHIN a single
+        # scatter, and duplicate scatter indices have an unspecified
+        # winner — masking ofs < n - fr keeps the indices unique (a
+        # window of fr consecutive offsets maps injectively mod fr) and
+        # preserves the ring's newest-overwrites-oldest contract. The
+        # cursor still advances by n, so the collector counts exactly
+        # these drops as wrap losses — nothing silent.
+        live = mask & (ofs >= n - fr)
+        idx = jnp.where(live, slot, jnp.int64(fr))  # others -> dropped
+        row = jnp.stack(
+            [host_gid, dst, fidx, t0, t1, fbytes, frtx], axis=1
+        )  # [H, FLOW_COLS] i64, netobs.FLOW_FIELDS column order
+        rows = rows.at[idx].set(row, mode="drop")
+        cur = cur + n
+    return FlowLedger(rows=rows[None], cursor=cur[None])
+
+
+def _finish_microstep(
+    cfg: EngineConfig, st: SimState, c: _EvCarry, queue, ob_entries,
+    used_lats, flow_entries, host_gid,
+):
+    """Apply a microstep's accumulated outbox appends (one fused slab pass)
+    and flow-ledger appends, fold the used-latency lookahead, and
+    reassemble the SimState."""
     outbox = st.outbox
     ob_lost = jnp.zeros((), jnp.int64)
     if ob_entries:
@@ -1685,6 +1906,10 @@ def _finish_microstep(st: SimState, c: _EvCarry, queue, ob_entries, used_lats):
                 st.min_used_lat,
                 jnp.min(jnp.stack([jnp.min(u) for u in used_lats])),
             )
+        )
+    if flow_entries:
+        st = st._replace(
+            flows=_flow_append(cfg, st.flows, host_gid, flow_entries)
         )
     stats = c.stats._replace(ob_dropped=c.stats.ob_dropped + ob_lost[None])
     return st._replace(
@@ -1764,12 +1989,14 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
             )
         )
 
-    c, push_list, ob_entries, used_lats = _event_body(
+    c, push_list, ob_entries, used_lats, flow_entries = _event_body(
         cfg, model, _ev_carry_of(st), params, host_gid, window_end, ev, active
     )
     if push_list:
         queue = q_push_many(queue, push_list)
-    return _finish_microstep(st, c, queue, ob_entries, used_lats)
+    return _finish_microstep(
+        cfg, st, c, queue, ob_entries, used_lats, flow_entries, host_gid
+    )
 
 
 def _lex_less(at, ao, bt, bo):
@@ -1838,6 +2065,7 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
     push_lists = []  # per batch index, K=1 chronological order
     ob_entries = []
     used_lats = []
+    flow_entries = []  # flow-ledger appends, K=1 chronological order
     for j in range(k):
         ev = popped.event(j)
         down_j = resume_j = None
@@ -1877,9 +2105,10 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
             fd = cons_j & down_x
             fault_drop = fault_drop + fd
             exec_j = cons_j & ~fd
-        c, push_list, entries, lats = _event_body(
+        c, push_list, entries, lats, flows_j = _event_body(
             cfg, model, c, params, host_gid, window_end, ev, exec_j
         )
+        flow_entries += flows_j
         # accumulate this event's push keys into the guard minimum AFTER
         # its own execution (an event's pushes cannot defer itself)
         for push in push_list:
@@ -1928,7 +2157,9 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
     c = c._replace(stats=stats)
     if cfg.cpu_delay_ns > 0:
         st = st._replace(cpu_busy_until=busy)
-    st = _finish_microstep(st, c, queue, ob_entries, used_lats)
+    st = _finish_microstep(
+        cfg, st, c, queue, ob_entries, used_lats, flow_entries, host_gid
+    )
     return st, m
 
 

@@ -67,8 +67,14 @@ ORDER_LANES = frozenset({"order", "seq"})
 
 # Monotone counter lanes: int64. A long campaign overflows i32 counters
 # (events at 10k hosts pass 2^31 in under an hour of sim time), and the
-# trace ring's cursor arithmetic assumes no wrap.
-COUNTER_LANES = frozenset({"cursor", "rounds", "microsteps", "events"})
+# trace/flow rings' cursor arithmetic assumes no wrap. The network
+# observatory's class/flow/safe-window counters are the same species
+# (fl_bytes at 10k flows/s of 100 KiB flows passes 2^31 in minutes).
+COUNTER_LANES = frozenset({
+    "cursor", "rounds", "microsteps", "events",
+    "ec_timer", "ec_pkt", "ec_app",
+    "fl_done", "fl_bytes", "fl_rtx", "win_bound",
+})
 
 # Digest lanes: uint64 (FNV-1a fold, core/engine.py _digest_update).
 DIGEST_LANES = frozenset({"digest"})
@@ -165,6 +171,19 @@ STATE_LANES: dict[str, str] = {
     # escalate/abort — core/pressure.py; the default drop policy carries
     # None here and traces no pressure code)
     "stats.pressure": "int64",
+    # network-observatory lanes (obs/netobs.py; present only when
+    # observability.network is on — the fl_*/flows planes additionally
+    # require an active flow ledger). Event-class counts, flow-ledger
+    # totals, safe-window binder counts, and the ledger ring itself.
+    "stats.ec_timer": "int64",
+    "stats.ec_pkt": "int64",
+    "stats.ec_app": "int64",
+    "stats.fl_done": "int64",
+    "stats.fl_bytes": "int64",
+    "stats.fl_rtx": "int64",
+    "stats.win_bound": "int64",
+    "flows.rows": "int64",
+    "flows.cursor": "int64",
     "stats.digest": "uint64",
 }
 
@@ -184,6 +203,8 @@ STATE_LANES: dict[str, str] = {
 #   S   the per-shard element of a [world]-sharded plane (always 1)
 #   R   trace_rounds (ring rows; plane absent when 0)
 #   F   len(TRACE_FIELDS) (obs/tracer.py ring columns)
+#   FR  flow_records (flow-ledger ring rows; flows planes absent when 0)
+#   FF  len(FLOW_FIELDS) (obs/netobs.py ledger columns)
 #
 # Integer entries are literal dimensions. Stage A stays jax-free: tokens
 # only, no imports. tests/test_memory.py asserts this dict covers
@@ -199,6 +220,8 @@ _STATS_PER_HOST = (
 _STATS_PER_SHARD = (
     "ob_dropped", "a2a_shed", "microsteps", "bq_rebuilds", "popk_deferred",
     "ici_bytes", "outbox_hwm", "gear_shed", "pressure",
+    "ec_timer", "ec_pkt", "ec_app", "fl_done", "fl_bytes", "fl_rtx",
+    "win_bound",
 )
 
 STATE_LANE_SHAPES: dict[str, tuple] = {
@@ -225,6 +248,8 @@ STATE_LANE_SHAPES: dict[str, tuple] = {
     "outbox.count": ("S",),
     "trace.rows": ("S", "R", "F"),
     "trace.cursor": ("S",),
+    "flows.rows": ("S", "FR", "FF"),
+    "flows.cursor": ("S",),
     **{f"stats.{f}": ("H",) for f in _STATS_PER_HOST},
     **{f"stats.{f}": ("S",) for f in _STATS_PER_SHARD},
     "stats.digest": ("H",),
@@ -237,7 +262,19 @@ STATE_LANE_SHAPES: dict[str, tuple] = {
 # shadow_tpu/sim.py stats_report or listed here with a reason).
 # ---------------------------------------------------------------------------
 
+_NETOBS_EXPORT_REASON = (
+    "exported through the sim-stats network{} block assembled by "
+    "obs/netobs.assemble_network_report (the ONE shared helper sim.py, "
+    "cosim.py, and bench.py all call — it reads the lane directly so "
+    "the block's shape cannot drift between exporters); gated on "
+    "observability.network, None otherwise"
+)
+
 STATS_EXPORT_EXEMPT: dict[str, str] = {
+    **{f: _NETOBS_EXPORT_REASON for f in (
+        "ec_timer", "ec_pkt", "ec_app",
+        "fl_done", "fl_bytes", "fl_rtx", "win_bound",
+    )},
     "gear_shed": (
         "transient gear-abort control signal: a shedding chunk is "
         "discarded and replayed from its pre-chunk snapshot, so the "

@@ -223,6 +223,10 @@ class HybridSimulation:
             trace_rounds=(
                 max(auto_rpc, 1) if cfg.observability.trace else 0
             ),
+            # network observatory: event-class + safe-window accounting
+            # ride along on the hybrid device plane (the hybrid model has
+            # no flow port, so no flow ledger here)
+            netobs=cfg.observability.network,
             microstep_limit=ex.microstep_limit,
             # the K-way fold and the flipped multi-device exchange default
             # ride along on hybrid sims: both act below the bridge (the
@@ -727,12 +731,20 @@ class HybridSimulation:
                     f"hbm={self._memmon.hwm_bytes()} "
                     if self._memmon is not None else ""
                 )
+                ek_f = ""
+                if self.engine_cfg.netobs:
+                    _s = self.state.stats
+                    ek_f = (
+                        f"ek={int(np.asarray(_s.ec_timer).sum())}/"
+                        f"{int(np.asarray(_s.ec_pkt).sum())} "
+                    )
                 print(
                     f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s windows={windows} "
                     f"{fault_f}"
                     f"{gear_f}"
                     f"{hbm_f}"
+                    f"{ek_f}"
                     f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                     f"{simmod.resource_heartbeat()}",
                     file=log,
@@ -1083,11 +1095,31 @@ class HybridSimulation:
                 else {}
             ),
             **(
+                {"network": self._network_report(s, n)}
+                if self.engine_cfg.netobs
+                else {}
+            ),
+            **(
                 {"memory": self._memory_report()}
                 if self._memmon is not None
                 else {}
             ),
         }
+
+    def _network_report(self, s, n: int) -> dict:
+        """Network-observatory block for the hybrid device plane: event
+        classes + safe-window telemetry + the per-link fold over the
+        modeled lanes (the CPU plane's per-socket/interface counters
+        already live in host-stats.json). The hybrid model carries no
+        flow port and no per-host hook, so no ledger/model fields."""
+        from shadow_tpu.obs.netobs import assemble_network_report, node_map
+
+        return assemble_network_report(
+            stats=s,
+            num_real=n,
+            rounds=int(s.rounds),
+            node_of=node_map(self.specs, n),
+        )
 
     def _memory_report(self) -> dict:
         from shadow_tpu.obs.memory import observatory_report

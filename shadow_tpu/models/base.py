@@ -88,15 +88,51 @@ class PacketSend(NamedTuple):
     count_max: int = 1  # static burst width (trace-time)
 
 
+class FlowDone(NamedTuple):
+    """A flow-completion record for the network observatory's flow ledger
+    (obs/netobs.py): emitted by models that track application flows (the
+    tgen client's FIN-ACK), consumed by the engine ONLY when the ledger is
+    traced in (`EngineConfig.flow_ledger_active`) — an observer, so
+    emitting it never changes digests, events, or drops. All arrays are
+    per-host lanes; at most one flow completes per host per microstep
+    (the same one-event-per-host contract every emission port obeys)."""
+
+    mask: Array  # bool[H] this host completed a flow at this event
+    dst: Array  # i32/i64[H] the peer (server) host id
+    flow: Array  # i32[H] model flow index (tgen: the completed phase)
+    t_start: Array  # i64[H] flow start sim-time (ns)
+    bytes: Array  # i32/i64[H] application payload bytes transferred
+    retransmits: Array  # i32/i64[H] retransmitted segments of THIS flow
+
+
 class HandlerOut(NamedTuple):
     state: Any
     rng: RngState
     pushes: tuple[LocalPush, ...] = ()
     sends: tuple[PacketSend, ...] = ()
+    # flow-completion port (network observatory): None for models without
+    # application flows. The engine reads it only when the flow ledger is
+    # traced in, so carrying it costs nothing when the observatory is off.
+    flow: Any = None  # FlowDone | None
 
 
 class Model(Protocol):
-    """A host application model (see module docstring for the contract)."""
+    """A host application model (see module docstring for the contract).
+
+    Network-observatory hooks (all optional, observer-only):
+      - `timer_kinds`: tuple of model event kinds that are TIMER events
+        (retransmit/delayed-ACK/periodic timers) for the observatory's
+        event-class accounting. Packet arrivals classify as `packet` via
+        the engine's KIND_PKT flag; non-packet kinds outside this tuple
+        classify as `app`. Default () = no timer kinds.
+      - `flow_ledger`: True when `handle` emits `HandlerOut.flow`
+        completion records (the drivers size a device flow ledger only
+        for such models).
+      - `per_host_network(state) -> dict[str, array]`: host-side hook
+        returning per-host [H] network counters from final model state
+        (e.g. {"bytes": ..., "retransmits": ...}) folded into the
+        per-link/per-host report. Default absent = engine counters only.
+    """
 
     name: str
 

@@ -47,6 +47,9 @@ def _unpack_t(lo, hi):
 class UdpEchoModel:
     name = "udp_echo"
     wire_kind = KIND_REQ  # cross-plane packets arrive as requests (mixed sims)
+    # observatory event classes: the client send tick is the model's one
+    # timer lane; requests/responses classify as packets via KIND_PKT
+    timer_kinds = (KIND_TICK,)
     # this protocol IS echo-the-payload: a native request's payload words
     # (byte-store key + magic) must ride back verbatim so the bridge can
     # reconstruct the exact reply bytes (cosim._drain_captures); the server

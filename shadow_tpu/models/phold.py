@@ -33,6 +33,9 @@ KIND_MSG = 1  # message arrives: hold it, then it matures
 class PholdModel:
     name = "phold"
     wire_kind = KIND_MSG  # cross-plane packets count as held messages (mixed sims)
+    # observatory event classes: a matured job IS a timer fire (the held
+    # message's exponential delay elapsing); arrivals classify as packets
+    timer_kinds = (KIND_JOB,)
 
     def build(self, hosts, seed):
         h = len(hosts)

@@ -64,6 +64,7 @@ from jax import lax
 
 from shadow_tpu.config.units import TimeUnit, parse_time_ns
 from shadow_tpu.models.base import (
+    FlowDone,
     HandlerCtx,
     HandlerOut,
     LocalPush,
@@ -116,6 +117,12 @@ def _ctz32(x):
 class TgenTcpModel:
     name = "tgen_tcp"
     wire_kind = KIND_SEG
+    # network-observatory hooks (models/base.py Model docstring): the TCP
+    # timer lanes are the retransmit and delayed-ACK timers — exactly the
+    # events ROADMAP item 2's timer-wheel decision needs counted. TICK
+    # (flow pacing) and TX (transmit continuation) classify as app.
+    timer_kinds = (KIND_RTO, KIND_DELACK)
+    flow_ledger = True  # handle() emits FlowDone records at FIN-ACK
 
     def build(self, hosts, seed):
         h = len(hosts)
@@ -215,6 +222,10 @@ class TgenTcpModel:
             "fast_rtx": zi64(),
             "timeouts": zi64(),
             "flows_done": zi64(),
+            # per-flow retransmit base: d_rtx at the current flow's start,
+            # so the flow ledger's per-flow retransmit count is a cheap
+            # subtraction at FIN-ACK (inert when the observatory is off)
+            "flow_rtx0": zi64(),
             "fct_sum": zi64(),
             "segs_rcvd": zi64(),
             "dup_segs": zi64(),
@@ -427,7 +438,20 @@ class TgenTcpModel:
         all_acked = new_acked & (st["snd_una"] >= L) & (st["c_state"] == CST_EST)
         st["c_state"] = jnp.where(all_acked, CST_FIN, st["c_state"])
 
-        # ---- FIN-ACK: flow complete; next phase or done
+        # ---- FIN-ACK: flow complete; next phase or done. The flow-ledger
+        # record is captured HERE, before the phase/flow_t0 lanes advance:
+        # the completed flow's identity is (this host, c_peer, my_phase),
+        # its span [flow_t0, t), its payload L segments x mss bytes, and
+        # its retransmits the d_rtx delta since the flow started. Pure
+        # observation — the engine reads it only when the ledger is on.
+        flow_done = FlowDone(
+            mask=finack_in,
+            dst=st["c_peer"],
+            flow=my_phase,
+            t_start=st["flow_t0"],
+            bytes=L.astype(jnp.int64) * p["mss"].astype(jnp.int64),
+            retransmits=st["d_rtx"] - st["flow_rtx0"],
+        )
         phase1 = jnp.where(finack_in, my_phase + 1, my_phase)
         more = finack_in & (phase1 < p["flows"])
         st["c_phase"] = phase1
@@ -456,6 +480,7 @@ class TgenTcpModel:
         st["rto"] = jnp.where(start, p["rto_init"], st["rto"])
         st["rtt_seq"] = jnp.where(start, -1, st["rtt_seq"])
         st["flow_t0"] = jnp.where(start, t, st["flow_t0"])
+        st["flow_rtx0"] = jnp.where(start, st["d_rtx"], st["flow_rtx0"])
 
         # ---- TX continuation: up to tx_batch DATA segments per microstep
         # (one send port each; same-round departure makes the wire result
@@ -663,10 +688,21 @@ class TgenTcpModel:
         )
 
         return HandlerOut(
-            state=st, rng=ctx.rng, pushes=(port_a, port_b), sends=(send,)
+            state=st, rng=ctx.rng, pushes=(port_a, port_b), sends=(send,),
+            flow=flow_done,
         )
 
     # ------------------------------------------------------------------ #
+
+    def per_host_network(self, state):
+        """Per-host network counters for the observatory's per-link fold
+        (models/base.py Model docstring): payload bytes RECEIVED (charged
+        to the server side) and data segments retransmitted (charged to
+        the client side)."""
+        return {
+            "bytes": np.asarray(state["bytes_rcvd"]),
+            "retransmits": np.asarray(state["d_rtx"]),
+        }
 
     def report(self, state, hosts):
         done = np.asarray(state["flows_done"])

@@ -21,6 +21,8 @@ KIND_FIRE = 0
 @register_model
 class TimerModel:
     name = "timer"
+    # observatory event classes: every event this model handles is a timer
+    timer_kinds = (KIND_FIRE,)
 
     def build(self, hosts, seed):
         h = len(hosts)

@@ -12,8 +12,11 @@ from shadow_tpu.obs.perf import PerfTimers
 from shadow_tpu.obs.simlog import SimLogger, format_sim_time
 from shadow_tpu.obs.tracer import ReplicaTracer, RoundTracer, TraceRing
 from shadow_tpu.obs.memory import MemoryGuard, MemoryMonitor
+from shadow_tpu.obs.netobs import FlowCollector, FlowLedger
 
 __all__ = [
+    "FlowCollector",
+    "FlowLedger",
     "MemoryGuard",
     "MemoryMonitor",
     "PcapWriter",

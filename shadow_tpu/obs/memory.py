@@ -77,14 +77,18 @@ def dims_of(
     send_budget: int = 8,
     trace_rounds: int = 0,
     pressure: bool = False,
+    netobs: bool = False,
+    flow_records: int = 0,
     payload_words: int | None = None,
     trace_cols: int | None = None,
+    flow_cols: int | None = None,
 ) -> dict[str, int]:
     """Resolve the STATE_LANE_SHAPES dimension tokens for one shape.
 
-    `payload_words`/`trace_cols` default to the live constants
-    (ops.events.EVENT_PAYLOAD_WORDS / len(tracer.TRACE_FIELDS)) — pass
-    them explicitly only when modeling a foreign layout."""
+    `payload_words`/`trace_cols`/`flow_cols` default to the live
+    constants (ops.events.EVENT_PAYLOAD_WORDS / len(tracer.TRACE_FIELDS)
+    / len(netobs.FLOW_FIELDS)) — pass them explicitly only when modeling
+    a foreign layout."""
     if payload_words is None:
         from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
 
@@ -93,6 +97,10 @@ def dims_of(
         from shadow_tpu.obs.tracer import TRACE_COLS
 
         trace_cols = TRACE_COLS
+    if flow_cols is None:
+        from shadow_tpu.obs.netobs import FLOW_COLS
+
+        flow_cols = FLOW_COLS
     return {
         "H": int(hosts_per_shard),
         "C": int(queue_capacity),
@@ -102,7 +110,10 @@ def dims_of(
         "S": 1,
         "R": int(trace_rounds),
         "F": int(trace_cols),
+        "FR": int(flow_records) if netobs else 0,
+        "FF": int(flow_cols),
         "pressure": 1 if pressure else 0,
+        "netobs": 1 if netobs else 0,
     }
 
 
@@ -115,6 +126,8 @@ def dims_of_config(cfg) -> dict[str, int]:
         send_budget=cfg.sends_per_host_round,
         trace_rounds=cfg.trace_rounds,
         pressure=cfg.pressure_abort,
+        netobs=cfg.netobs,
+        flow_records=cfg.flow_records,
     )
 
 
@@ -136,6 +149,10 @@ def dims_of_state(cfg, state) -> dict[str, int]:
             int(state.trace.rows.shape[-2]) if state.trace is not None else 0
         ),
         pressure=state.stats.pressure is not None,
+        netobs=state.stats.ec_timer is not None,
+        flow_records=(
+            int(state.flows.rows.shape[-2]) if state.flows is not None else 0
+        ),
     )
 
 
@@ -150,6 +167,18 @@ def lane_plane_bytes(path: str, dims: dict[str, int]) -> int | None:
     if path.startswith("trace.") and dims["R"] == 0:
         return None
     if path == "stats.pressure" and not dims["pressure"]:
+        return None
+    # network-observatory planes: class/safe-window lanes ride with the
+    # knob, flow lanes additionally require an active ledger ring
+    if path in (
+        "stats.ec_timer", "stats.ec_pkt", "stats.ec_app", "stats.win_bound"
+    ) and not dims.get("netobs"):
+        return None
+    if path in ("stats.fl_done", "stats.fl_bytes", "stats.fl_rtx") and (
+        not dims.get("netobs") or dims.get("FR", 0) == 0
+    ):
+        return None
+    if path.startswith("flows.") and dims.get("FR", 0) == 0:
         return None
     n = 1
     for tok in shape:
@@ -269,8 +298,8 @@ def static_model(cfg, state=None, params=None, replicas: int = 1) -> dict:
         fields = state_field_bytes(state)
         measured_total = sum(fields.values())
         covered = {
-            "queue", "outbox", "stats", "trace", "rng", "now", "done",
-            "seq", "sent_round", "cpu_busy_until", "min_used_lat",
+            "queue", "outbox", "stats", "trace", "flows", "rng", "now",
+            "done", "seq", "sent_round", "cpu_busy_until", "min_used_lat",
         }
         unreg = {
             k: v // world for k, v in fields.items() if k not in covered
@@ -303,6 +332,8 @@ def state_bytes_at(cfg, capacity: int, send_budget: int) -> int:
         send_budget=send_budget or cfg.sends_per_host_round,
         trace_rounds=cfg.trace_rounds,
         pressure=cfg.pressure_abort,
+        netobs=cfg.netobs,
+        flow_records=cfg.flow_records,
     )
     return sum(component_totals(registered_component_bytes(dims)).values())
 

@@ -57,6 +57,12 @@ TRACE_FIELDS = (
     "faults_delayed", # fault-plane delays this round (delta, this shard)
     "hosts_down",     # hosts inside a crash window at this round's end
     "cap",            # active per-host queue capacity (pressure plane)
+    # network observatory (obs/netobs.py; zero unless observability.network)
+    "ec_timer",       # timer-class events executed this round (delta)
+    "ec_pkt",         # packet-class events executed this round (delta)
+    "ec_app",         # app-class events executed this round (delta)
+    "flows",          # flows completed this round (delta, this shard)
+    "bind_shard",     # shard whose local min bound the barrier this round
 )
 TRACE_COLS = len(TRACE_FIELDS)
 (
@@ -78,7 +84,16 @@ TRACE_COLS = len(TRACE_FIELDS)
     COL_FAULTS_DELAYED,
     COL_HOSTS_DOWN,
     COL_CAP,
+    COL_EC_TIMER,
+    COL_EC_PKT,
+    COL_EC_APP,
+    COL_FLOWS,
+    COL_BIND_SHARD,
 ) = range(TRACE_COLS)
+
+
+# flow-track export cap (note_flows): complete events drawn per trace
+MAX_FLOW_EVENTS = 20_000
 
 
 class TraceRing(NamedTuple):
@@ -134,6 +149,12 @@ class RoundTracer:
         # chunk boundaries): (wall_t, (per-shard bytes,)) — exported as a
         # counter track on the wall-clock timeline + Prometheus gauges
         self._memory: list[tuple[float, tuple[int, ...]]] = []
+        # drained flow-ledger records (obs/netobs.py FlowCollector rows,
+        # [n, FLOW_COLS]) — exported as a sim-time flow track. Bounded:
+        # beyond MAX_FLOW_EVENTS the newest records are counted, not drawn
+        # (a million-flow run must not grow a GB-scale trace JSON).
+        self._flows: list[np.ndarray] = []
+        self._flows_seen = 0
 
     # ---- collection --------------------------------------------------------
 
@@ -219,6 +240,30 @@ class RoundTracer:
             (float(wall_t), tuple(int(b) for b in per_shard_bytes))
         )
 
+    def note_flows(self, records: np.ndarray) -> None:
+        """Adopt a batch of drained flow-ledger records ([n, FLOW_COLS],
+        obs/netobs.py column order) for the sim-time flow track. Records
+        beyond the export cap are counted in otherData, never silent."""
+        n = int(records.shape[0])
+        if n == 0:
+            return
+        kept = sum(r.shape[0] for r in self._flows)
+        room = max(0, MAX_FLOW_EVENTS - kept)
+        if room:
+            self._flows.append(np.asarray(records[:room], np.int64))
+        self._flows_seen += n
+
+    def reset_flows(self, records: np.ndarray) -> None:
+        """Replace the flow track with exactly `records` — the abort
+        paths call this with the FlowCollector's post-truncation record
+        set so the drawn track covers exactly the exported prefix (the
+        flow-track analogue of `truncate_to_round`; without it, flows
+        drained from chunks the export rewound past would still be
+        drawn)."""
+        self._flows = []
+        self._flows_seen = 0
+        self.note_flows(np.asarray(records, np.int64))
+
     @property
     def rounds(self) -> int:
         return self._cursor - self._origin - self.lost
@@ -258,6 +303,9 @@ class RoundTracer:
                        "tid": s + 1, "args": {"name": f"rounds shard {s}"}})
         ev.append({"ph": "M", "name": "thread_name", "pid": 1,
                    "tid": world + 1, "args": {"name": "exchange"}})
+        if self._flows:
+            ev.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": world + 2, "args": {"name": "flows"}})
         for s in range(world):
             for r in rows[s]:
                 args = {f: int(v) for f, v in zip(TRACE_FIELDS, r)}
@@ -279,6 +327,28 @@ class RoundTracer:
                                  "a2a_shed": int(r[COL_A2A_SHED]),
                                  "ici_bytes": int(r[COL_ICI_BYTES])},
                     })
+        # flow track (obs/netobs.py ledger records): one complete event per
+        # drained flow, spanning [t_start, t_end) on the sim-time timeline
+        if self._flows:
+            from shadow_tpu.obs.netobs import (
+                FCOL_BYTES, FCOL_DST, FCOL_FLOW, FCOL_RETRANSMITS,
+                FCOL_SRC, FCOL_T_END, FCOL_T_START,
+            )
+
+            for rec in np.concatenate(self._flows, axis=0):
+                ts = rec[FCOL_T_START] / 1e3  # sim ns -> us
+                dur = max(int(rec[FCOL_T_END] - rec[FCOL_T_START]), 1) / 1e3
+                ev.append({
+                    "name": f"flow {int(rec[FCOL_SRC])}"
+                            f"->{int(rec[FCOL_DST])}",
+                    "cat": "flow", "ph": "X", "ts": ts, "dur": dur,
+                    "pid": 1, "tid": world + 2,
+                    "args": {
+                        "flow": int(rec[FCOL_FLOW]),
+                        "bytes": int(rec[FCOL_BYTES]),
+                        "retransmits": int(rec[FCOL_RETRANSMITS]),
+                    },
+                })
         for i, c in enumerate(self._chunks):
             ev.append({
                 "name": f"chunk {i}", "cat": "chunk", "ph": "X",
@@ -298,14 +368,19 @@ class RoundTracer:
                 "pid": 2, "tid": 1,
                 "args": {f"shard{s}": b for s, b in enumerate(shards)},
             })
+        other = {
+            "rounds_traced": self.rounds,
+            "rounds_lost": self.lost,
+            "trace_fields": list(TRACE_FIELDS),
+        }
+        if self._flows_seen:
+            drawn = sum(r.shape[0] for r in self._flows)
+            other["flows_drawn"] = drawn
+            other["flows_not_drawn"] = self._flows_seen - drawn
         return {
             "traceEvents": ev,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "rounds_traced": self.rounds,
-                "rounds_lost": self.lost,
-                "trace_fields": list(TRACE_FIELDS),
-            },
+            "otherData": other,
         }
 
     def write_chrome_trace(self, path: str) -> str:
@@ -343,6 +418,11 @@ class RoundTracer:
             "faults_delayed": _sum(COL_FAULTS_DELAYED),
             "hosts_down_max": _max(COL_HOSTS_DOWN),
             "cap_max": _max(COL_CAP),
+            # network-observatory columns (zero on untraced-netobs runs)
+            "ec_timer": _sum(COL_EC_TIMER),
+            "ec_pkt": _sum(COL_EC_PKT),
+            "ec_app": _sum(COL_EC_APP),
+            "flows": _sum(COL_FLOWS),
         }
 
     def gear_histogram(self) -> dict:
@@ -485,6 +565,10 @@ _REPLICA_SUM_COLS = {
     "a2a_shed": COL_A2A_SHED,
     "faults_dropped": COL_FAULTS_DROPPED,
     "faults_delayed": COL_FAULTS_DELAYED,
+    "ec_timer": COL_EC_TIMER,
+    "ec_pkt": COL_EC_PKT,
+    "ec_app": COL_EC_APP,
+    "flows": COL_FLOWS,
 }
 _REPLICA_MAX_COLS = {
     "occ_hwm": COL_OCC_HWM,

@@ -861,3 +861,16 @@ def q_pop_k(q, limit, k: int) -> PoppedK:
 
 def q_clear_popped(q, popped: PoppedK, m):
     return clear_popped(q, popped, m)
+
+
+def kind_in(kind, kinds: tuple[int, ...]) -> Array:
+    """bool mask: `kind` equals any of the STATIC `kinds` tuple — the
+    network observatory's event-class membership test (a chain of eqs
+    XLA fuses; `kinds` is trace-time static, typically 1-2 entries).
+    An empty tuple yields all-False without reading `kind`'s values."""
+    if not kinds:
+        return jnp.zeros(kind.shape, bool)
+    m = kind == kinds[0]
+    for k in kinds[1:]:
+        m = m | (kind == k)
+    return m

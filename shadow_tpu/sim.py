@@ -353,6 +353,8 @@ def heartbeat_line(
     gear: int | None = None,
     cap: int | None = None,
     hbm: int | None = None,
+    ek: tuple[int, int] | None = None,
+    fct: int | None = None,
     rep: tuple[int, int] | None = None,
 ) -> str:
     """The `[heartbeat]` progress line, shared by the Simulation run loop
@@ -364,11 +366,15 @@ def heartbeat_line(
     pressure-plane runs (escalation regrows it mid-run); `hbm` is the
     per-shard HBM high-water in bytes (memory observatory runs —
     obs/memory.py, the reference's per-host allocated-memory heartbeat);
-    `rep` is (replicas done, total) on ensemble campaign runs."""
+    `rep` is (replicas done, total) on ensemble campaign runs; `ek` is
+    (timer events, packet events) and `fct` the flows completed so far —
+    both only on network-observatory runs (obs/netobs.py)."""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
     gear_f = f"gear={gear} " if gear is not None else ""
     cap_f = f"cap={cap} " if cap is not None else ""
     hbm_f = f"hbm={hbm} " if hbm is not None else ""
+    ek_f = f"ek={ek[0]}/{ek[1]} " if ek is not None else ""
+    fct_f = f"fct={fct} " if fct is not None else ""
     rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
     return (
         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
@@ -381,6 +387,8 @@ def heartbeat_line(
         f"{gear_f}"
         f"{cap_f}"
         f"{hbm_f}"
+        f"{ek_f}"
+        f"{fct_f}"
         f"{rep_f}"
         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
         f"{resource_heartbeat()}"
@@ -510,6 +518,16 @@ class Simulation:
             # round tracer ring sized to the chunk length: the run loop
             # drains at every chunk boundary, so the ring can never wrap
             trace_rounds=rpc if cfg.observability.trace else 0,
+            # network observatory (obs/netobs.py): event classes + safe
+            # window ride the knob; the flow ledger only for models that
+            # declare a flow port (tgen) — other models carry no ring
+            netobs=cfg.observability.network,
+            flow_records=(
+                cfg.observability.network_flows
+                if cfg.observability.network
+                and getattr(self.model, "flow_ledger", False)
+                else 0
+            ),
             fault_crash_windows=self._fault_sched.crash_windows,
             fault_loss_windows=self._fault_sched.loss_windows,
             fault_queue_clear=self._fault_sched.queue_clear,
@@ -629,6 +647,24 @@ class Simulation:
             # ring; start draining from the current cursor, not zero
             tracer.sync_cursor(self.state.trace)
             self._tracer = tracer
+        flowcol = None
+        if self.engine_cfg.flow_ledger_active:
+            # network observatory's flow ledger (obs/netobs.py): drained
+            # at the same chunk boundaries as the trace ring, with the
+            # same checkpoint-resume cursor adoption (pre-snapshot
+            # records are never replayed as fresh completions)
+            from shadow_tpu.obs.netobs import FlowCollector
+
+            flowcol = FlowCollector(self.engine_cfg.flow_records)
+            flowcol.sync_cursor(self.state.flows)
+            self._flowcol = flowcol
+
+        def _drain_flows():
+            if flowcol is not None:
+                n = flowcol.drain(self.state.flows)
+                if n and tracer is not None:
+                    # this drain's records feed the Perfetto flow track
+                    tracer.note_flows(flowcol.last_drained)
         profiling = bool(cfg.observability.profile_dir)
         if profiling:
             os.makedirs(cfg.observability.profile_dir, exist_ok=True)
@@ -778,6 +814,18 @@ class Simulation:
                     wall_t0=t_chunk, wall_t1=time.monotonic(),
                 )
                 tracer.truncate_to_round(int(self.state.stats.rounds))
+            if flowcol is not None:
+                # drained records beyond the exported state's own ledger
+                # cursor cover rounds the artifacts do not — drop them
+                # (FlowCollector.truncate_to_cursor docs this), then
+                # re-seat the trace's flow track on the kept record set
+                jax.block_until_ready(self.state)
+                _drain_flows()
+                flowcol.truncate_to_cursor(
+                    np.asarray(jax.device_get(self.state.flows.cursor))
+                )
+                if tracer is not None:
+                    tracer.reset_flows(flowcol.records())
             self._pressure_aborted = True
 
         from shadow_tpu.core.pressure import PressureAbort
@@ -813,6 +861,19 @@ class Simulation:
                                 tracer.truncate_to_round(
                                     int(self.state.stats.rounds)
                                 )
+                            if flowcol is not None:
+                                # same contract for flow records: the
+                                # exported state's ledger cursor is the
+                                # truth of what its prefix completed —
+                                # and the trace's flow track re-seats on
+                                # the kept record set
+                                flowcol.truncate_to_cursor(
+                                    np.asarray(jax.device_get(
+                                        self.state.flows.cursor
+                                    ))
+                                )
+                                if tracer is not None:
+                                    tracer.reset_flows(flowcol.records())
                         self._aborted = True
                         break
                 else:
@@ -830,6 +891,9 @@ class Simulation:
                         self.state.trace,
                         wall_t0=t_chunk, wall_t1=time.monotonic(),
                     )
+                if flowcol is not None:
+                    jax.block_until_ready(self.state)
+                    _drain_flows()
                 if monitor is not None:
                     t_s = time.monotonic()
                     shard_bytes = monitor.sample(
@@ -870,10 +934,24 @@ class Simulation:
                     hbm = (
                         monitor.hwm_bytes() if monitor is not None else None
                     )
+                    # ek= (timer/packet event counts) and fct= (flows
+                    # completed) ride along only on network-observatory
+                    # runs (fct only when a flow ledger is active)
+                    ek = fct = None
+                    if self.engine_cfg.netobs:
+                        ek = (
+                            int(np.asarray(self.state.stats.ec_timer).sum()),
+                            int(np.asarray(self.state.stats.ec_pkt).sum()),
+                        )
+                        if self.engine_cfg.flow_ledger_active:
+                            fct = int(
+                                np.asarray(self.state.stats.fl_done).sum()
+                            )
                     print(
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
                             fault=fault, gear=last_gear, cap=cap, hbm=hbm,
+                            ek=ek, fct=fct,
                         ),
                         file=log,
                     )
@@ -1037,6 +1115,28 @@ class Simulation:
             if getattr(self, "_pressure_aborted", False):
                 report["pressure_aborted"] = True
                 report["aborted"] = True
+        if self.engine_cfg.netobs:
+            # network observatory block (obs/netobs.py): event classes,
+            # safe-window critical path, flow ledger, per-link fold —
+            # assembled by the ONE shared helper (bench rows and the
+            # hybrid driver use the same one, so the block's shape
+            # cannot drift between exporters). The gated stats lanes
+            # (ec_* / fl_* / win_bound) are read inside it and listed in
+            # lanes.STATS_EXPORT_EXEMPT with that export path recorded.
+            from shadow_tpu.obs.netobs import (
+                assemble_network_report, node_map,
+            )
+
+            report["network"] = assemble_network_report(
+                stats=s,
+                num_real=n,
+                rounds=int(s.rounds),
+                node_of=node_map(self.hosts, n),
+                model=self.model,
+                model_state=self._model_host_view(),
+                flow_ledger=self.engine_cfg.flow_ledger_active,
+                collector=getattr(self, "_flowcol", None),
+            )
         memmon = getattr(self, "_memmon", None)
         if memmon is not None:
             # HBM observatory block (obs/memory.py): static byte model +
@@ -1070,6 +1170,23 @@ class Simulation:
             }
         return report
 
+    def _model_host_view(self):
+        """The model state as a host-side tree sliced to the real hosts,
+        fetched ONCE per device state (memoized on the state's model
+        pytree identity): stats_report's network block and
+        write_outputs' host-stats extras both read it, and the transfer
+        is the whole model state — at the million-host scale, paying it
+        twice per report is real traffic."""
+        st = self.state.model
+        cached = getattr(self, "_model_view_cache", None)
+        if cached is not None and cached[0] is st:
+            return cached[1]
+        view = jax.tree.map(
+            lambda a: np.asarray(a)[: self._num_real], jax.device_get(st)
+        )
+        self._model_view_cache = (st, view)
+        return view
+
     def host_digests(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.state.stats.digest))[: self._num_real]
 
@@ -1099,6 +1216,21 @@ class Simulation:
             deliv_c, lost_c = s.pkts_delivered, s.pkts_lost
             digests = self.host_digests()
             occ_c = s.q_occ_hwm
+        # network observatory: per-host network counters ride into
+        # host-stats.json on gated runs (engine drop lanes by cause +
+        # the model's per-host hook — bytes/retransmits on tgen)
+        net_ph: dict[str, Any] = {}
+        if getattr(self.engine_cfg, "netobs", False) and gold is None:
+            net_ph = {
+                "packets_codel_dropped": s.pkts_codel_dropped,
+                "packets_budget_dropped": s.pkts_budget_dropped,
+                "packets_unreachable": s.pkts_unreachable,
+            }
+            if hasattr(self.model, "per_host_network"):
+                for k, v in self.model.per_host_network(
+                    self._model_host_view()
+                ).items():
+                    net_ph[k] = v
         for h in self.hosts:
             hd = os.path.join(data_dir, "hosts", h.name)
             os.makedirs(hd, exist_ok=True)
@@ -1116,6 +1248,11 @@ class Simulation:
                             if occ_c is not None
                             else {}
                         ),
+                        **{
+                            k: int(np.asarray(v)[h.host_id])
+                            for k, v in net_ph.items()
+                            if h.host_id < len(np.asarray(v))
+                        },
                         "determinism_digest": f"{int(digests[h.host_id]):016x}",
                     },
                     f,

@@ -31,6 +31,8 @@ def build_sim(
     queue_block: int = 0,
     microstep_events: int = 1,
     trace_rounds: int = 0,
+    netobs: bool = False,
+    flow_records: int = 0,
     merge_rows: int = 0,
     faults: dict | None = None,
     bootstrap_end: int = 0,
@@ -79,6 +81,8 @@ def build_sim(
         exchange=exchange,
         microstep_events=microstep_events,
         trace_rounds=trace_rounds,
+        netobs=netobs,
+        flow_records=flow_records,
         merge_rows=merge_rows,
         **fault_kw,
     )

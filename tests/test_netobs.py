@@ -1,0 +1,652 @@
+"""Network observatory (`observability.network`, shadow_tpu/obs/netobs.py).
+
+Gates, mirroring the ISSUE acceptance:
+  - observer exactness: digests, per-host event counts, and every drop
+    counter are bit-identical with the observatory (and flow ledger) on
+    vs off, across echo/phold/tgen x flat/bucketed x K{1,4}; the
+    world=8 legs run subprocess-isolated (tests/subproc.py, this box's
+    documented jaxlib-0.4.37 corruption posture) with one layout/K
+    point per model covering both axes;
+  - event-class totals reconcile exactly: ec_timer + ec_pkt + ec_app ==
+    stats.events, and the per-round trace columns sum to the same;
+  - the flow ledger reconciles exactly: drained record totals ==
+    fl_done/fl_bytes/fl_rtx stats lanes == the model's own flows_done,
+    wrap losses are counted (never silent), and a collector synced to a
+    mid-run cursor never replays pre-sync records (the checkpoint-resume
+    contract);
+  - safe-window telemetry: win_bound counts cover every round;
+  - heartbeat ek=/fct= round-trip through parse_shadow --strict;
+  - a compiled-Simulation smoke (subprocess-isolated) exports the
+    network{} block, the flow track, and artifacts tools/net_report.py
+    and tools/trace_summary.py consume.
+
+Engine-harness legs run in-process (the stable path on this box);
+compiled-Simulation legs go through tests/subproc.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import Engine
+from shadow_tpu.obs.netobs import (
+    FCOL_BYTES,
+    FCOL_DST,
+    FCOL_RETRANSMITS,
+    FCOL_SRC,
+    FCOL_T_END,
+    FCOL_T_START,
+    FLOW_COLS,
+    FlowCollector,
+    bench_network_block,
+    event_class_report,
+    fct_stats,
+    link_hwm,
+    network_report,
+)
+from shadow_tpu.obs.tracer import (
+    COL_BIND_SHARD,
+    COL_EC_APP,
+    COL_EC_PKT,
+    COL_EC_TIMER,
+    COL_FLOWS,
+    RoundTracer,
+)
+from tests.engine_harness import build_sim, mk_hosts
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+RING = 64
+
+
+def _run(model, hosts, stop, *, k=1, qb=0, netobs=False, flow_records=0,
+         trace=False, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, queue_block=qb, microstep_events=k,
+        netobs=netobs, flow_records=flow_records,
+        trace_rounds=(RING if trace else 0), **kw
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return state
+
+
+# short-horizon variants of the tracer's workload trio: enough rounds to
+# exercise timers, retransmits, and flow completions, small enough for
+# the 24-build matrix
+_CASES = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 5)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(5, {"flow_segs": 8, "flows": 2, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             2_000_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+
+def _flow_records_for(model):
+    return 64 if model == "tgen_tcp" else 0
+
+
+def _matrix_params():
+    """The world-1 acceptance matrix. Tier-1 wall budget on this box is
+    the binding constraint (the 870 s gate already cuts the suite), so
+    the mixed-axis combos — (flat, k4) and (bucketed, k1), which add no
+    code path the aligned pairs miss (netobs touches layout/K only
+    through the shared microstep body) — carry the `slow` mark: the
+    FULL cross product runs under `pytest -m ''`, tier-1 runs the
+    aligned half plus the world-8 legs."""
+    out = []
+    for case in sorted(_CASES):
+        for k in (1, 4):
+            for qb in (0, 8):
+                aligned = (k == 1) == (qb == 0)
+                marks = () if aligned else (pytest.mark.slow,)
+                out.append(pytest.param(
+                    case, k, qb,
+                    id=f"{case}-{'flat' if qb == 0 else 'bucketed'}-k{k}",
+                    marks=marks,
+                ))
+    return out
+
+
+@pytest.mark.parametrize("case,k,qb", _matrix_params())
+def test_netobs_is_bit_identical_and_reconciles(case, k, qb):
+    """The ISSUE acceptance gate, world=1: observatory on vs off across
+    the model x layout x K matrix, plus class/flow/safe-window
+    reconciliation on the gated run."""
+    model, hosts, stop, kw = _CASES[case]
+    fr = _flow_records_for(model)
+    s_off = _run(model, hosts, stop, k=k, qb=qb, **kw)
+    s_on = _run(model, hosts, stop, k=k, qb=qb, netobs=True,
+                flow_records=fr, **kw)
+    off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+
+    np.testing.assert_array_equal(np.asarray(off.digest), np.asarray(on.digest))
+    np.testing.assert_array_equal(np.asarray(off.events), np.asarray(on.events))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(s_off.queue.dropped)),
+        np.asarray(jax.device_get(s_on.queue.dropped)),
+    )
+    for field in ("pkts_sent", "pkts_lost", "pkts_codel_dropped",
+                  "pkts_budget_dropped", "pkts_delivered", "q_occ_hwm"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(off, field)), np.asarray(getattr(on, field)),
+            err_msg=field,
+        )
+
+    # the ungated program carries NO observatory lanes; the gated one
+    # reconciles class totals with the event counter exactly
+    assert off.ec_timer is None and off.win_bound is None
+    ec = (int(np.asarray(on.ec_timer).sum())
+          + int(np.asarray(on.ec_pkt).sum())
+          + int(np.asarray(on.ec_app).sum()))
+    assert ec == int(np.asarray(on.events).sum())
+    assert int(np.asarray(on.ec_pkt).sum()) > 0  # every case sends packets
+
+    # safe window: the single shard binds every scheduling round
+    assert int(np.asarray(on.win_bound).sum()) == int(on.rounds)
+
+    if fr:
+        col = FlowCollector(fr)
+        col.drain(s_on.flows)
+        r = col.records()
+        assert r.shape == (int(np.asarray(on.fl_done).sum()), FLOW_COLS)
+        assert int(r[:, FCOL_BYTES].sum()) == int(np.asarray(on.fl_bytes).sum())
+        assert int(r[:, FCOL_RETRANSMITS].sum()) == int(
+            np.asarray(on.fl_rtx).sum()
+        )
+        assert (r[:, FCOL_T_END] > r[:, FCOL_T_START]).all()
+        assert (r[:, FCOL_SRC] != r[:, FCOL_DST]).all()
+        # ledger completions == the model's own flow counter (an
+        # independent path: model state vs engine stats vs ring)
+        mdl = jax.device_get(s_on.model)
+        assert int(np.asarray(mdl["flows_done"]).sum()) == int(
+            np.asarray(on.fl_done).sum()
+        )
+    else:
+        assert on.fl_done is None and s_on.flows is None
+
+
+# world=8 legs: one (layout, K) point per model — between the three legs
+# both queue layouts and both K values are covered at world 8; the full
+# cross product stays at world 1 above (each 8-device leg costs a heavy
+# shard_map compile, and compiled multi-device runs are exactly where
+# this box's documented corruption bites, hence tests/subproc.py).
+_W8_SCRIPT = """
+import json, sys
+import numpy as np
+import jax
+from shadow_tpu.core import Engine
+from tests.engine_harness import build_sim, mk_hosts
+
+model, qb, k, fr = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+cases = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "udp_echo": ("udp_echo",
+        [dict(host_id=0, name="server", start_time=0,
+              model_args={"role": "server"})]
+        + [dict(host_id=i, name=f"c{i}", start_time=0,
+                model_args={"role": "client", "peer": "server",
+                            "interval": "4 ms", "size_bytes": 2000})
+           for i in range(1, 8)],
+        200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen_tcp": ("tgen_tcp",
+        mk_hosts(8, {"flow_segs": 8, "flows": 1, "cwnd_cap": 8,
+                     "rto_min": "100 ms"}),
+        1_500_000_000, dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+name, hosts, stop, kw = cases[model]
+
+def run(netobs):
+    cfg, m, params, mstate, events = build_sim(
+        name, hosts, stop, world=8, queue_block=qb, microstep_events=k,
+        netobs=netobs, flow_records=(fr if netobs else 0), **kw)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    eng = Engine(cfg, m, mesh)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return state
+
+s_off = run(False)
+s_on = run(True)
+off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+out = {
+    "digest_equal": bool(
+        (np.asarray(off.digest) == np.asarray(on.digest)).all()),
+    "events_equal": bool(
+        (np.asarray(off.events) == np.asarray(on.events)).all()),
+    "dropped_equal": bool((
+        np.asarray(jax.device_get(s_off.queue.dropped))
+        == np.asarray(jax.device_get(s_on.queue.dropped))).all()),
+    "events": int(np.asarray(on.events).sum()),
+    "ec_total": int(np.asarray(on.ec_timer).sum())
+    + int(np.asarray(on.ec_pkt).sum()) + int(np.asarray(on.ec_app).sum()),
+    "rounds": int(on.rounds),
+    "win_bound": [int(x) for x in np.asarray(on.win_bound)],
+    "fl_done": (int(np.asarray(on.fl_done).sum())
+                if on.fl_done is not None else None),
+}
+print(json.dumps(out))
+"""
+
+
+@pytest.mark.parametrize(
+    "model,qb,k,fr",
+    [("udp_echo", 0, 1, 0), ("phold", 8, 1, 0), ("tgen_tcp", 0, 4, 64)],
+    ids=["echo-flat-k1", "phold-bucketed-k1", "tgen-flat-k4"],
+)
+def test_netobs_world8_bit_identical(model, qb, k, fr):
+    """World-8 observer exactness + reconciliation: the per-shard
+    win_bound counts must cover every round exactly once (the binder is
+    mesh-uniform with deterministic ties)."""
+    from tests.subproc import run_isolated_json
+
+    out = run_isolated_json(_W8_SCRIPT, model, qb, k, fr)
+    assert out["digest_equal"], "digests changed with the observatory on"
+    assert out["events_equal"] and out["dropped_equal"]
+    assert out["ec_total"] == out["events"]
+    assert sum(out["win_bound"]) == out["rounds"]
+    if fr:
+        assert out["fl_done"] is not None and out["fl_done"] >= 0
+
+
+def test_flow_ledger_wrap_counts_lost_records():
+    """A ring smaller than the completions between drains loses the
+    OLDEST records and counts them — and the fl_* stats lanes keep the
+    exact totals regardless (the independent-path design)."""
+    model, hosts, stop, kw = _CASES["tgen"]
+    state = _run(model, hosts, stop, netobs=True, flow_records=4, **kw)
+    s = jax.device_get(state.stats)
+    done = int(np.asarray(s.fl_done).sum())
+    assert done > 4  # 5 hosts x 2 flows: the 4-slot ring must wrap
+    col = FlowCollector(4)
+    n = col.drain(state.flows)
+    assert n == 4
+    assert col.lost == done - 4
+    assert col.count == 4
+    r = col.records()
+    assert r.shape[0] == 4
+    # the survivors are the NEWEST records: completion times beyond the
+    # drained set never exceed theirs... (monotone cursor: rows at
+    # cursor-4..cursor-1 are the last four appended on this shard)
+    assert (r[:, FCOL_T_END] > 0).all()
+
+
+def test_flow_collector_sync_cursor_never_replays():
+    """The checkpoint-resume shape: a FRESH collector handed a ledger
+    whose cursor is already advanced must adopt it — not replay
+    pre-existing records as new completions or count them as losses."""
+    model, hosts, stop, kw = _CASES["tgen"]
+    state = _run(model, hosts, stop, netobs=True, flow_records=64, **kw)
+    assert int(jax.device_get(state.flows.cursor).max()) > 0
+    b = FlowCollector(64)
+    b.sync_cursor(state.flows)
+    assert b.drain(state.flows) == 0
+    assert b.count == 0 and b.lost == 0
+    assert b.records().shape[0] == 0
+
+
+def test_flow_collector_truncate_to_cursor():
+    """The graceful-abort shape: drained records beyond an exported
+    state's own ledger cursor are dropped, newest first."""
+    model, hosts, stop, kw = _CASES["tgen"]
+    state = _run(model, hosts, stop, netobs=True, flow_records=64, **kw)
+    col = FlowCollector(64)
+    n = col.drain(state.flows)
+    assert n >= 4
+    keep = n - 3
+    dropped = col.truncate_to_cursor(np.asarray([keep], np.int64))
+    assert dropped == 3
+    assert col.records().shape[0] == keep
+    # idempotent at the same cursor
+    assert col.truncate_to_cursor(np.asarray([keep], np.int64)) == 0
+
+
+def test_flow_collector_truncate_across_wrap_losses():
+    """Truncation must account wrap-lost records by their GLOBAL index —
+    a rewind to cursor 0 cannot leave phantom losses or a negative
+    count (the review-found over-drop)."""
+    model, hosts, stop, kw = _CASES["tgen"]
+    state = _run(model, hosts, stop, netobs=True, flow_records=4, **kw)
+    done = int(np.asarray(jax.device_get(state.stats.fl_done)).sum())
+    assert done > 4
+    col = FlowCollector(4)
+    col.drain(state.flows)
+    assert col.lost == done - 4 and col.count == 4
+    # full rewind: every record AND every loss is un-seen
+    assert col.truncate_to_cursor(np.asarray([0], np.int64)) == done
+    assert col.count == 0 and col.lost == 0
+    assert col.records().shape[0] == 0
+    # partial rewind INTO the lost range: losses recount to the prefix
+    col2 = FlowCollector(4)
+    col2.drain(state.flows)
+    keep = done - 2  # drops 2 held records, keeps 2 held + all losses
+    col2.truncate_to_cursor(np.asarray([keep], np.int64))
+    assert col2.lost == done - 4
+    assert col2.count == 2
+    assert col2.records().shape[0] == 2
+
+
+def test_trace_ring_carries_event_class_columns():
+    """The per-round class/flow columns reconcile with the cumulative
+    stats lanes, and bind_shard is 0 on a single shard."""
+    model, hosts, stop, kw = _CASES["tgen"]
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, netobs=True, flow_records=64,
+        trace_rounds=RING, **kw
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    tracer = RoundTracer(RING)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        jax.block_until_ready(state)
+        tracer.drain(state.trace)
+        chunks += 1
+        assert chunks < 500
+    s = jax.device_get(state.stats)
+    rows = tracer.rows()[0]
+    assert rows[:, COL_EC_TIMER].sum() == int(np.asarray(s.ec_timer).sum())
+    assert rows[:, COL_EC_PKT].sum() == int(np.asarray(s.ec_pkt).sum())
+    assert rows[:, COL_EC_APP].sum() == int(np.asarray(s.ec_app).sum())
+    assert rows[:, COL_FLOWS].sum() == int(np.asarray(s.fl_done).sum())
+    assert (rows[:, COL_BIND_SHARD] == 0).all()
+    t = tracer.totals()
+    assert t["ec_timer"] + t["ec_pkt"] + t["ec_app"] == t["events"]
+    assert t["flows"] == int(np.asarray(s.fl_done).sum())
+
+
+def test_flow_collector_validation():
+    with pytest.raises(ValueError, match="ring_records"):
+        FlowCollector(0)
+    col = FlowCollector(8)
+    assert col.count == 0 and col.lost == 0
+    assert col.records().shape == (0, FLOW_COLS)
+    assert col.summary()["records_drained"] == 0
+    assert col.summary()["fct"]["p50_ms"] is None
+
+
+def test_netobs_report_helpers():
+    ec = event_class_report(30, 60, 10)
+    assert ec["total"] == 100 and ec["timer_share"] == 0.3
+    assert event_class_report(0, 0, 0)["timer_share"] is None
+    f = fct_stats(np.asarray([10_000_000, 20_000_000, 30_000_000]))
+    assert f["n"] == 3 and f["p50_ms"] == 20.0 and f["max_ms"] == 30.0
+    assert link_hwm({}) == {"packets_sent": 0, "bytes": 0}
+    assert link_hwm(
+        {"0": {"packets_sent": 5, "bytes": 100},
+         "1": {"packets_sent": 9, "bytes": 50}}
+    ) == {"packets_sent": 9, "bytes": 100}
+    net = network_report(
+        ec_timer=1, ec_pkt=2, ec_app=3, win_bound=np.asarray([4]),
+        rounds=4, fl=(2, 200, 1),
+        links={"0": {"hosts": 2, "packets_sent": 7, "bytes": 9}},
+    )
+    assert net["event_classes"]["total"] == 6
+    assert net["safe_window"]["critical_shard"] == 0
+    assert net["flows"]["completed"] == 2
+    assert net["link_hwm"]["packets_sent"] == 7
+    b = bench_network_block(net)
+    assert b["flows_completed"] == 2 and "event_classes" in b
+
+
+def test_engine_config_validates_flow_records():
+    from shadow_tpu.core.engine import EngineConfig
+
+    with pytest.raises(ValueError, match="netobs"):
+        EngineConfig(num_hosts=4, stop_time=1, flow_records=8)
+    with pytest.raises(ValueError, match="flow_records"):
+        EngineConfig(num_hosts=4, stop_time=1, netobs=True, flow_records=-1)
+    cfg = EngineConfig(num_hosts=4, stop_time=1, netobs=True, flow_records=8)
+    assert cfg.flow_ledger_active
+    assert not EngineConfig(
+        num_hosts=4, stop_time=1, netobs=True
+    ).flow_ledger_active
+
+
+def test_observability_network_options_parse():
+    from shadow_tpu.config.options import ConfigError, ObservabilityOptions
+
+    o = ObservabilityOptions.from_dict(None)
+    assert not o.network and o.network_flows == 4096
+    o = ObservabilityOptions.from_dict(
+        {"network": True, "network_flows": 128}
+    )
+    assert o.network and o.network_flows == 128
+    # 0 = ledger off, observatory still on (the engine's documented
+    # flow_records=0 contract reaches the config surface)
+    o = ObservabilityOptions.from_dict(
+        {"network": True, "network_flows": 0}
+    )
+    assert o.network and o.network_flows == 0
+    with pytest.raises(ConfigError, match="network_flows"):
+        ObservabilityOptions.from_dict({"network_flows": -1})
+
+
+def test_example_netobs_yaml_parses():
+    from shadow_tpu.config.options import load_config
+
+    cfg = load_config(os.path.join(_REPO, "examples", "netobs.yaml"))
+    assert cfg.observability.network
+    assert cfg.observability.network_flows == 1024
+    assert cfg.observability.trace
+
+
+def test_heartbeat_ek_fct_regex_and_strict_roundtrip(tmp_path):
+    """The ek=/fct= fields parse, older generations keep parsing, and a
+    line emitted by heartbeat_line round-trips through --strict."""
+    sys.path.insert(0, _REPO)
+    from tools.parse_shadow import HEARTBEAT_RE, parse_heartbeats
+    from shadow_tpu.sim import heartbeat_line
+
+    line = heartbeat_line(
+        2_000_000_000, 3.0, 99, 80, 40, 4096, 7,
+        ek=(31, 52), fct=12,
+    )
+    m = HEARTBEAT_RE.search(line)
+    assert m and m.group("ek_timer") == "31" and m.group("ek_pkt") == "52"
+    assert m.group("fct_done") == "12"
+    # older generation without the fields still parses
+    old = ("[heartbeat] sim_time=1.000s wall=2.50s events=100 rounds=10 "
+           "msteps/round=3.0 ev/mstep=3.33 ici_bytes=4096 q_hwm=7 "
+           "ratio=0.40x")
+    m = HEARTBEAT_RE.search(old)
+    assert m and m.group("ek_timer") is None and m.group("fct_done") is None
+    # hybrid windows form with ek
+    hyb = ("[heartbeat] sim_time=2.000s wall=3.00s windows=12 gear=2 "
+           "ek=31/52 ratio=0.67x")
+    m = HEARTBEAT_RE.search(hyb)
+    assert m and m.group("ek_timer") == "31" and m.group("windows") == "12"
+    # strict round-trip (the R5 runtime half)
+    log = tmp_path / "run.log"
+    log.write_text(line + "\n" + old + "\n" + hyb + "\n")
+    hbs = parse_heartbeats(str(log), strict=True)
+    assert len(hbs) == 3
+    assert hbs[0]["ek_timer"] == 31 and hbs[0]["fct_done"] == 12
+
+
+def test_bench_compare_network_block(tmp_path):
+    """FCT/retransmit/link-hwm growth fail the diff; share drift warns."""
+    sys.path.insert(0, _REPO)
+    from tools.bench_compare import compare, _rows
+
+    def row(p50, p99, rtx, hwm, share):
+        return {"metric": "m", "value": 10.0, "network": {
+            "event_classes": {"timer": 10, "packet": 80, "app": 10,
+                              "timer_share": share},
+            "fct": {"p50_ms": p50, "p99_ms": p99},
+            "retransmits": rtx,
+            "link_hwm": {"packets_sent": hwm, "bytes": hwm * 100},
+        }}
+
+    old = _rows([row(10.0, 40.0, 5, 1000, 0.10)])
+    # regression: p99 +50%, retransmits x3, link hwm +50%
+    new = _rows([row(10.0, 60.0, 15, 1500, 0.30)])
+    findings = compare(old, new, 0.10, 0.10)
+    kinds = {(f["kind"], f["severity"]) for f in findings}
+    assert ("network", "regression") in kinds
+    details = " | ".join(f["detail"] for f in findings)
+    assert "fct p99" in details and "retransmits" in details
+    assert "link hot-spot" in details
+    assert any(f["severity"] == "warning" and "share" in f["detail"]
+               for f in findings)
+    # identical blocks: no network findings at all
+    same = compare(old, _rows([row(10.0, 40.0, 5, 1000, 0.10)]), 0.1, 0.1)
+    assert not [f for f in same if f["kind"] == "network"]
+    # losing the block entirely is a coverage warning
+    lost = _rows([{"metric": "m", "value": 10.0}])
+    findings = compare(old, lost, 0.1, 0.1)
+    assert any(f["kind"] == "network" and f["severity"] == "warning"
+               for f in findings)
+
+
+# the compiled-Simulation smoke runs in a SUBPROCESS via tests/subproc.py
+# (the shared isolation for this box's documented jaxlib-0.4.37 heap
+# corruption in compiled Simulation runs). The engine-harness matrix
+# above is the primary gate; this leg gates the DRIVER wiring: config ->
+# engine statics, chunk-boundary drains, sim-stats network{} block,
+# host-stats extras, and the exported artifacts.
+_SMOKE_SCRIPT = """
+import json, sys
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+def cfg(tmp, network):
+    return ConfigOptions.from_dict({
+        "general": {"stop_time": "3 s", "seed": 7, "data_directory": tmp,
+                    "heartbeat_interval": None},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_queue_capacity": 32,
+                         "sends_per_host_round": 16,
+                         "rounds_per_chunk": 16},
+        "observability": {"trace": network, "network": network,
+                          "network_flows": 64},
+        "hosts": {
+            "node": {"count": 5, "network_node_id": 0,
+                     "processes": [{
+                         "model": "tgen_tcp",
+                         "model_args": {"flows": 2, "flow_segs": 8,
+                                        "cwnd_cap": 8,
+                                        "rto_min": "100 ms"}}]},
+        },
+    })
+
+off_dir, on_dir = sys.argv[1], sys.argv[2]
+sim_off = Simulation(cfg(off_dir, False), world=1)
+rep_off = sim_off.run()
+sim_on = Simulation(cfg(on_dir, True), world=1)
+rep_on = sim_on.run()
+# scribble gate (tools/net_report.py run_check documents it): this box's
+# silent-corruption flavor scrawls pointer garbage over small model
+# lanes in in-process compiled-Simulation sequences (reproduced on
+# unmodified HEAD). A per-host flow counter outside [0, flows=2] is
+# physically impossible — classify instead of false-failing the
+# reconciliation asserts.
+import jax, numpy as np
+for sim in (sim_off, sim_on):
+    fd = np.asarray(jax.device_get(sim.state.model["flows_done"]))
+    if (fd < 0).any() or (fd > 2).any():
+        print(json.dumps({"poisoned": fd.tolist()}))
+        raise SystemExit(0)
+sim_on.write_outputs(report=rep_on)
+print(json.dumps({"off": rep_off, "on": rep_on}))
+"""
+
+
+def test_simulation_netobs_smoke(tmp_path):
+    """Tier-1 driver smoke (the ISSUE's CI satellite): a tiny tgen sim
+    with the observatory on matches the off-run's digests, exports a
+    reconciling network{} block, and produces artifacts net_report.py
+    and trace_summary.py consume."""
+    from tests.subproc import run_isolated_json
+
+    for attempt in range(3):
+        reps = run_isolated_json(
+            _SMOKE_SCRIPT, str(tmp_path / "off"), str(tmp_path / "on")
+        )
+        if "poisoned" not in reps:
+            break
+    else:
+        pytest.skip(
+            "known jaxlib-0.4.37 silent-scribble corruption poisoned the "
+            f"model lanes in 3/3 attempts (reproduced on unmodified HEAD; "
+            f"CHANGES.md env notes): {reps['poisoned']}"
+        )
+    rep_off, rep_on = reps["off"], reps["on"]
+
+    assert rep_on["determinism_digest"] == rep_off["determinism_digest"]
+    assert rep_on["events_processed"] == rep_off["events_processed"]
+    assert "network" not in rep_off
+    net = rep_on["network"]
+    assert net["event_classes"]["total"] == rep_on["events_processed"]
+    assert net["event_classes"]["timer"] >= 0
+    assert net["event_classes"]["packet"] > 0
+    flows = net["flows"]
+    assert flows["completed"] == rep_on["model_report"]["flows_completed"]
+    assert flows["records_drained"] + flows["records_lost"] \
+        == flows["completed"]
+    assert flows["fct"]["p50_ms"] is not None
+    assert sum(net["safe_window"]["bound_rounds_per_shard"]) \
+        == rep_on["rounds"]
+    assert "0" in net["links"]
+    assert net["links"]["0"]["hosts"] == 5
+    assert net["links"]["0"]["packets_sent"] == rep_on["packets_sent"]
+    assert net["link_hwm"]["packets_sent"] > 0
+
+    # host-stats carries the per-host network extras on gated runs
+    hs = json.load(open(tmp_path / "on" / "hosts" / "node1" /
+                        "host-stats.json"))
+    assert "retransmits" in hs and "bytes" in hs
+    assert "packets_codel_dropped" in hs
+
+    # the trace carries the flow track and the class columns
+    trace = json.load(open(tmp_path / "on" / "trace.json"))
+    flow_ev = [e for e in trace["traceEvents"] if e.get("cat") == "flow"]
+    assert len(flow_ev) == flows["records_drained"]
+    assert all(e["dur"] > 0 for e in flow_ev)
+
+    # tools consume the artifacts
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_summary.py"),
+         str(tmp_path / "on" / "trace.json"), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout)
+    assert summary["event_classes"]["total"] == rep_on["events_processed"]
+    assert summary["event_classes"]["flows_completed"] == flows["completed"]
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "net_report.py"),
+         str(tmp_path / "on")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "timer-vs-packet share" in out.stdout
+    assert "## flows" in out.stdout and "## links" in out.stdout

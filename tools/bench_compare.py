@@ -19,6 +19,12 @@ Verdicts (rc 1 if any REGRESSION, else 0):
     (metrics are throughput-style — higher is better)
   - hbm: per-shard peak bytes (or model-predicted bytes where no peak
     was recorded) grew more than --hbm-threshold relative
+  - network (PR 10 observatory block): FCT p50/p99 or retransmits grew
+    more than --threshold relative, or the link hot-spot max grew more
+    than --hbm-threshold — flow BEHAVIOR regressions, not just
+    wall-clock. An event-class share drift > 10 points is a warning
+    (the mix shifting is signal, not inherently bad); OLD carrying a
+    network block NEW lost is a coverage warning.
   - a metric present in OLD but missing from NEW is a regression
     (silently dropping a tracked workload is how coverage rots)
 """
@@ -39,7 +45,9 @@ def _rows(blob) -> dict[str, dict]:
             continue
         if "parsed" in item and isinstance(item["parsed"], dict):
             item = {**item["parsed"],
-                    **({"hbm": item["hbm"]} if "hbm" in item else {})}
+                    **({"hbm": item["hbm"]} if "hbm" in item else {}),
+                    **({"network": item["network"]}
+                       if "network" in item else {})}
         if "metric" in item:
             out[str(item["metric"])] = item
         elif "n_devices" in item:
@@ -60,6 +68,69 @@ def _hbm_peak(row: dict) -> int | None:
     if model.get("total_bytes"):
         return int(model["total_bytes"])
     return None
+
+
+def _compare_network(
+    add, name: str, o_net: dict, n_net: dict,
+    threshold: float, hbm_threshold: float,
+):
+    """Diff one metric's `network{}` blocks (obs/netobs.py
+    bench_network_block shape): flow-behavior regressions fail the
+    diff even when wall-clock held."""
+    # FCT distribution: lower is better — growth past threshold regresses
+    o_fct, n_fct = o_net.get("fct") or {}, n_net.get("fct") or {}
+    for q in ("p50_ms", "p99_ms"):
+        ov, nv = o_fct.get(q), n_fct.get(q)
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
+            if ov > 0:
+                rel = (nv - ov) / ov
+                if rel > threshold:
+                    add("network", name, "regression",
+                        f"fct {q} {ov} -> {nv} ms ({rel * 100:+.1f}%, "
+                        f"threshold +{threshold * 100:.0f}%)")
+                elif rel < -threshold:
+                    add("network", name, "improvement",
+                        f"fct {q} {ov} -> {nv} ms ({rel * 100:+.1f}%)")
+            elif nv > 0:
+                # a zero baseline makes relative thresholds meaningless;
+                # 0 -> N is still a flow-behavior change, never silent
+                add("network", name, "regression",
+                    f"fct {q} appeared: 0 -> {nv} ms (zero baseline)")
+        elif ov is not None and nv is None:
+            add("network", name, "regression",
+                f"OLD recorded fct {q}={ov}, NEW recorded none")
+    # retransmits: lower is better; 0 -> N is the canonical regression
+    # this block exists to catch (a healthy baseline HAS zero rtx)
+    orx, nrx = o_net.get("retransmits"), n_net.get("retransmits")
+    if isinstance(orx, (int, float)) and isinstance(nrx, (int, float)):
+        if orx > 0:
+            rel = (nrx - orx) / orx
+            if rel > threshold:
+                add("network", name, "regression",
+                    f"retransmits {orx} -> {nrx} ({rel * 100:+.1f}%, "
+                    f"threshold +{threshold * 100:.0f}%)")
+        elif nrx > 0:
+            add("network", name, "regression",
+                f"retransmits appeared: 0 -> {nrx} (zero baseline)")
+    # link hot-spot: growth past the hbm-style threshold regresses
+    o_hwm = (o_net.get("link_hwm") or {}).get("packets_sent")
+    n_hwm = (n_net.get("link_hwm") or {}).get("packets_sent")
+    if isinstance(o_hwm, (int, float)) and isinstance(n_hwm, (int, float)) \
+            and o_hwm > 0:
+        rel = (n_hwm - o_hwm) / o_hwm
+        if rel > hbm_threshold:
+            add("network", name, "regression",
+                f"link hot-spot packets {o_hwm} -> {n_hwm} "
+                f"({rel * 100:+.1f}%, threshold "
+                f"+{hbm_threshold * 100:.0f}%)")
+    # event-class mix drift: signal worth a look, not inherently bad
+    o_sh = (o_net.get("event_classes") or {}).get("timer_share")
+    n_sh = (n_net.get("event_classes") or {}).get("timer_share")
+    if isinstance(o_sh, (int, float)) and isinstance(n_sh, (int, float)):
+        if abs(n_sh - o_sh) > 0.10:
+            add("network", name, "warning",
+                f"timer-event share {o_sh:.2f} -> {n_sh:.2f} "
+                f"(mix shifted by {abs(n_sh - o_sh) * 100:.0f} points)")
 
 
 def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
@@ -110,6 +181,14 @@ def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
             # (not a hard regression — older rows predate the block)
             add("hbm", name, "warning",
                 "OLD carried an hbm block, NEW has none")
+        o_net, n_net = o.get("network"), n.get("network")
+        if isinstance(o_net, dict) and isinstance(n_net, dict):
+            _compare_network(
+                add, name, o_net, n_net, threshold, hbm_threshold
+            )
+        elif isinstance(o_net, dict) and n_net is None:
+            add("network", name, "warning",
+                "OLD carried a network block, NEW has none")
     for name in sorted(set(new) - set(old)):
         add("coverage", name, "info", "new metric (no baseline)")
     return findings

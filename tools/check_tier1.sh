@@ -23,6 +23,13 @@
 # in a worker subprocess and self-classifies the known jaxlib corruption
 # signature as SKIP (soak.py posture).
 #
+# Optional stage: TIER1_NET=1 runs the network-observatory
+# reconciliation check (tools/net_report.py --check: digests identical
+# with the observatory on/off, event-class totals == the event counter,
+# and the flow ledger reconciling exactly against the fl_* stats lanes
+# and the model's own flow counts). Subprocess-isolated with the same
+# corruption-signature SKIP posture as the hbm stage.
+#
 # Optional third stage: TIER1_CAMPAIGN=1 runs the ensemble-plane smoke
 # (tools/campaign.py --smoke: an A/A control campaign that must hold +
 # a forced-divergence A/B campaign whose bisection must agree with the
@@ -76,6 +83,14 @@ if [ -n "${TIER1_HBM:-}" ]; then
   hbm_rc=$?
   echo "HBM_RC=$hbm_rc"
   [ "$rc" -eq 0 ] && rc=$hbm_rc
+fi
+if [ -n "${TIER1_NET:-}" ]; then
+  echo "== network-observatory reconciliation check (TIER1_NET) =="
+  timeout -k 10 "${TIER1_NET_TIMEOUT:-330}" \
+    env JAX_PLATFORMS=cpu python tools/net_report.py --check
+  net_rc=$?
+  echo "NET_RC=$net_rc"
+  [ "$rc" -eq 0 ] && rc=$net_rc
 fi
 if [ -n "${TIER1_CAMPAIGN:-}" ]; then
   echo "== campaign smoke (TIER1_CAMPAIGN) =="

@@ -65,6 +65,17 @@ AUDIT_CONFIGS = {
         stop=200_000_000,
         kw=dict(qcap=16),
     ),
+    # network observatory ON (ISSUE 10): event-class lanes, flow ledger,
+    # and safe-window telemetry traced in — pins the gated program's
+    # compile surface while `echo`/`phold` above pin that the DEFAULT
+    # (observatory-off) programs stay byte-unchanged.
+    "tgen_netobs": dict(
+        model="tgen_tcp",
+        hosts="tgen",  # mk_hosts(4, tgen args) below
+        stop=400_000_000,
+        kw=dict(qcap=16, trace_rounds=8, netobs=True, flow_records=16,
+                sends_budget=16),
+    ),
 }
 
 
@@ -144,9 +155,13 @@ def _build(name, spec):
     from tests.engine_harness import build_sim, mk_hosts
     from shadow_tpu.core.engine import Engine
 
-    hosts = spec["hosts"] or mk_hosts(
-        4, {"mean_delay": "50 ms", "population": 2}
-    )
+    hosts = spec["hosts"]
+    if hosts == "tgen":
+        hosts = mk_hosts(
+            4, {"flow_segs": 4, "flows": 1, "cwnd_cap": 4}
+        )
+    elif hosts is None:
+        hosts = mk_hosts(4, {"mean_delay": "50 ms", "population": 2})
     cfg, model, params, mstate, events = build_sim(
         spec["model"], hosts, spec["stop"], **spec["kw"]
     )
@@ -158,7 +173,7 @@ def _build(name, spec):
 def run_audit(
     root: str | None = None,
     update: bool = False,
-    configs: tuple[str, ...] = ("echo", "phold"),
+    configs: tuple[str, ...] = ("echo", "phold", "tgen_netobs"),
     fingerprint_file: str = FINGERPRINT_FILE,
 ):
     """Returns (findings, report dict per config)."""

@@ -1,0 +1,349 @@
+#!/usr/bin/env python3
+"""Network-observatory analyzer: event-class shares, FCT distribution,
+per-link hot spots, and safe-window critical path from a sim's exported
+`network{}` block (shadow_tpu/obs/netobs.py).
+
+Answers the ROADMAP item-2 gating question directly: *what fraction of
+events are timers?* — the number the sort-free/timer-wheel rebuild is
+justified (or not) by. Reads the artifact, not the simulation, so the
+report mode runs anywhere.
+
+Usage:
+  python tools/net_report.py DATA_DIR_OR_SIM_STATS [--json]
+  python tools/net_report.py --check            # reconciliation gate (CI)
+
+--check builds a small tgen-TCP sim twice (observatory off / on) in a
+worker subprocess and asserts the full observer contract:
+  - digests and event counts bit-identical off vs on;
+  - event-class totals == the event counter (timer+packet+app == events);
+  - the flow ledger reconciles EXACTLY: drained record totals ==
+    fl_done/fl_bytes/fl_rtx stats lanes == the model's flows_done;
+  - safe-window bound counts sum to the round count.
+Exit codes: 0 ok (or environment-classified SKIP on this box's
+documented jaxlib corruption signature — hbm_report/soak posture),
+2 violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# this box's documented jaxlib-0.4.37 corruption signatures (CHANGES.md
+# env notes; tests/subproc.py owns the canonical set — duplicated so a
+# plain report run never imports the test infra)
+HEAP_CORRUPTION_RCS = (134, 139, -6, -11)
+
+
+def load_network_block(path: str) -> tuple[dict, dict]:
+    """(sim_stats, network block) from a data dir or sim-stats.json."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "sim-stats.json")
+    with open(path) as f:
+        stats = json.load(f)
+    net = stats.get("network")
+    if net is None:
+        raise SystemExit(
+            f"net_report: {path} carries no network{{}} block — run with "
+            f"`observability.network: true`"
+        )
+    return stats, net
+
+
+def print_report(stats: dict, net: dict, file=sys.stdout):
+    ec = net.get("event_classes", {})
+    total = ec.get("total", 0)
+    print("# network observatory report", file=file)
+    print(
+        f"\n## event classes ({total} events)\n"
+        f"  timer   {ec.get('timer', 0):>12}  "
+        f"({(ec.get('timer_share') or 0) * 100:5.1f}%)\n"
+        f"  packet  {ec.get('packet', 0):>12}  "
+        f"({(ec.get('packet_share') or 0) * 100:5.1f}%)\n"
+        f"  app     {ec.get('app', 0):>12}",
+        file=file,
+    )
+    share = ec.get("timer_share")
+    if share is not None:
+        # the ROADMAP item-2 gate, stated as a sentence with a number
+        verdict = (
+            "timer events DOMINATE — the timer-wheel rebuild pays here"
+            if share > 0.5 else
+            "timer events do NOT dominate at this scale"
+        )
+        print(
+            f"  timer-vs-packet share: {share * 100:.1f}% timers vs "
+            f"{(ec.get('packet_share') or 0) * 100:.1f}% packets — "
+            f"{verdict}",
+            file=file,
+        )
+    flows = net.get("flows")
+    if flows:
+        fct = flows.get("fct") or {}
+        print(
+            f"\n## flows\n"
+            f"  completed    {flows.get('completed', 0)}\n"
+            f"  bytes        {flows.get('bytes', 0)}\n"
+            f"  retransmits  {flows.get('retransmits', 0)}\n"
+            f"  records      drained={flows.get('records_drained', 0)} "
+            f"lost={flows.get('records_lost', 0)}\n"
+            f"  fct          p50={fct.get('p50_ms')} ms  "
+            f"p99={fct.get('p99_ms')} ms  mean={fct.get('mean_ms')} ms  "
+            f"max={fct.get('max_ms')} ms",
+            file=file,
+        )
+    links = net.get("links")
+    if links:
+        print("\n## links (per graph node)", file=file)
+        hdr = (f"  {'node':<6} {'hosts':>6} {'sent':>10} {'deliv':>10} "
+               f"{'loss':>8} {'codel':>8} {'budget':>8}")
+        print(hdr, file=file)
+        hot = sorted(
+            links.items(),
+            key=lambda kv: -kv[1].get("packets_sent", 0),
+        )
+        for node, link in hot[:20]:
+            print(
+                f"  {node:<6} {link.get('hosts', 0):>6} "
+                f"{link.get('packets_sent', 0):>10} "
+                f"{link.get('packets_delivered', 0):>10} "
+                f"{link.get('drops_path_loss', 0):>8} "
+                f"{link.get('drops_codel', 0):>8} "
+                f"{link.get('drops_budget', 0):>8}",
+                file=file,
+            )
+        if len(hot) > 20:
+            print(f"  ... {len(hot) - 20} more nodes", file=file)
+        hwm = net.get("link_hwm", {})
+        print(f"  hot spot: packets={hwm.get('packets_sent', 0)} "
+              f"bytes={hwm.get('bytes', 0)}", file=file)
+    sw = net.get("safe_window")
+    if sw:
+        print(
+            f"\n## safe window ({sw.get('rounds', 0)} rounds)\n"
+            f"  bound per shard  {sw.get('bound_rounds_per_shard')}\n"
+            f"  critical shard   {sw.get('critical_shard')} "
+            f"({(sw.get('critical_share') or 0) * 100:.1f}% of rounds)",
+            file=file,
+        )
+
+
+def _check_config(tmp: str) -> dict:
+    """Small tgen-TCP sim for the reconciliation gate: lossy enough to
+    exercise retransmit timers, long enough for every flow to finish."""
+    return {
+        "general": {"stop_time": "4 s", "seed": 11, "data_directory": tmp,
+                    "heartbeat_interval": None},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_queue_capacity": 32,
+                         "sends_per_host_round": 16,
+                         "rounds_per_chunk": 32},
+        "observability": {"network": True, "network_flows": 64,
+                          "trace": True},
+        "hosts": {
+            "node": {"count": 6, "network_node_id": 0,
+                     "processes": [{
+                         "model": "tgen_tcp",
+                         "model_args": {"flows": 2, "flow_segs": 8,
+                                        "cwnd_cap": 8,
+                                        "rto_min": "100 ms"}}]},
+        },
+    }
+
+
+def run_check(tmp_dir: str) -> int:
+    """The reconciliation gate (see module docstring). rc 0 ok, 2 bad,
+    3 poisoned-environment (see the scribble gate below)."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.sim import Simulation
+
+    failures: list[str] = []
+
+    def ck(ok: bool, msg: str):
+        if not ok:
+            failures.append(msg)
+
+    cfg_on = _check_config(os.path.join(tmp_dir, "on"))
+    cfg_off = json.loads(json.dumps(cfg_on))
+    cfg_off["observability"] = {}
+    cfg_off["general"]["data_directory"] = os.path.join(tmp_dir, "off")
+
+    sim_off = Simulation(ConfigOptions.from_dict(cfg_off), world=1)
+    rep_off = sim_off.run()
+    sim_on = Simulation(ConfigOptions.from_dict(cfg_on), world=1)
+    rep_on = sim_on.run()
+
+    # scribble gate: this box's documented jaxlib-0.4.37 corruption has a
+    # SILENT flavor that scrawls pointer-sized garbage over small device
+    # buffers in in-process compiled-Simulation sequences (reproduced on
+    # unmodified HEAD: tgen model counter lanes reading ~9e13 while the
+    # digest stays intact; bench.py's solo-leg poison gate exists for the
+    # same mode). A per-host flow counter above the configured flows-per-
+    # client bound (or negative) is physically impossible — classify the
+    # run as poisoned (rc 3: the parent retries, then SKIPs) instead of
+    # reporting a false reconciliation failure.
+    flows_bound = 2  # flows per client in _check_config
+    for label, sim in (("off", sim_off), ("on", sim_on)):
+        fd = np.asarray(jax.device_get(sim.state.model["flows_done"]))
+        if (fd < 0).any() or (fd > flows_bound).any():
+            print(
+                f"POISONED: {label}-run model flow counters {fd.tolist()} "
+                f"outside [0, {flows_bound}] — the documented silent-"
+                f"scribble corruption, not an observatory verdict",
+                file=sys.stderr,
+            )
+            return 3
+
+    # observer exactness
+    ck(rep_on["determinism_digest"] == rep_off["determinism_digest"],
+       f"digest changed with observatory on: "
+       f"{rep_off['determinism_digest']} -> {rep_on['determinism_digest']}")
+    ck(rep_on["events_processed"] == rep_off["events_processed"],
+       "event count changed with observatory on")
+    net = rep_on.get("network")
+    ck(net is not None, "no network block in gated sim-stats")
+    if net is None:
+        net = {}
+
+    # event classes reconcile with the event counter
+    ec = net.get("event_classes", {})
+    ck(ec.get("total") == rep_on["events_processed"],
+       f"event-class total {ec.get('total')} != events "
+       f"{rep_on['events_processed']}")
+    ck(ec.get("timer", 0) > 0, "no timer events classified on tgen-TCP")
+    ck(ec.get("packet", 0) > 0, "no packet events classified")
+
+    # flow ledger reconciles exactly (drained records vs stats lanes vs
+    # the model's own counter)
+    flows = net.get("flows", {})
+    mr = rep_on["model_report"]
+    ck(flows.get("completed") == mr["flows_completed"],
+       f"ledger completions {flows.get('completed')} != model "
+       f"{mr['flows_completed']}")
+    ck(flows.get("records_drained", 0) + flows.get("records_lost", 0)
+       == flows.get("completed"),
+       f"drained {flows.get('records_drained')} + lost "
+       f"{flows.get('records_lost')} != completed "
+       f"{flows.get('completed')}")
+    if flows.get("records_lost", 0) == 0:
+        # nothing wrapped: the drained-record sums (ring path) must
+        # equal the fl_* stats lanes (independent in-jit path) — the
+        # real ledger-vs-counters cross-check
+        ck(flows.get("drained_bytes") == flows.get("bytes"),
+           f"drained record bytes {flows.get('drained_bytes')} != "
+           f"fl_bytes lane {flows.get('bytes')}")
+        ck(flows.get("drained_retransmits") == flows.get("retransmits"),
+           f"drained record retransmits "
+           f"{flows.get('drained_retransmits')} != fl_rtx lane "
+           f"{flows.get('retransmits')}")
+    ck(flows.get("retransmits", 0) <= mr["retransmits"],
+       f"per-flow retransmits {flows.get('retransmits')} exceed the "
+       f"model total {mr['retransmits']}")
+
+    # safe window covers every round
+    sw = net.get("safe_window", {})
+    ck(sum(sw.get("bound_rounds_per_shard", [])) == rep_on["rounds"],
+       f"safe-window bound counts {sw.get('bound_rounds_per_shard')} "
+       f"do not sum to rounds {rep_on['rounds']}")
+
+    share = ec.get("timer_share")
+    print(
+        f"timer share {share if share is not None else '-'} "
+        f"(timer={ec.get('timer')} packet={ec.get('packet')} "
+        f"app={ec.get('app')}), flows={flows.get('completed')}, "
+        f"fct p50={((flows.get('fct') or {}).get('p50_ms'))} ms"
+    )
+    if failures:
+        for f in failures:
+            print(f"CHECK FAILED: {f}", file=sys.stderr)
+        return 2
+    print("net_report --check ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("path", nargs="?",
+                   help="data dir or sim-stats.json with a network block")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--check", action="store_true",
+                   help="ledger-vs-counters reconciliation gate (CI "
+                   "stage); runs the compiled leg in a worker subprocess "
+                   "and classifies the known corruption signature as SKIP")
+    p.add_argument("--check-worker", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: the isolated leg
+    args = p.parse_args(argv)
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # this box's sitecustomize registers an axon TPU plugin and
+        # overrides the env var; pin the backend back (soak.py idiom)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.check_worker:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            return run_check(tmp)
+
+    if args.check:
+        # hbm_report posture: the compiled leg runs in a fresh
+        # subprocess; the documented corruption signature (no verdict
+        # printed) classifies as SKIP rc 0 instead of a false FAIL
+        cmd = [sys.executable, os.path.abspath(__file__), "--check-worker"]
+        for attempt in range(3):
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=600,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO,
+                )
+            except subprocess.TimeoutExpired:
+                print(f"attempt {attempt + 1}: check worker timed out "
+                      f"(600s); retrying", file=sys.stderr)
+                continue
+            sys.stdout.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+            if proc.returncode == 3:
+                # the worker's scribble gate classified its own device
+                # state as poisoned (silent-corruption flavor): retry
+                # like an aborting worker, never report it as a verdict
+                print(f"attempt {attempt + 1}: worker self-classified "
+                      f"poisoned device state; retrying", file=sys.stderr)
+                continue
+            if proc.returncode in HEAP_CORRUPTION_RCS and (
+                "ok" not in proc.stdout and "FAILED" not in proc.stderr
+            ):
+                print(f"attempt {attempt + 1}: known corruption signature "
+                      f"rc={proc.returncode}; retrying", file=sys.stderr)
+                continue
+            return proc.returncode
+        print("SKIP: every attempt died of the known jaxlib corruption "
+              "signature (environment, not an observatory verdict)")
+        return 0
+
+    if not args.path:
+        p.error("a data dir / sim-stats.json path is required "
+                "(or --check)")
+    stats, net = load_network_block(args.path)
+    if args.json:
+        print(json.dumps(net, indent=2))
+    else:
+        print_report(stats, net)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

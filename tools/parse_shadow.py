@@ -36,6 +36,11 @@ HEARTBEAT_RE = re.compile(
     # PR 9 memory-observatory field (only emitted when
     # observability.memory is on): per-shard HBM high-water, bytes
     r"(?:hbm=(?P<hbm>\d+) )?"
+    # PR 10 network-observatory fields (only emitted when
+    # observability.network is on): ek=<timer events>/<packet events>
+    # cumulative; fct=<flows completed> (flow-ledger runs only)
+    r"(?:ek=(?P<ek_timer>\d+)/(?P<ek_pkt>\d+) )?"
+    r"(?:fct=(?P<fct_done>\d+) )?"
     # PR 6 ensemble-campaign field (only emitted by tools/campaign.py):
     # rep=<replicas done>/<total replicas>
     r"(?:rep=(?P<rep_done>\d+)/(?P<rep_total>\d+) )?"

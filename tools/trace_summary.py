@@ -77,6 +77,25 @@ def summarize(trace: dict) -> dict:
             "rounds_per_chunk": _stats(per_chunk),
             "ms_per_chunk_mean": round(sum(spans_ms) / len(spans_ms), 2),
         }
+    # per-event-class breakdown (network observatory, PR 10): the
+    # timer/packet/app mix the timer-wheel decision (ROADMAP item 2)
+    # gates on. Traces recorded before the observatory (or with it off)
+    # carry no class counts — the section is omitted rather than lying
+    # with zeros-as-measurement.
+    ec = {
+        "timer": sum(r.get("ec_timer", 0) for r in rounds),
+        "packet": sum(r.get("ec_pkt", 0) for r in rounds),
+        "app": sum(r.get("ec_app", 0) for r in rounds),
+    }
+    ec_total = sum(ec.values())
+    if ec_total:
+        out["event_classes"] = {
+            **ec,
+            "total": ec_total,
+            "timer_share": round(ec["timer"] / ec_total, 4),
+            "packet_share": round(ec["packet"] / ec_total, 4),
+            "flows_completed": sum(r.get("flows", 0) for r in rounds),
+        }
     other = trace.get("otherData", {})
     if other:
         out["rounds_lost"] = other.get("rounds_lost", 0)
@@ -96,6 +115,15 @@ def _print_table(s: dict, out=sys.stdout):
             f"{p['events']['sum']:>9} {p['events']['mean']:>9.2f} "
             f"{p['microsteps']['sum']:>8} {p['sends']['sum']:>8} "
             f"{p['occ_hwm']:>8}",
+            file=out,
+        )
+    ec = s.get("event_classes")
+    if ec:
+        print(
+            f"event classes: timer={ec['timer']} "
+            f"({ec['timer_share'] * 100:.1f}%)  "
+            f"packet={ec['packet']} ({ec['packet_share'] * 100:.1f}%)  "
+            f"app={ec['app']}  flows={ec['flows_completed']}",
             file=out,
         )
     c = s.get("chunks")
