@@ -476,7 +476,21 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
             },
         }
         return cfg, "phold_pressure_sim_seconds_per_wall_second", 30
-    raise SystemExit(f"unknown --config {n} (1-9 supported)")
+    if n == 10:
+        # integrity-sentinel bench (PR 11): the flagship tgen-TCP torus
+        # shapes (config 6) with the in-jit invariant guards ON — what
+        # always-on SDC detection costs on the north-star workload. The
+        # guards are a handful of reductions per ROUND (one [H, C]
+        # compare for the slab floor + per-lane monotonicity compares),
+        # amortized over the round's microsteps; the BENCH row carries
+        # the integrity{transients,replays} counters so a box's scribble
+        # waves show up as counted, survived events instead of silent
+        # poison, and tools/bench_compare.py fails the diff if a
+        # deterministic violation ever appears.
+        cfg, _, stop_s = baseline_config(6, small)
+        cfg["integrity"] = {"enabled": True}
+        return cfg, "tgen_tcp_integrity_sim_seconds_per_wall_second", stop_s
+    raise SystemExit(f"unknown --config {n} (1-10 supported)")
 
 
 def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
@@ -572,10 +586,9 @@ def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
 
 def _corruption_rcs() -> tuple[int, ...]:
     """Worker exit signatures of this box's documented jaxlib-0.4.37
-    compiled-run corruption (CHANGES.md env notes). tests/subproc.py owns
-    the canonical set; imported lazily so plain bench runs never pull in
-    the test infra (subproc imports pytest at module level)."""
-    from tests.subproc import HEAP_CORRUPTION_RCS
+    compiled-run corruption (CHANGES.md env notes). tools/corruption.py
+    owns the canonical taxonomy (stdlib-only — no test infra, no JAX)."""
+    from tools.corruption import HEAP_CORRUPTION_RCS
 
     return HEAP_CORRUPTION_RCS
 
@@ -847,10 +860,11 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     # pressure escalation, exactly as the Simulation driver wires it —
     # config 9's BENCH row measures drop-free-under-pressure end to end
     resil = None
-    if gearctl is not None or cfg.pressure.active:
+    if gearctl is not None or cfg.pressure.active or cfg.integrity.enabled:
         resil = ResilienceController(
             gearctl=gearctl,
             pressure=cfg.pressure if cfg.pressure.active else None,
+            integrity=cfg.integrity if cfg.integrity.enabled else None,
             queue_block=sim.engine_cfg.queue_block,
         )
     ob_hwm_run = 0  # run-wide outbox high-water (gear runs reset the
@@ -886,13 +900,26 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
 
     sup_aborted = False
     press_aborted = False
+    integ_aborted = False
+
+    from shadow_tpu.core.integrity import IntegrityAbort
 
     def step(state):
-        nonlocal sup_aborted, press_aborted
+        nonlocal sup_aborted, press_aborted, integ_aborted
         try:
             if sup is None:
                 return _step_raw(state)
             return sup.run_chunk(state, _step_raw)
+        except IntegrityAbort as e:
+            # deterministic violation (or a persistently non-reproducing
+            # one): export the last good pre-chunk snapshot — the
+            # violating attempt's state is by definition corrupt — and
+            # let the row carry the abort naming for bench_compare
+            print(f"[integrity] aborting bench run: {e}", file=sys.stderr)
+            integ_aborted = True
+            sup_aborted = True  # stops the measurement loops
+            good = resil.abort_export_state()
+            return good if good is not None else state
         except PressureAbort as e:
             # same honest-artifacts posture as the drivers: abort policy
             # exports the dropping state, escalate-cornered the last
@@ -1048,6 +1075,14 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
                 }
                 if resil is not None and cfg.pressure.active else {}
             ),
+            # integrity-sentinel counters (PR 11): config 10's evidence
+            # — transient SDC survived + sentinel replays (zero on a
+            # clean box), and the deterministic-violation naming when
+            # the sentinel aborted the run
+            **(
+                {"integrity": resil.integrity_report()}
+                if resil is not None and cfg.integrity.enabled else {}
+            ),
             **(
                 {"supervisor": sup.report()} if sup is not None else {}
             ),
@@ -1084,8 +1119,16 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
                          "total_bytes", "per_host_bytes")
             },
         },
+        # the row-level integrity block (like network/hbm): what
+        # tools/bench_compare.py diffs — a deterministic violation
+        # appearing in NEW is a regression, transient growth a warning
+        **(
+            {"integrity": resil.integrity_report()}
+            if resil is not None and cfg.integrity.enabled else {}
+        ),
         **({"aborted": True} if sup_aborted else {}),
         **({"pressure_aborted": True} if press_aborted else {}),
+        **({"integrity_aborted": True} if integ_aborted else {}),
     }
 
 

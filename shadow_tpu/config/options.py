@@ -565,6 +565,52 @@ class PressureOptions:
 
 
 @dataclass
+class IntegrityOptions:
+    """The integrity sentinel (core/integrity.py + docs/architecture.md
+    "Integrity sentinel"): in-jit per-round invariant guards compiled
+    into the round body only when `enabled` — conservation laws the
+    state must satisfy regardless of workload (time monotonicity,
+    event-class reconciliation, queue fill-cache agreement, counter
+    monotonicity, outbox bounds, dual-digest virginity). With the block
+    absent/off the engine traces ZERO sentinel code and the program is
+    byte-identical to the pre-sentinel build.
+
+    On a violation the chunk aborts at the violating round and the
+    driver restores the pre-chunk snapshot and replays: a violation
+    reproducing with the same (shard, round, bitmask) signature is a
+    DETERMINISTIC engine bug -> loud IntegrityAbort naming the
+    invariant, round, and shard; one that does not reproduce is
+    transient silent data corruption -> counted in sim-stats
+    integrity{transients,replays}, logged, and the run continues."""
+
+    enabled: bool = False
+    # second, independently-folded per-host digest lane (stats.digest2)
+    # so a scribble on the digest plane itself is detectable
+    # (core/integrity.classify_digest_pair)
+    dual_digest: bool = True
+    # consecutive non-reproducing violation replays of ONE chunk before
+    # the sentinel gives up (violations persisting without ever
+    # reproducing still stop the run — progress must stay bounded)
+    max_replays: int = 3
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "IntegrityOptions":
+        d = dict(d or {})
+        o = IntegrityOptions(
+            enabled=bool(d.pop("enabled", False)),
+            dual_digest=bool(d.pop("dual_digest", True)),
+            max_replays=int(d.pop("max_replays", 3)),
+        )
+        if o.max_replays < 1:
+            raise ConfigError(
+                f"integrity.max_replays must be >= 1, got {o.max_replays}"
+            )
+        if d:
+            raise ConfigError(f"unknown integrity options: {sorted(d)}")
+        return o
+
+
+@dataclass
 class FaultChurnOptions:
     """Seeded host-churn: each host crashes once with probability `prob`
     at a uniform time in [bootstrap_end_time, stop_time), down for an
@@ -1061,6 +1107,7 @@ class ConfigOptions:
     )
     faults: FaultOptions = field(default_factory=FaultOptions)
     pressure: PressureOptions = field(default_factory=PressureOptions)
+    integrity: IntegrityOptions = field(default_factory=IntegrityOptions)
     campaign: CampaignOptions = field(default_factory=CampaignOptions)
     host_option_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: list[HostOptions] = field(default_factory=list)
@@ -1092,6 +1139,7 @@ class ConfigOptions:
             ),
             faults=FaultOptions.from_dict(d.pop("faults", None)),
             pressure=PressureOptions.from_dict(d.pop("pressure", None)),
+            integrity=IntegrityOptions.from_dict(d.pop("integrity", None)),
             campaign=CampaignOptions.from_dict(d.pop("campaign", None)),
             host_option_defaults=defaults,
             hosts=hosts,

@@ -136,6 +136,16 @@ from shadow_tpu.core.compat import shard_map_compat as _shard_map
 _FNV_PRIME = jnp.uint64(1099511628211)
 _MIX1 = jnp.uint64(0x9E3779B97F4A7C15)
 _MIX2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
+_FNV_OFFSET = jnp.uint64(0xCBF29CE484222325)
+# dual-digest fold constants (integrity sentinel, core/integrity.py):
+# no constant shared with the primary fold, and the mix roles of t and
+# order are swapped, so the two planes cannot agree by accident
+from shadow_tpu.core.integrity import DIGEST2_OFFSET, DIGEST2_PRIME
+
+_DIGEST2_OFFSET = jnp.uint64(DIGEST2_OFFSET)
+_DIGEST2_PRIME = jnp.uint64(DIGEST2_PRIME)
+_MIX3 = jnp.uint64(0xD6E8FEB86659FD93)
+_MIX4 = jnp.uint64(0xA0761D6478BD642F)
 
 
 class Outbox(NamedTuple):
@@ -234,6 +244,26 @@ class Stats(NamedTuple):
     # shard's local min event time bound the all-reduce-min barrier
     # (ties to the lowest shard id) — the critical-path/straggler view.
     win_bound: Any = None  # i64[world] | None
+    # Integrity sentinel lanes (core/integrity.py; None unless
+    # cfg.integrity — the default program traces zero sentinel code and
+    # stays byte-identical). `integrity` is the psum'd GLOBAL cumulative
+    # violation count (the chunk loop's mesh-uniform first-violation
+    # abort signal, same mechanism as gear_shed/pressure); `iv_mask` is
+    # the PER-SHARD bitwise-OR of violated invariant bits (bit positions
+    # in core/integrity.IV_NAMES) and `iv_round` the per-shard round
+    # index of the first local violation (-1 = none) — together the
+    # (shard, round, mask) reproduction signature the replay classifier
+    # compares. Structurally zero/-1 in any accepted final state: a
+    # violating chunk always aborts and is replayed or the run stops.
+    integrity: Any = None  # i64[world] | None
+    iv_mask: Any = None  # i64[world] | None
+    iv_round: Any = None  # i64[world] | None
+    # Dual digest (None unless cfg.integrity_dual): a second,
+    # independently-folded per-host event digest sharing NO constants
+    # with the primary FNV fold, so a scribble on one digest plane is
+    # detectable by cross-checking the two (core/integrity.
+    # classify_digest_pair) instead of silently reporting a wrong digest.
+    digest2: Any = None  # u64[H] | None
 
 
 class SimState(NamedTuple):
@@ -445,6 +475,31 @@ class EngineConfig:
     # records, counted by the FlowCollector (never silent), while the
     # fl_* stats lanes keep exact totals regardless.
     flow_records: int = 0
+    # Integrity sentinel (core/integrity.py; config `integrity:`): when
+    # True the round body evaluates the per-round invariant guards
+    # (conservation laws the state must satisfy regardless of workload)
+    # into the psum'd `stats.integrity` violation lane plus the
+    # per-shard `iv_mask`/`iv_round` signature lanes, and the chunk
+    # while_loop aborts mesh-uniformly at the first violating round —
+    # the detector the quarantine-and-replay classifier
+    # (core/pressure.ResilienceController) restores and replays on.
+    # False (the default) traces ZERO sentinel code: the program is
+    # byte-identical to the pre-sentinel engine (the echo/phold jaxpr
+    # fingerprints are the gate).
+    integrity: bool = False
+    # dual-digest lane (requires integrity): maintain the second,
+    # independently-folded per-host digest (stats.digest2) so a scribble
+    # on the digest plane itself is detectable host-side.
+    integrity_dual: bool = False
+    # strict window-monotonicity sub-check of IV_TIME (window_end never
+    # below the committed now). Unconditional on the pure-device engine
+    # under static runahead; the HYBRID bridge legitimately injects
+    # CPU-plane packets whose conservative arrival bound (until +
+    # min-latency) can sit below the device's last guarded window_end
+    # when runahead_floor exceeds the graph's min latency — cosim
+    # therefore builds with False and keeps the slab-floor sub-check
+    # plus its own host-side bridge guards (cosim._bridge_guard).
+    integrity_strict_time: bool = True
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -503,6 +558,11 @@ class EngineConfig:
             raise ValueError(
                 "flow_records > 0 requires netobs=True (the flow ledger "
                 "is a network-observatory instrument)"
+            )
+        if self.integrity_dual and not self.integrity:
+            raise ValueError(
+                "integrity_dual requires integrity=True (the dual digest "
+                "is an integrity-sentinel lane)"
             )
 
     @property
@@ -624,7 +684,7 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         q_occ_hwm=zi(),
         outbox_hwm=jnp.zeros((cfg.world,), jnp.int64),
         gear_shed=jnp.zeros((cfg.world,), jnp.int64),
-        digest=jnp.full((h,), 0xCBF29CE484222325, jnp.uint64),  # FNV offset
+        digest=jnp.full((h,), _FNV_OFFSET, jnp.uint64),  # FNV offset basis
         rounds=jnp.zeros((), jnp.int64),
         pressure=(
             jnp.zeros((cfg.world,), jnp.int64) if cfg.pressure_abort
@@ -639,6 +699,18 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         fl_bytes=zw() if cfg.flow_ledger_active else None,
         fl_rtx=zw() if cfg.flow_ledger_active else None,
         win_bound=zw() if cfg.netobs else None,
+        # integrity sentinel lanes (core/integrity.py): absent unless
+        # the sentinel is traced in; iv_round's -1 = "no violation yet"
+        integrity=zw() if cfg.integrity else None,
+        iv_mask=zw() if cfg.integrity else None,
+        iv_round=(
+            jnp.full((cfg.world,), -1, jnp.int64) if cfg.integrity
+            else None
+        ),
+        digest2=(
+            jnp.full((h,), _DIGEST2_OFFSET, jnp.uint64)
+            if cfg.integrity_dual else None
+        ),
     )
 
 
@@ -726,6 +798,17 @@ def _digest_update(digest, active, t, kind, order):
     x = x ^ (kind.astype(jnp.uint64) * _MIX2)
     x = x ^ order.astype(jnp.uint64)
     return jnp.where(active, (digest ^ x) * _FNV_PRIME, digest)
+
+
+def _digest_update2(digest2, active, t, kind, order):
+    """The integrity sentinel's SECOND per-host fold: same inputs, no
+    shared constants, and order (not t) carries the first multiplier —
+    a scribble flipping bits on one digest plane cannot land on a value
+    consistent with the other (core/integrity.classify_digest_pair)."""
+    x = order.astype(jnp.uint64) * _MIX3
+    x = x ^ (t.astype(jnp.uint64) * _MIX4)
+    x = x ^ kind.astype(jnp.uint64)
+    return jnp.where(active, (digest2 ^ x) * _DIGEST2_PRIME, digest2)
 
 
 def _outbox_append(ob: Outbox, mask, col, dst, t, order, kind, payload):
@@ -995,6 +1078,10 @@ class Engine:
                 fl_bytes=sh if self.cfg.flow_ledger_active else None,
                 fl_rtx=sh if self.cfg.flow_ledger_active else None,
                 win_bound=sh if self.cfg.netobs else None,
+                integrity=sh if self.cfg.integrity else None,
+                iv_mask=sh if self.cfg.integrity else None,
+                iv_round=sh if self.cfg.integrity else None,
+                digest2=sh if self.cfg.integrity_dual else None,
             ),
             trace=(
                 TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
@@ -1160,6 +1247,12 @@ def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EnginePa
     # replays (escalate) or stops with honest artifacts (abort).
     shed0 = state.stats.gear_shed[0] if cfg.gear_active else None
     press0 = state.stats.pressure[0] if cfg.pressure_abort else None
+    # integrity sentinel: stop at the first violating round — every
+    # further round of this chunk would run on state a guard already
+    # called corrupt, and the driver's classifier discards the attempt
+    # and replays from its pre-chunk snapshot anyway. `stats.integrity`
+    # is psum'd, so the condition is uniform across the mesh.
+    iv0 = state.stats.integrity[0] if cfg.integrity else None
 
     def cond(carry):
         st, i = carry
@@ -1168,6 +1261,8 @@ def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EnginePa
             ok = ok & (st.stats.gear_shed[0] <= shed0)
         if press0 is not None:
             ok = ok & (st.stats.pressure[0] <= press0)
+        if iv0 is not None:
+            ok = ok & (st.stats.integrity[0] <= iv0)
         return ok
 
     def body(carry):
@@ -1197,6 +1292,7 @@ def _run_guarded_chunk(
     first-drop pressure abort when `cfg.pressure_abort` is set."""
     shed0 = st.stats.gear_shed[0] if cfg.gear_active else None
     press0 = st.stats.pressure[0] if cfg.pressure_abort else None
+    iv0 = st.stats.integrity[0] if cfg.integrity else None
 
     def cond(carry):
         stc, i = carry
@@ -1219,6 +1315,11 @@ def _run_guarded_chunk(
             ok = ok & (stc.stats.gear_shed[0] <= shed0)
         if press0 is not None:
             ok = ok & (stc.stats.pressure[0] <= press0)
+        if iv0 is not None:
+            # first-violation stop, same mechanism as the pressure abort
+            # (the hybrid driver raises IntegrityAbort on it — the CPU
+            # plane cannot roll back, so no replay classification there)
+            ok = ok & (stc.stats.integrity[0] <= iv0)
         return ok
 
     def body(carry):
@@ -1393,6 +1494,27 @@ def _window_step(
         )
         total = lax.psum(local, axis) if axis else local
         stats = stats._replace(pressure=total[None])
+    if cfg.integrity:
+        # integrity sentinel (core/integrity.py): evaluate the per-round
+        # invariant guards on the post-exchange state. The count is
+        # psum'd so the chunk loop's first-violation abort is uniform
+        # across the mesh; the (shard, round, mask) signature lanes stay
+        # per-shard so the replay classifier can name the violating
+        # shard. The final done-round is not a scheduling round and is
+        # never judged (mirrors stats.rounds).
+        iv_viol, iv_m = _integrity_round_check(
+            cfg, axis, st, st_m, st_x, stats, window_end, done, ob_hwm
+        )
+        iv_total = lax.psum(iv_viol, axis) if axis else iv_viol
+        stats = stats._replace(
+            integrity=stats.integrity + iv_total[None],
+            iv_mask=stats.iv_mask | iv_m[None],
+            iv_round=jnp.where(
+                (stats.iv_round < 0) & (iv_m != 0),
+                st.stats.rounds,
+                stats.iv_round,
+            ),
+        )
     min_used = _pmin(st_x.min_used_lat, axis)
     out = st_x._replace(
         now=jnp.where(done, st.now, window_end),
@@ -1486,6 +1608,123 @@ def _trace_round(
     )
 
 
+def _integrity_round_check(
+    cfg: EngineConfig, axis, st0: SimState, st_m: SimState, st_x: SimState,
+    stats: Stats, window_end, done, ob_hwm,
+):
+    """The integrity sentinel's per-round invariant guards
+    (core/integrity.py names the bits). Returns (local violation count
+    i64, local invariant bitmask i64), both zeroed on the done-round.
+
+    Every check below is UNCONDITIONAL — satisfied by construction on
+    every legal engine trajectory, so a trip always means corrupted
+    state (or a real engine bug, which the replay classifier
+    distinguishes). The derivations:
+
+      IV_TIME (a) window monotonicity: window_end = min(gmin_eff + ra,
+        stop) with gmin_eff >= committed now (leftover events are >= the
+        previous window end, floor-held events' effective time is their
+        restart/busy horizon >= now) and stop >= now — so a regressing
+        window means a past-time value appeared in the time plane. The
+        one legal exception is a valve-bound round (the livelock
+        condition leaves in-window events behind) combined with DYNAMIC
+        runahead shrink, so (a) is traced only under static runahead.
+      IV_TIME (b) slab floor: every event present at round entry is
+        >= the entry's global raw minimum; pops remove, pushes carry
+        t >= the executing event's time >= that minimum, and merged
+        arrivals are >= window_end > it — so no post-round slot may
+        hold a smaller time (catches in-flight scribbles on the time
+        plane within the round, any runahead mode).
+      IV_EC: ec_timer/ec_pkt/ec_app bucket the exact `active` mask
+        stats.events counts (`_event_body`), so the class sums equal
+        the event total per shard — the netobs reconciliation CHECK
+        promoted to a hard guard (traced only when the observatory is).
+      IV_QFILL: the bucketed queue's per-block fill caches are
+        incrementally maintained to equal the slab's true occupancy
+        (tests/test_bucketq.py gates the ops); one [H, C] compare+sum
+        re-derives the truth (bucketed layouts only).
+      IV_COUNTER: every event/drop/fault counter only ever adds
+        non-negative masks — deltas are >= 0 and values never negative.
+      IV_OUTBOX: sent_round increments by booleans gated on the budget,
+        so no host's round cursor exceeds sends_per_host_round, cursors
+        stay non-negative, and the count word stays in [0, H x B].
+      IV_DIGEST: a host with zero executed events has never passed
+        through `_digest_update`, so both digest lanes still carry
+        their initial offsets (the dual lane shares no constants with
+        the primary — core/integrity.classify_digest_pair)."""
+    from shadow_tpu.core.integrity import (
+        IV_COUNTER,
+        IV_DIGEST,
+        IV_EC,
+        IV_OUTBOX,
+        IV_QFILL,
+        IV_TIME,
+    )
+
+    checks: list[tuple[int, Any]] = []
+    gmin_raw = _pmin(jnp.min(st0.queue.t), axis)
+    t_bad = jnp.min(st_x.queue.t) < gmin_raw
+    if cfg.integrity_strict_time and not cfg.use_dynamic_runahead:
+        # see the IV_TIME (a) derivation above: valve-bound rounds under
+        # DYNAMIC runahead (shrinking ra) and the hybrid bridge
+        # (cfg.integrity_strict_time False) are the two legal exceptions
+        t_bad = t_bad | (window_end < st0.now)
+    checks.append((IV_TIME, t_bad))
+    if cfg.netobs:
+        ec_sum = stats.ec_timer[0] + stats.ec_pkt[0] + stats.ec_app[0]
+        checks.append((IV_EC, ec_sum != jnp.sum(stats.events)))
+    if cfg.queue_block:
+        # judged PRE-exchange (st_m): the merge rebuilds the caches
+        # wholesale whenever any shard sent, which would erase a
+        # divergence before a post-exchange read could see it — the
+        # incrementally-maintained pre-merge caches carry one through
+        occ_true = jnp.sum(
+            st_m.queue.t != TIME_MAX, axis=1, dtype=jnp.int32
+        )
+        checks.append((
+            IV_QFILL,
+            jnp.any(occ_true != jnp.sum(st_m.queue.bfill, axis=1)),
+        ))
+    c_bad = jnp.any(st_x.queue.dropped < st0.queue.dropped) | jnp.any(
+        st_x.queue.dropped < 0
+    )
+    for get in (
+        lambda s: s.events,
+        lambda s: s.pkts_sent,
+        lambda s: s.pkts_lost,
+        lambda s: s.pkts_unreachable,
+        lambda s: s.pkts_codel_dropped,
+        lambda s: s.pkts_delivered,
+        lambda s: s.pkts_budget_dropped,
+        lambda s: s.faults_dropped,
+        lambda s: s.faults_delayed,
+    ):
+        post, pre = get(stats), get(st0.stats)
+        c_bad = c_bad | jnp.any(post < pre) | jnp.any(post < 0)
+    checks.append((IV_COUNTER, c_bad))
+    b = cfg.sends_per_host_round
+    count = st_m.outbox.count[0]
+    checks.append((
+        IV_OUTBOX,
+        (ob_hwm > b)
+        | (jnp.min(st_m.sent_round) < 0)
+        | (count < 0)
+        | (count > st_m.outbox.t.shape[0] * b),
+    ))
+    virgin = stats.digest != _FNV_OFFSET
+    if cfg.integrity_dual:
+        virgin = virgin | (stats.digest2 != _DIGEST2_OFFSET)
+    checks.append((IV_DIGEST, jnp.any((stats.events == 0) & virgin)))
+
+    mask = jnp.zeros((), jnp.int64)
+    viol = jnp.zeros((), jnp.int64)
+    for bit, bad in checks:
+        bad = bad & ~done
+        mask = mask | jnp.where(bad, jnp.int64(1 << bit), jnp.int64(0))
+        viol = viol + bad.astype(jnp.int64)
+    return viol, mask
+
+
 def _hold_faults(cfg: EngineConfig, params: EngineParams):
     """The fault schedule iff queue-HOLD crash semantics are in force —
     the only fault mode that floors next-event times (clear mode drops at
@@ -1538,6 +1777,12 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
         events=stats.events + active,
         digest=_digest_update(stats.digest, active, ev.t, ev.kind, ev.order),
     )
+    if cfg.integrity_dual:
+        stats = stats._replace(
+            digest2=_digest_update2(
+                stats.digest2, active, ev.t, ev.kind, ev.order
+            )
+        )
 
     is_pkt = (ev.kind & KIND_PKT) != 0
 

@@ -1,0 +1,180 @@
+"""Integrity sentinel: in-jit invariant guards + host-side SDC classification.
+
+This box's own history (CHANGES.md PR 9/10 env notes) documents silent
+data corruption waves — device buffers scribbled with pointer garbage,
+digests flipping with no crash — and until now every defense was
+after-the-fact: the supervisor's digest cross-check fires only at
+snapshot boundaries, and the subprocess classifiers only see a run's
+final artifacts. The sentinel moves detection INTO the jitted round
+body: a set of conservation laws the state must satisfy on every round
+regardless of workload, compiled in only when `integrity.enabled` is on
+(default OFF traces zero sentinel code — the default echo/phold jaxpr
+fingerprints are byte-unchanged, the gate tests/test_integrity.py pins).
+
+The invariant set (bit positions in the per-shard `stats.iv_mask` lane;
+every check is unconditional — an invariant that a legal engine
+trajectory could violate would turn the sentinel into a false-abort
+machine, so each one's derivation is written out at the check site in
+core/engine.py `_integrity_round_check`):
+
+  IV_TIME     safe-window/time monotonicity: the new window never
+              regresses past the committed time, and no queue slot ever
+              holds a time below the round-entry global minimum.
+  IV_EC       event-class reconciliation (network observatory on):
+              ec_timer + ec_pkt + ec_app == events — the netobs
+              reconciliation CHECK promoted to a hard in-round guard.
+  IV_QFILL    bucketed-queue occupancy agreement: the incrementally
+              maintained per-block fill caches sum to the slab's true
+              non-empty slot count.
+  IV_COUNTER  counter monotonicity: event/drop/fault counters never
+              decrease within a round and never go negative.
+  IV_OUTBOX   outbox bounds: no host stages more than the send budget in
+              a round, cursors stay non-negative, the count word stays
+              inside [0, H x B].
+  IV_DIGEST   dual-digest virginity: a host with zero executed events
+              still carries both digest lanes' initial values (the
+              second, independently-folded lane makes a scribble on the
+              digest plane itself detectable — see classify_digest_pair).
+
+Detection feeds the snapshot-replay machinery PR 8 built
+(core/pressure.ResilienceController): the chunk while_loop aborts
+mesh-uniformly at the first violating round (same mechanism as
+gear_shed/pressure), the controller restores the pre-chunk snapshot and
+replays; a violation that REPRODUCES at the same round with the same
+bitmask is deterministic — a real engine bug — and raises
+`IntegrityAbort` naming the invariant, round, and shard, with last-good
+artifacts exported poisoned-style. A violation that does NOT reproduce
+is transient SDC: counted in sim-stats `integrity{transients,replays}`,
+logged, and the run continues — the documented scribble waves turn from
+silent poison into counted, survived events.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+# invariant bit positions (stats.iv_mask); append-only — recorded masks
+# in logs/artifacts are read by these positions
+IV_TIME = 0
+IV_EC = 1
+IV_QFILL = 2
+IV_COUNTER = 3
+IV_OUTBOX = 4
+IV_DIGEST = 5
+
+IV_NAMES = (
+    "time_monotonic",
+    "event_class_reconcile",
+    "queue_fill_cache",
+    "counter_monotonic",
+    "outbox_budget",
+    "dual_digest_virgin",
+)
+
+# second digest lane's fold constants (core/engine._digest_update2):
+# deliberately DIFFERENT offset basis, mix multipliers, and fold prime
+# from the primary FNV-1a fold so a scribble cannot satisfy both lanes
+# by accident — the planes share no constants.
+DIGEST2_OFFSET = 0x9AE16A3B2F90404F  # (cityhash k2)
+# distinct ODD fold multiplier (the PCG-64 LCG constant): an even
+# multiplier would shift one bit of history out of the fold per event,
+# leaving digest2 a function of only a host's last ~63 events — which
+# would let genuinely divergent trajectories misclassify as
+# "digest-plane" scribbles (classify_digest_pair's central guarantee)
+DIGEST2_PRIME = 0x5851F42D4C957F2D
+
+
+class IntegrityAbort(RuntimeError):
+    """A deterministic invariant violation (reproduced at the same round
+    with the same bitmask across a snapshot replay), or a hybrid-plane
+    violation the bridge cannot replay-classify. The driver exports
+    last-good artifacts poisoned-style: the violating attempt's state is
+    discarded and the report names the invariant, round, and shard."""
+
+
+def mask_names(mask: int) -> list[str]:
+    """The invariant names a violation bitmask encodes."""
+    out = [name for bit, name in enumerate(IV_NAMES) if mask & (1 << bit)]
+    if mask >> len(IV_NAMES):
+        out.append(f"unknown_bits=0x{mask >> len(IV_NAMES):x}")
+    return out
+
+
+def violation_total(state) -> int:
+    """The psum'd global cumulative violation count, read host-side
+    (uniform across shards; max guards against a scribbled replica)."""
+    import jax
+
+    lane = getattr(state.stats, "integrity", None)
+    if lane is None:
+        return 0
+    return int(np.asarray(jax.device_get(lane)).max())
+
+
+def violation_signature(state) -> tuple:
+    """Canonical (shard, round, mask) tuple per violating shard — the
+    reproduction key the quarantine-and-replay classifier compares: a
+    replayed chunk reproducing the SAME signature is deterministic, a
+    differing/absent one is transient SDC."""
+    import jax
+
+    masks = np.asarray(jax.device_get(state.stats.iv_mask))
+    first_round = np.asarray(jax.device_get(state.stats.iv_round))
+    return tuple(
+        (int(shard), int(first_round[shard]), int(masks[shard]))
+        for shard in range(masks.shape[0])
+        if int(masks[shard]) != 0
+    )
+
+
+def describe_signature(sig: tuple) -> str:
+    """Human-readable violation naming: invariant(s), round, shard."""
+    if not sig:
+        return "no violating shard recorded"
+    return "; ".join(
+        f"shard {shard}: invariant(s) {'+'.join(mask_names(mask))} "
+        f"(mask 0x{mask:x}) at round {rnd}"
+        for shard, rnd, mask in sig
+    )
+
+
+def raise_if_violated(state, baseline: int = 0, context: str = ""):
+    """Loud stop on any violation past `baseline` — the hybrid driver's
+    path (the CPU plane cannot roll back, so a violation there is
+    unclassifiable by replay and treated as deterministic)."""
+    total = violation_total(state)
+    if total <= baseline:
+        return
+    sig = violation_signature(state)
+    prefix = f"{context}: " if context else ""
+    raise IntegrityAbort(
+        f"integrity: {prefix}invariant violated ({total - baseline} new "
+        f"violation(s)) — {describe_signature(sig)}"
+    )
+
+
+def classify_digest_pair(
+    primary_a: int, dual_a: Any, primary_b: int, dual_b: Any
+) -> str:
+    """Classify two completed runs' (primary, dual) digest folds:
+
+      "clean"        — both lanes agree: same trajectory.
+      "digest-plane" — primary lanes disagree but the independently-
+                       folded dual lanes agree: the trajectories were
+                       identical and one PRIMARY digest plane was
+                       scribbled (the SDC flavor a single digest cannot
+                       see — the wrong-digest corruption mode the
+                       CHANGES.md env notes document).
+      "divergent"    — the dual lanes disagree: the trajectories really
+                       differed (primary agreement with dual divergence
+                       is the mirror scribble on a dual plane).
+
+    Dual folds may be None (sentinel off / old artifacts): then only
+    "clean"/"divergent" are distinguishable from the primary lane."""
+    if dual_a is None or dual_b is None:
+        return "clean" if primary_a == primary_b else "divergent"
+    if int(dual_a) == int(dual_b):
+        return "clean" if primary_a == primary_b else "digest-plane"
+    return "divergent"

@@ -74,10 +74,16 @@ COUNTER_LANES = frozenset({
     "cursor", "rounds", "microsteps", "events",
     "ec_timer", "ec_pkt", "ec_app",
     "fl_done", "fl_bytes", "fl_rtx", "win_bound",
+    # integrity sentinel (core/integrity.py): the psum'd violation
+    # count, the per-shard invariant bitmask, and the first-violation
+    # round index (-1 = none) — i64 like every control-signal lane
+    "integrity", "iv_mask", "iv_round",
 })
 
-# Digest lanes: uint64 (FNV-1a fold, core/engine.py _digest_update).
-DIGEST_LANES = frozenset({"digest"})
+# Digest lanes: uint64 (FNV-1a fold, core/engine.py _digest_update;
+# digest2 is the integrity sentinel's independently-folded dual lane,
+# core/engine.py _digest_update2).
+DIGEST_LANES = frozenset({"digest", "digest2"})
 
 # Deliberately-32-bit lanes (ids and per-round cursors bounded by
 # construction): narrowing TO these widths is fine, narrowing below is
@@ -184,6 +190,13 @@ STATE_LANES: dict[str, str] = {
     "stats.win_bound": "int64",
     "flows.rows": "int64",
     "flows.cursor": "int64",
+    # integrity-sentinel lanes (core/integrity.py; present only when
+    # the `integrity:` block enables the guards — the default program
+    # carries None here and traces no sentinel code)
+    "stats.integrity": "int64",
+    "stats.iv_mask": "int64",
+    "stats.iv_round": "int64",
+    "stats.digest2": "uint64",
     "stats.digest": "uint64",
 }
 
@@ -221,7 +234,7 @@ _STATS_PER_SHARD = (
     "ob_dropped", "a2a_shed", "microsteps", "bq_rebuilds", "popk_deferred",
     "ici_bytes", "outbox_hwm", "gear_shed", "pressure",
     "ec_timer", "ec_pkt", "ec_app", "fl_done", "fl_bytes", "fl_rtx",
-    "win_bound",
+    "win_bound", "integrity", "iv_mask", "iv_round",
 )
 
 STATE_LANE_SHAPES: dict[str, tuple] = {
@@ -253,6 +266,7 @@ STATE_LANE_SHAPES: dict[str, tuple] = {
     **{f"stats.{f}": ("H",) for f in _STATS_PER_HOST},
     **{f"stats.{f}": ("S",) for f in _STATS_PER_SHARD},
     "stats.digest": ("H",),
+    "stats.digest2": ("H",),
     "stats.rounds": (),
 }
 
@@ -290,6 +304,15 @@ STATS_EXPORT_EXEMPT: dict[str, str] = {
         "pressure{} block in sim-stats carries the regrow/replay "
         "accounting"
     ),
+    **{f: (
+        "transient integrity-abort control signal (core/integrity.py): "
+        "a violating chunk is discarded and replayed from its pre-chunk "
+        "snapshot (transient SDC) or the run stops (IntegrityAbort), so "
+        "the lanes are structurally zero/-1 in any accepted final "
+        "state; the integrity{} block in sim-stats carries the "
+        "transient/replay accounting and the deterministic-violation "
+        "naming"
+    ) for f in ("integrity", "iv_mask", "iv_round")},
 }
 
 # ---------------------------------------------------------------------------

@@ -59,6 +59,13 @@ from typing import Any
 
 import numpy as np
 
+from shadow_tpu.core.integrity import (
+    IntegrityAbort,
+    describe_signature,
+    violation_signature,
+    violation_total,
+)
+
 DEFAULT_MAX_CAPACITY_FACTOR = 8  # auto max_capacity = 8x the base slab
 DEFAULT_MAX_OUTBOX_FACTOR = 4  # auto max_outbox = 4x the base budget
 
@@ -131,6 +138,7 @@ class ResilienceController:
         *,
         gearctl=None,
         pressure=None,
+        integrity=None,
         queue_block: int = 0,
         reshard=None,
         log=None,
@@ -138,6 +146,26 @@ class ResilienceController:
     ):
         self.gearctl = gearctl
         self.pressure = pressure
+        # integrity sentinel (core/integrity.py): the third arbitration
+        # branch — a chunk whose in-jit invariant guards tripped is
+        # restored from the pre-chunk snapshot and replayed AT THE SAME
+        # SHAPE; a violation reproducing with the same (shard, round,
+        # bitmask) signature is deterministic (IntegrityAbort), one that
+        # does not reproduce is transient SDC (counted, survived).
+        self.integrity = integrity  # config.options.IntegrityOptions | None
+        self.integrity_on = bool(
+            integrity is not None and getattr(integrity, "enabled", False)
+        )
+        self.iv_transients = 0  # violations that did not reproduce
+        self.iv_replays = 0  # chunk replays the sentinel forced
+        self.iv_deterministic: dict | None = None  # the abort's naming
+        # test-only state-mutation hook: callable(state, attempt) -> state
+        # applied AFTER the pre-chunk snapshot, before each dispatch
+        # attempt — the seam tests/test_integrity.py uses to emulate
+        # in-flight SDC (a one-shot scribble must not survive into the
+        # replay, exactly like real corruption of in-dispatch buffers).
+        # None in production.
+        self.test_scribble = None
         self.queue_block = int(queue_block)
         self._reshard = reshard
         self._log = log
@@ -312,20 +340,33 @@ class ResilienceController:
         else:
             cap = budget = 0
         need_snap = (
-            gearctl is not None and gear < gearctl.top
-        ) or self.escalate
+            (gearctl is not None and gear < gearctl.top)
+            or self.escalate
+            or self.integrity_on
+        )
         snap = snapshot_state(state) if need_snap else None
         self._last_snap = snap
+        # integrity classifier state, chunk-scoped: the last violating
+        # attempt's (shard, round, mask) signature and how many
+        # sentinel-forced replays this chunk has eaten
+        iv_last_sig = None
+        iv_attempts = 0
+        attempt_i = 0
         while True:
             shed0 = int(
                 np.asarray(jax.device_get(state.stats.gear_shed)).max()
             )
             press0 = self._pressure_total(state) if pressured else 0
             cats0 = self._pressure_categories(state) if pressured else None
+            iv0 = violation_total(state) if self.integrity_on else 0
+            if self.test_scribble is not None:
+                state = self.test_scribble(state, attempt_i)
+            attempt_i += 1
             try:
                 out = dispatch(state, gear, cap, budget)
                 jax.block_until_ready(out)
-            except (KeyboardInterrupt, SystemExit, PressureAbort):
+            except (KeyboardInterrupt, SystemExit, PressureAbort,
+                    IntegrityAbort):
                 raise
             except Exception as e:
                 grown_cap = (
@@ -399,6 +440,76 @@ class ResilienceController:
                     self._last_snap = snap
                     continue
                 raise
+            if self.integrity_on:
+                # integrity arbitration FIRST: a violating attempt's
+                # other counters (shed/pressure) may themselves be
+                # scribbled — the attempt is discarded wholesale either
+                # way, so nothing below may act on it
+                ivd = violation_total(out) - iv0
+                if ivd > 0:
+                    sig = violation_signature(out)
+                    detail = describe_signature(sig)
+                    if iv_last_sig is not None and sig == iv_last_sig:
+                        # reproduced at the same round with the same
+                        # bitmask across a snapshot replay: the engine
+                        # deterministically violates its own invariant —
+                        # a real bug, never survivable
+                        self.aborted = True
+                        self.iv_deterministic = {
+                            "signature": [list(s) for s in sig],
+                            "detail": detail,
+                        }
+                        self.last_error = (
+                            f"deterministic integrity violation: {detail}"
+                        )
+                        raise IntegrityAbort(
+                            f"integrity: violation REPRODUCED across a "
+                            f"snapshot replay (deterministic engine bug, "
+                            f"not SDC) — {detail}"
+                        )
+                    if iv_last_sig is not None:
+                        # the previous violation did not reproduce at
+                        # its signature: transient SDC, counted
+                        self.iv_transients += 1
+                    iv_last_sig = sig
+                    iv_attempts += 1
+                    if iv_attempts > self.integrity.max_replays:
+                        # cornered WITHOUT dispatching another replay:
+                        # iv_replays counts replays that actually ran
+                        # (iv_attempts - 1 here), not this refusal
+                        self.aborted = True
+                        self.iv_deterministic = {
+                            "signature": [list(s) for s in sig],
+                            "detail": detail,
+                            "nonreproducing": True,
+                        }
+                        self.last_error = (
+                            f"integrity violations persist without "
+                            f"reproducing after {iv_attempts - 1} "
+                            f"replays; last: {detail}"
+                        )
+                        raise IntegrityAbort(
+                            f"integrity: cornered — {self.last_error}"
+                        )
+                    self.iv_replays += 1
+                    self._say(
+                        f"invariant violation ({detail}); restoring "
+                        f"pre-chunk snapshot and replaying to classify "
+                        f"(attempt {iv_attempts}/"
+                        f"{self.integrity.max_replays})"
+                    )
+                    state = restore_snapshot(snap)
+                    continue
+                if iv_last_sig is not None:
+                    # the replay came back clean: the violation was
+                    # transient SDC — counted, logged, survived
+                    self.iv_transients += 1
+                    self._say(
+                        "transient SDC survived: the violation did not "
+                        "reproduce on replay; continuing with the clean "
+                        "chunk"
+                    )
+                    iv_last_sig = None
             shed = (
                 int(np.asarray(jax.device_get(out.stats.gear_shed)).max())
                 - shed0
@@ -641,6 +752,22 @@ class ResilienceController:
         """(queue_capacity, send_budget) of a state — the heartbeat's
         `cap=` source."""
         return state.queue.t.shape[1], state.outbox.t.shape[1]
+
+    def integrity_report(self) -> dict:
+        """JSON-able integrity{} accounting for sim-stats / BENCH rows:
+        the transient/replay counts plus — after an IntegrityAbort — the
+        deterministic violation's naming (invariants, round, shard)."""
+        out: dict[str, Any] = {
+            "transients": self.iv_transients,
+            "replays": self.iv_replays,
+            "max_replays": (
+                self.integrity.max_replays if self.integrity is not None
+                else 0
+            ),
+        }
+        if self.iv_deterministic is not None:
+            out["deterministic"] = self.iv_deterministic
+        return out
 
     def report(self) -> dict:
         """JSON-able summary for sim-stats / BENCH rows."""

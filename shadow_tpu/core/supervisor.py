@@ -51,9 +51,11 @@ class SupervisorAbort(RuntimeError):
     last good state and writes artifacts for the completed prefix."""
 
 
-def state_digest_sig(state) -> tuple[int, int]:
+def state_digest_sig(state) -> tuple[int, ...]:
     """Cheap integrity signature of a SimState: (rounds, xor of the
-    per-host event digests). Recorded at snapshot time and re-checked at
+    per-host event digests) — plus a third element, the dual-digest
+    fold, on integrity-sentinel states (compare signatures opaquely, not
+    by unpacking). Recorded at snapshot time and re-checked at
     restore time — a mismatch means device memory silently diverged
     between the copy and the replay (the known wrong-digest corruption
     mode), which replaying would only launder into believable results.
@@ -61,14 +63,25 @@ def state_digest_sig(state) -> tuple[int, int]:
     Replica-axis-aware: an ensemble state's `stats.rounds` is [R] (one
     counter per replica) and its digest plane [R, H]; the signature sums
     the rounds and folds the whole plane, so the same supervisor wraps
-    solo and campaign dispatches unchanged."""
+    solo and campaign dispatches unchanged.
+
+    Integrity-sentinel states (core/integrity.py) carry a SECOND,
+    independently-folded digest plane; the signature folds it too, so a
+    scribble confined to one digest plane between snapshot and restore
+    cannot slip past the cross-check."""
     import jax
 
     digest = int(np.bitwise_xor.reduce(
         np.asarray(jax.device_get(state.stats.digest)).reshape(-1)
     ))
     rounds = int(np.asarray(jax.device_get(state.stats.rounds)).sum())
-    return rounds, digest
+    d2 = getattr(state.stats, "digest2", None)
+    if d2 is None:
+        return rounds, digest
+    digest2 = int(np.bitwise_xor.reduce(
+        np.asarray(jax.device_get(d2)).reshape(-1)
+    ))
+    return rounds, digest, digest2
 
 
 class ChunkSupervisor:
@@ -193,14 +206,17 @@ class ChunkSupervisor:
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # XlaRuntimeError, aborts, anything
+                from shadow_tpu.core.integrity import IntegrityAbort
                 from shadow_tpu.core.pressure import PressureAbort
 
-                if isinstance(e, PressureAbort):
-                    # a pressure-policy stop is a deterministic DECISION,
-                    # not a transient dispatch failure: retrying would
+                if isinstance(e, (PressureAbort, IntegrityAbort)):
+                    # a pressure-policy stop or an integrity-sentinel
+                    # classification is a deterministic DECISION, not a
+                    # transient dispatch failure: retrying would
                     # reproduce it max_retries times and then launder it
-                    # into a SupervisorAbort — let the driver's pressure
-                    # handler see it instead
+                    # into a SupervisorAbort — let the driver's handler
+                    # see it instead (the sentinel already did its own
+                    # quarantine-and-replay before deciding)
                     raise
                 self.last_error = f"{type(e).__name__}: {e}"
                 if self.memory is not None:

@@ -50,6 +50,7 @@ import yaml
 from shadow_tpu.config.options import ConfigError, ConfigOptions
 from shadow_tpu.core import engine as eng
 from shadow_tpu.core.engine import Engine, EngineParams
+from shadow_tpu.core.integrity import IntegrityAbort
 from shadow_tpu.core.pressure import PressureAbort
 from shadow_tpu.core.supervisor import SupervisorAbort
 from shadow_tpu.host import CpuHost, HostConfig
@@ -243,6 +244,23 @@ class HybridSimulation:
             # pressure plane: abort policy traces the first-drop stop
             # into the guarded loop (escalate was rejected above)
             pressure_abort=cfg.pressure.active,
+            # integrity sentinel: device-plane guards ride along on the
+            # hybrid plane, with the first-violation stop in the guarded
+            # loop. The bridge cannot roll the CPU plane back, so there
+            # is no quarantine-and-replay classification here — a
+            # violation raises IntegrityAbort directly (treated
+            # deterministic; see _device_rounds). The strict
+            # window-monotonicity sub-check is relaxed: CPU-plane
+            # injections' conservative arrival bound can legally sit
+            # below the device's last guarded window_end (the
+            # EngineConfig.integrity_strict_time docstring derives
+            # this); the host-side _bridge_guard covers the bridge's
+            # own clock/staging invariants instead.
+            integrity=cfg.integrity.enabled,
+            integrity_dual=(
+                cfg.integrity.enabled and cfg.integrity.dual_digest
+            ),
+            integrity_strict_time=False,
         )
         self.mesh = None
         if world > 1:
@@ -520,6 +538,10 @@ class HybridSimulation:
         self._supervisor = None
         self._aborted = False
         self._pressure_aborted = False
+        # integrity sentinel: the committed joint time horizon the
+        # host-side bridge guards check against (str detail once aborted)
+        self._integrity_aborted: str | None = None
+        self._iv_horizon = 0
         if cfg.faults.supervisor.enabled:
             from shadow_tpu.core.supervisor import ChunkSupervisor
 
@@ -645,8 +667,21 @@ class HybridSimulation:
             if t_next >= stop:
                 break
             window_end = min(t_next + runahead, stop)
-            with self.perf.time("host_plane"):
-                self._execute_hosts(window_end)
+            try:
+                if self.engine_cfg.integrity:
+                    self._bridge_guard_clock(t_next)
+                with self.perf.time("host_plane"):
+                    self._execute_hosts(window_end)
+                if self.engine_cfg.integrity:
+                    # judged while the window's staged sends actually
+                    # EXIST (post host execution, pre injection) — at
+                    # the top of the loop the previous window's inject
+                    # loop has always drained the staging list
+                    self._bridge_guard_staging()
+            except IntegrityAbort as e:
+                print(f"[integrity] aborting run: {e}", file=log)
+                self._integrity_aborted = str(e)
+                break
             # inject ALL staged sends (multiple merges under staging-cap
             # overflow — BEFORE any device rounds run, so a tiny cap only
             # costs extra merge dispatches and cannot shift packet timing),
@@ -692,6 +727,21 @@ class HybridSimulation:
                         wall_t0=t_rounds, wall_t1=time.monotonic(),
                     )
                 self._pressure_aborted = True
+                break
+            except IntegrityAbort as e:
+                # an in-jit invariant tripped on the device plane. The
+                # CPU plane cannot roll back, so there is no replay
+                # classification — the run stops loudly, the report
+                # names the invariant/round/shard, and the artifacts
+                # carry `integrity_aborted` so the violating state's
+                # counters never read as a trustworthy record.
+                print(f"[integrity] aborting run: {e}", file=log)
+                if self._tracer is not None:
+                    self._tracer.drain(
+                        self.state.trace,
+                        wall_t0=t_rounds, wall_t1=time.monotonic(),
+                    )
+                self._integrity_aborted = str(e)
                 break
             if self._tracer is not None:
                 self._tracer.drain(
@@ -761,6 +811,43 @@ class HybridSimulation:
                 self._gc_bytes()
         return windows
 
+    def _bridge_guard_clock(self, t_next: int):
+        """Host-side bridge-clock invariant (the integrity sentinel's
+        hybrid half — the in-jit guards cover the device plane, these
+        cover the clock/staging state only Python can see): the
+        (CPU plane, device plane) joint next-event time never regresses
+        below the previously committed horizon — both planes completed
+        everything under it, and every new event (CPU injection,
+        conservative arrival bound) lands at or above it by the
+        lookahead argument, so a regression means a scribbled
+        queue/time value. Raises IntegrityAbort (no replay
+        classification on the bridge)."""
+        horizon = self._iv_horizon
+        if t_next < horizon:
+            raise IntegrityAbort(
+                f"integrity: bridge clock regressed — joint next-event "
+                f"time {t_next} fell below the committed horizon "
+                f"{horizon} (a scribbled queue/time plane, or an engine "
+                f"bug breaking conservative lookahead)"
+            )
+        self._iv_horizon = t_next
+
+    def _bridge_guard_staging(self):
+        """Staging-floor invariant, judged POST host execution while the
+        window's staged sends exist (the top-of-loop point always sees
+        an empty list — the previous window's inject loop drains it):
+        no staged send's event time sits below the committed horizon —
+        its originating host already executed past it."""
+        horizon = self._iv_horizon
+        below = [s for s in self._staged if s[1] < horizon]
+        if below:
+            raise IntegrityAbort(
+                f"integrity: bridge staging holds {len(below)} "
+                f"send(s) below the committed horizon {horizon} "
+                f"(earliest t={min(s[1] for s in below)}) — staged "
+                f"state corrupted"
+            )
+
     def _guarded_at(self, gear: int):
         """The guarded-chunk program for a merge gear (lazily jitted and
         cached, exactly like Engine.run_chunk_gear)."""
@@ -818,6 +905,19 @@ class HybridSimulation:
             self.state = run(self.state)
         else:
             self.state = self._supervisor.run_chunk(self.state, run)
+        if self.engine_cfg.integrity:
+            # integrity sentinel, checked BEFORE the pressure read: a
+            # violating attempt's other counters may themselves be
+            # scribbled. The guarded loop stopped at the first violating
+            # round; the bridge cannot replay-classify (the CPU plane
+            # advanced), so any violation is a loud stop.
+            from shadow_tpu.core.integrity import raise_if_violated
+
+            raise_if_violated(
+                self.state,
+                context="hybrid device plane (unclassifiable — the CPU "
+                "plane cannot roll back for a replay)",
+            )
         if self.cfg.pressure.active:
             # abort policy (the only active pressure policy the hybrid
             # driver admits): the guarded loop stopped at the first
@@ -1083,6 +1183,37 @@ class HybridSimulation:
             **(
                 {"pressure_aborted": True, "aborted": True}
                 if self._pressure_aborted else {}
+            ),
+            # integrity sentinel block (core/integrity.py): the hybrid
+            # plane has no replay classifier, so the block carries the
+            # dual digest fold plus — after an abort — the violation's
+            # naming; integrity_aborted keeps a violating state's
+            # counters from reading as a trustworthy record
+            **(
+                {
+                    "integrity": {
+                        "transients": 0,
+                        "replays": 0,
+                        "max_replays": self.cfg.integrity.max_replays,
+                        **(
+                            {"deterministic": {
+                                "detail": self._integrity_aborted,
+                            }}
+                            if self._integrity_aborted else {}
+                        ),
+                        **(
+                            {"determinism_digest2": (
+                                f"{int(np.bitwise_xor.reduce(jax.device_get(self.state.stats.digest2)[:n])):016x}"
+                            )}
+                            if self.engine_cfg.integrity_dual else {}
+                        ),
+                    },
+                }
+                if self.engine_cfg.integrity else {}
+            ),
+            **(
+                {"integrity_aborted": True, "aborted": True}
+                if self._integrity_aborted else {}
             ),
             **(
                 {"poisoned": True}

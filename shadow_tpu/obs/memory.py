@@ -79,6 +79,8 @@ def dims_of(
     pressure: bool = False,
     netobs: bool = False,
     flow_records: int = 0,
+    integrity: bool = False,
+    integrity_dual: bool = False,
     payload_words: int | None = None,
     trace_cols: int | None = None,
     flow_cols: int | None = None,
@@ -114,6 +116,8 @@ def dims_of(
         "FF": int(flow_cols),
         "pressure": 1 if pressure else 0,
         "netobs": 1 if netobs else 0,
+        "integrity": 1 if integrity else 0,
+        "integrity_dual": 1 if integrity_dual else 0,
     }
 
 
@@ -128,6 +132,8 @@ def dims_of_config(cfg) -> dict[str, int]:
         pressure=cfg.pressure_abort,
         netobs=cfg.netobs,
         flow_records=cfg.flow_records,
+        integrity=cfg.integrity,
+        integrity_dual=cfg.integrity_dual,
     )
 
 
@@ -153,6 +159,8 @@ def dims_of_state(cfg, state) -> dict[str, int]:
         flow_records=(
             int(state.flows.rows.shape[-2]) if state.flows is not None else 0
         ),
+        integrity=state.stats.integrity is not None,
+        integrity_dual=state.stats.digest2 is not None,
     )
 
 
@@ -167,6 +175,14 @@ def lane_plane_bytes(path: str, dims: dict[str, int]) -> int | None:
     if path.startswith("trace.") and dims["R"] == 0:
         return None
     if path == "stats.pressure" and not dims["pressure"]:
+        return None
+    # integrity-sentinel planes (core/integrity.py): the violation/
+    # signature lanes ride the sentinel knob, the dual digest its own
+    if path in ("stats.integrity", "stats.iv_mask", "stats.iv_round") and (
+        not dims.get("integrity")
+    ):
+        return None
+    if path == "stats.digest2" and not dims.get("integrity_dual"):
         return None
     # network-observatory planes: class/safe-window lanes ride with the
     # knob, flow lanes additionally require an active ledger ring
@@ -334,6 +350,8 @@ def state_bytes_at(cfg, capacity: int, send_budget: int) -> int:
         pressure=cfg.pressure_abort,
         netobs=cfg.netobs,
         flow_records=cfg.flow_records,
+        integrity=cfg.integrity,
+        integrity_dual=cfg.integrity_dual,
     )
     return sum(component_totals(registered_component_bytes(dims)).values())
 

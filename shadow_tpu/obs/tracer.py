@@ -155,6 +155,14 @@ class RoundTracer:
         # (a million-flow run must not grow a GB-scale trace JSON).
         self._flows: list[np.ndarray] = []
         self._flows_seen = 0
+        # integrity-sentinel violation notes (core/integrity.py): the
+        # deterministic-abort naming dicts the driver hands over. A SIDE
+        # channel, deliberately not a ring column — appending a column
+        # would widen every traced program's ring and churn the frozen
+        # default jaxpr fingerprints, and a violating chunk records at
+        # most ONE violating round per attempt anyway (the loop aborts
+        # there), so per-abort notes are complete.
+        self._violations: list[dict] = []
 
     # ---- collection --------------------------------------------------------
 
@@ -252,6 +260,13 @@ class RoundTracer:
         if room:
             self._flows.append(np.asarray(records[:room], np.int64))
         self._flows_seen += n
+
+    def note_violation(self, info: dict) -> None:
+        """Record a deterministic integrity violation (the controller's
+        iv_deterministic dict: signature [(shard, round, mask)...] +
+        detail text) for the exported trace — rendered as an instant
+        event on the sim-time timeline and surfaced in summary()."""
+        self._violations.append(dict(info))
 
     def reset_flows(self, records: np.ndarray) -> None:
         """Replace the flow track with exactly `records` — the abort
@@ -368,6 +383,30 @@ class RoundTracer:
                 "pid": 2, "tid": 1,
                 "args": {f"shard{s}": b for s, b in enumerate(shards)},
             })
+        # integrity-violation track: one instant event per recorded
+        # deterministic violation, anchored to the violating round's
+        # window when its row was traced (violating attempts are usually
+        # discarded pre-drain, so fall back to the last traced window)
+        if self._violations:
+            ev.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": world + 3, "args": {"name": "integrity"}})
+            last_ts = (
+                rows[0, -1, COL_WINDOW_END] / 1e3 if rows.shape[1] else 0.0
+            )
+            for v in self._violations:
+                ts = last_ts
+                for _shard, rnd, _mask in v.get("signature", []):
+                    hit = rows[0][rows[0][:, COL_ROUND] == rnd]
+                    if hit.shape[0]:
+                        ts = hit[0][COL_WINDOW_START] / 1e3
+                    break
+                ev.append({
+                    "name": "integrity violation", "cat": "integrity",
+                    "ph": "i", "s": "g", "ts": ts,
+                    "pid": 1, "tid": world + 3,
+                    "args": {k: v[k] for k in ("signature", "detail")
+                             if k in v},
+                })
         other = {
             "rounds_traced": self.rounds,
             "rounds_lost": self.lost,
@@ -452,6 +491,10 @@ class RoundTracer:
             "microsteps": t["microsteps"],
             "queue_occupancy_hwm": t["occ_hwm"],
             "ici_bytes": t["ici_bytes"],
+            **(
+                {"integrity_violations": [dict(v) for v in self._violations]}
+                if self._violations else {}
+            ),
         }
 
     def to_metrics_text(self, extra: dict | None = None) -> str:

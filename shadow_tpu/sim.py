@@ -355,6 +355,7 @@ def heartbeat_line(
     hbm: int | None = None,
     ek: tuple[int, int] | None = None,
     fct: int | None = None,
+    iv: tuple[int, int] | None = None,
     rep: tuple[int, int] | None = None,
 ) -> str:
     """The `[heartbeat]` progress line, shared by the Simulation run loop
@@ -368,13 +369,16 @@ def heartbeat_line(
     obs/memory.py, the reference's per-host allocated-memory heartbeat);
     `rep` is (replicas done, total) on ensemble campaign runs; `ek` is
     (timer events, packet events) and `fct` the flows completed so far —
-    both only on network-observatory runs (obs/netobs.py)."""
+    both only on network-observatory runs (obs/netobs.py); `iv` is
+    (transient SDC survived, sentinel replays) — only on
+    integrity-sentinel runs (core/integrity.py)."""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
     gear_f = f"gear={gear} " if gear is not None else ""
     cap_f = f"cap={cap} " if cap is not None else ""
     hbm_f = f"hbm={hbm} " if hbm is not None else ""
     ek_f = f"ek={ek[0]}/{ek[1]} " if ek is not None else ""
     fct_f = f"fct={fct} " if fct is not None else ""
+    iv_f = f"iv={iv[0]}/{iv[1]} " if iv is not None else ""
     rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
     return (
         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
@@ -389,6 +393,7 @@ def heartbeat_line(
         f"{hbm_f}"
         f"{ek_f}"
         f"{fct_f}"
+        f"{iv_f}"
         f"{rep_f}"
         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
         f"{resource_heartbeat()}"
@@ -463,6 +468,22 @@ class Simulation:
                     "snapshot-replay seam); disable pcap or keep "
                     "policy: drop"
                 )
+        # integrity sentinel (core/integrity.py): validated at build so
+        # unsupported combinations fail loudly, not mid-run
+        if cfg.integrity.enabled:
+            if ex.scheduler == "cpu-reference":
+                raise ConfigError(
+                    "integrity: the cpu-reference scheduler does not "
+                    "model the sentinel's in-jit guards; run the tpu "
+                    "scheduler or disable the integrity block"
+                )
+            if any(h.pcap_enabled for h in self.hosts):
+                raise ConfigError(
+                    "integrity: the sentinel is not supported with pcap "
+                    "capture (the single-round capture loop has no "
+                    "snapshot-replay seam for quarantine-and-replay); "
+                    "disable pcap or the integrity block"
+                )
         if press.policy == "escalate":
             if ex.merge_rows > 0:
                 raise ConfigError(
@@ -535,6 +556,11 @@ class Simulation:
             # condition into the chunk loop; drop (default) leaves the
             # program bit-identical to the pre-pressure engine
             pressure_abort=press.active,
+            # integrity sentinel: per-round invariant guards + the
+            # first-violation abort condition; OFF traces zero sentinel
+            # code (the default program stays byte-identical)
+            integrity=cfg.integrity.enabled,
+            integrity_dual=cfg.integrity.enabled and cfg.integrity.dual_digest,
         )
         # occupancy-adaptive merge gears (core/gears.py): resolved against
         # the (possibly auto-sized) send budget; [] = disabled
@@ -691,7 +717,10 @@ class Simulation:
         gearctl = None
         resilience = None
         pressure_on = cfg.pressure.active
-        if (self._gear_ladder or pressure_on) and capture is None:
+        integrity_on = cfg.integrity.enabled
+        if (self._gear_ladder or pressure_on or integrity_on) and (
+            capture is None
+        ):
             # the shared snapshot-replay seam (core/pressure.py): adaptive
             # merge gears dispatch at the width the controller picked from
             # last chunk's outbox-send high-water — a shed (exact, in-jit)
@@ -731,12 +760,20 @@ class Simulation:
             resilience = ResilienceController(
                 gearctl=gearctl,
                 pressure=cfg.pressure if pressure_on else None,
+                integrity=cfg.integrity if integrity_on else None,
                 queue_block=self.engine_cfg.queue_block,
                 reshard=reshard,
                 log=log,
                 memory=memguard,
             )
             self._pressctl = resilience if pressure_on else None
+            self._resil = resilience
+            # test-only SDC-injection seam (tests/test_integrity.py):
+            # a hook set on the Simulation before run() rides into the
+            # controller's post-snapshot/pre-dispatch slot
+            resilience.test_scribble = getattr(
+                self, "_integrity_test_scribble", None
+            )
         sup = None
         if cfg.faults.supervisor.enabled and capture is None:
             # crash-resilient supervisor (core/supervisor.py): periodic
@@ -797,13 +834,16 @@ class Simulation:
                 return st
             return self.engine.run_chunk(st, self.params)
 
-        def _pressure_abort(e, t_chunk):
-            # the pressure policy stopped the run: abort exports the
+        def _policy_abort(e, t_chunk, kind="pressure"):
+            # a policy stopped the run. Pressure-abort exports the
             # dropping state itself (the honest record — the drop is in
-            # the counters), escalate-cornered exports the last good
-            # pre-chunk snapshot. Either way the artifacts cover exactly
-            # what the exported state saw.
-            print(f"[pressure] aborting run: {e}", file=log)
+            # the counters); escalate-cornered and integrity-abort
+            # export the last good pre-chunk snapshot (an integrity
+            # violation's state is by definition corrupt — exporting it
+            # would be the poison this plane exists to catch; the
+            # report names the violated invariant instead). Either way
+            # the artifacts cover exactly what the exported state saw.
+            print(f"[{kind}] aborting run: {e}", file=log)
             good = resilience.abort_export_state()
             if good is not None:
                 self.state = good
@@ -826,8 +866,16 @@ class Simulation:
                 )
                 if tracer is not None:
                     tracer.reset_flows(flowcol.records())
-            self._pressure_aborted = True
+            if kind == "integrity":
+                self._integrity_aborted = True
+                if tracer is not None and resilience is not None and (
+                    resilience.iv_deterministic is not None
+                ):
+                    tracer.note_violation(resilience.iv_deterministic)
+            else:
+                self._pressure_aborted = True
 
+        from shadow_tpu.core.integrity import IntegrityAbort
         from shadow_tpu.core.pressure import PressureAbort
 
         try:
@@ -841,8 +889,11 @@ class Simulation:
 
                     try:
                         self.state = sup.run_chunk(self.state, _chunk_step)
+                    except IntegrityAbort as e:
+                        _policy_abort(e, t_chunk, kind="integrity")
+                        break
                     except PressureAbort as e:
-                        _pressure_abort(e, t_chunk)
+                        _policy_abort(e, t_chunk)
                         break
                     except SupervisorAbort as e:
                         # graceful abort: export the completed prefix from
@@ -879,8 +930,11 @@ class Simulation:
                 else:
                     try:
                         self.state = _chunk_step(self.state)
+                    except IntegrityAbort as e:
+                        _policy_abort(e, t_chunk, kind="integrity")
+                        break
                     except PressureAbort as e:
-                        _pressure_abort(e, t_chunk)
+                        _policy_abort(e, t_chunk)
                         break
                 if tracer is not None:
                     # pair the drained rounds with the true wall span of
@@ -947,11 +1001,17 @@ class Simulation:
                             fct = int(
                                 np.asarray(self.state.stats.fl_done).sum()
                             )
+                    # iv= rides along only on integrity-sentinel runs:
+                    # (transient SDC survived, sentinel replays) so far
+                    iv = (
+                        (resilience.iv_transients, resilience.iv_replays)
+                        if integrity_on and resilience is not None else None
+                    )
                     print(
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
                             fault=fault, gear=last_gear, cap=cap, hbm=hbm,
-                            ek=ek, fct=fct,
+                            ek=ek, fct=fct, iv=iv,
                         ),
                         file=log,
                     )
@@ -1114,6 +1174,31 @@ class Simulation:
             report["pressure_replays"] = rc.replays
             if getattr(self, "_pressure_aborted", False):
                 report["pressure_aborted"] = True
+                report["aborted"] = True
+        if self.engine_cfg.integrity:
+            # integrity sentinel block (core/integrity.py): the
+            # transient/replay accounting — the documented scribble
+            # waves as counted, survived events — plus the second
+            # digest fold (the dual lane that makes a scribble on the
+            # digest plane itself classifiable,
+            # core/integrity.classify_digest_pair) and, after an
+            # IntegrityAbort, the deterministic violation's naming.
+            rc = getattr(self, "_resil", None)
+            block: dict[str, Any] = (
+                rc.integrity_report() if rc is not None
+                else {
+                    "transients": 0,
+                    "replays": 0,
+                    "max_replays": self.cfg.integrity.max_replays,
+                }
+            )
+            if self.engine_cfg.integrity_dual:
+                block["determinism_digest2"] = (
+                    f"{int(np.bitwise_xor.reduce(np.asarray(s.digest2)[:n])):016x}"
+                )
+            report["integrity"] = block
+            if getattr(self, "_integrity_aborted", False):
+                report["integrity_aborted"] = True
                 report["aborted"] = True
         if self.engine_cfg.netobs:
             # network observatory block (obs/netobs.py): event classes,

@@ -33,6 +33,8 @@ def build_sim(
     trace_rounds: int = 0,
     netobs: bool = False,
     flow_records: int = 0,
+    integrity: bool = False,
+    integrity_dual: bool | None = None,
     merge_rows: int = 0,
     faults: dict | None = None,
     bootstrap_end: int = 0,
@@ -83,6 +85,12 @@ def build_sim(
         trace_rounds=trace_rounds,
         netobs=netobs,
         flow_records=flow_records,
+        # integrity sentinel: dual digest rides along by default when the
+        # guards are on (the drivers' IntegrityOptions.dual_digest default)
+        integrity=integrity,
+        integrity_dual=(
+            integrity if integrity_dual is None else integrity_dual
+        ),
         merge_rows=merge_rows,
         **fault_kw,
     )

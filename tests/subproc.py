@@ -29,8 +29,13 @@ import time
 
 import pytest
 
-# SIGABRT/SIGSEGV as seen through shell (128+N) and Python (-N) conventions
-HEAP_CORRUPTION_RCS = (134, 139, -6, -11)
+# the corruption-signature taxonomy lives in ONE place now
+# (tools/corruption.py; docs/corruption.md is the prose companion) —
+# the rc set stays re-exported here for existing importers
+from tools.corruption import (  # noqa: F401  (re-export)
+    HEAP_CORRUPTION_RCS,
+    classify as classify_corruption,
+)
 
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
@@ -153,22 +158,28 @@ def run_isolated(
             # plausibly a real deadlock regression — re-raise (visible
             # error) instead of skipping it away. Only a silent hang
             # matches the corruption's profile (these scripts print a
-            # single result line at the very end).
-            if (e.stdout or b"").strip():
+            # single result line at the very end); classify() applies
+            # exactly that output guard.
+            flavor = classify_corruption(
+                timed_out=True, output=e.stdout or b""
+            )
+            if flavor is None:
                 raise
             pytest.skip(
                 f"isolated subprocess timed out (attempt {attempt}, "
-                f"{timeout}s total budget) with no output (the hang "
-                f"flavor of the known jaxlib-0.4.37 corruption): "
+                f"{timeout}s total budget) with no output (the "
+                f"{flavor} flavor of the known jaxlib-0.4.37 "
+                f"corruption, tools/corruption.py): "
                 f"{(e.stderr or b'')[-200:]!r}"
             )
-        if proc.returncode in HEAP_CORRUPTION_RCS and not proc.stdout.strip():
+        flavor = classify_corruption(proc.returncode, output=proc.stdout)
+        if flavor is not None:
             if attempt <= retries:
                 continue  # one-off abort: retry before skipping
             pytest.skip(
                 "known jaxlib-0.4.37 heap corruption in compiled Simulation "
-                f"runs on this box, {attempts}/{attempts} attempts died "
-                "(malloc_consolidate SIGABRT/SIGSEGV, CHANGES.md env "
+                f"runs on this box ({flavor} flavor, tools/corruption.py), "
+                f"{attempts}/{attempts} attempts died (CHANGES.md env "
                 f"notes): {proc.stderr[-200:]}"
             )
         return proc

@@ -1,0 +1,966 @@
+"""Integrity sentinel (`integrity:` config block, core/integrity.py).
+
+Gates, mirroring the ISSUE acceptance:
+  - sentinel OFF/ON digest-exactness: digests, per-host event counts,
+    and every drop counter bit-identical across echo/phold/tgen x
+    flat/bucketed x K{1,4}; world=8 legs run subprocess-isolated
+    (tests/subproc.py — this box's documented corruption posture);
+  - per-invariant white-box trips: each of the six guards fires on its
+    crafted violation (host-side state mutation between chunks) and
+    stays quiet on clean runs; the chunk while_loop stops at the
+    violating round;
+  - deterministic-vs-transient classification: an injected REPRODUCING
+    scribble raises IntegrityAbort naming invariant+round+shard with
+    last-good artifacts exported; a ONE-SHOT scribble is survived,
+    counted in sim-stats integrity{}, and the completed run's digest
+    equals an uninjected run's (driver-level, subprocess-isolated);
+  - dual digest: a digest-plane flip the primary fold misses is
+    classified by core/integrity.classify_digest_pair;
+  - heartbeat iv= round-trips through parse_shadow --strict;
+  - the corruption-signature taxonomy (tools/corruption.py) classifies
+    each documented flavor;
+  - examples/integrity.yaml parses; invalid combinations are loud.
+
+Engine-harness legs run in-process (the stable path on this box);
+compiled-Simulation legs go through tests/subproc.py. The white-box
+trips assert their expected invariant BIT is set rather than the exact
+mask — a live corruption wave can legitimately set extra bits, which is
+the sentinel doing its job, not a test failure."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import Engine
+from shadow_tpu.core import integrity as ivmod
+from shadow_tpu.core.integrity import (
+    IV_COUNTER,
+    IV_DIGEST,
+    IV_EC,
+    IV_OUTBOX,
+    IV_QFILL,
+    IV_TIME,
+    classify_digest_pair,
+    describe_signature,
+    mask_names,
+    violation_signature,
+    violation_total,
+)
+from shadow_tpu.config.options import ConfigError, ConfigOptions
+from tests.engine_harness import build_sim, mk_hosts
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run_to_done(model, hosts, stop, *, k=1, qb=0, integrity=False, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, queue_block=qb, microstep_events=k,
+        integrity=integrity, **kw
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return state
+
+
+# short-horizon variants of the established workload trio (the netobs
+# matrix shapes): enough rounds to exercise every counter the guards read
+_CASES = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 5)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(5, {"flow_segs": 8, "flows": 2, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             2_000_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+
+def _matrix_params():
+    """World-1 acceptance matrix, tier-1-budgeted like the netobs one:
+    the mixed-axis combos add no code path the aligned pairs miss (the
+    guards touch layout/K only through values the round already
+    computes), so they carry the `slow` mark — the full cross product
+    runs under `pytest -m ''`."""
+    out = []
+    for case in sorted(_CASES):
+        for k in (1, 4):
+            for qb in (0, 8):
+                aligned = (k == 1) == (qb == 0)
+                marks = () if aligned else (pytest.mark.slow,)
+                out.append(pytest.param(
+                    case, k, qb,
+                    id=f"{case}-{'flat' if qb == 0 else 'bucketed'}-k{k}",
+                    marks=marks,
+                ))
+    return out
+
+
+@pytest.mark.parametrize("case,k,qb", _matrix_params())
+def test_sentinel_is_bit_identical(case, k, qb):
+    """Sentinel ON vs OFF: digests, events, and every drop counter
+    bit-identical — the guards only read — and a clean run trips
+    nothing (zero violations, virgin signature lanes)."""
+    model, hosts, stop, kw = _CASES[case]
+    s_off = _run_to_done(model, hosts, stop, k=k, qb=qb, **kw)
+    s_on = _run_to_done(model, hosts, stop, k=k, qb=qb, integrity=True, **kw)
+    off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+
+    np.testing.assert_array_equal(np.asarray(off.digest), np.asarray(on.digest))
+    np.testing.assert_array_equal(np.asarray(off.events), np.asarray(on.events))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(s_off.queue.dropped)),
+        np.asarray(jax.device_get(s_on.queue.dropped)),
+    )
+    for field in ("pkts_sent", "pkts_lost", "pkts_codel_dropped",
+                  "pkts_budget_dropped", "pkts_delivered", "q_occ_hwm"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(off, field)), np.asarray(getattr(on, field)),
+            err_msg=field,
+        )
+    # the ungated program carries NO sentinel lanes; the gated clean run
+    # carries virgin ones
+    assert off.integrity is None and off.digest2 is None
+    assert int(np.asarray(on.integrity).max()) == 0
+    assert int(np.asarray(on.iv_mask).max()) == 0
+    assert int(np.asarray(on.iv_round).max()) == -1
+    # the dual lane is a REAL second fold, not a copy
+    assert (np.asarray(on.digest2) != np.asarray(on.digest)).any()
+
+
+# ---------------------------------------------------------------------------
+# world=8 subprocess legs (one layout/K point per axis, netobs posture)
+# ---------------------------------------------------------------------------
+
+_W8_SCRIPT = """
+import json, sys
+import numpy as np
+import jax
+from shadow_tpu.core import Engine
+from tests.engine_harness import build_sim, mk_hosts
+
+qb, k = int(sys.argv[1]), int(sys.argv[2])
+
+def run(integrity):
+    cfg, m, params, mstate, events = build_sim(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+        300_000_000, world=8, queue_block=qb, microstep_events=k,
+        integrity=integrity, loss=0.1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    eng = Engine(cfg, m, mesh)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return state
+
+s_off = run(False)
+s_on = run(True)
+off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+print(json.dumps({
+    "digest_equal": bool(
+        (np.asarray(off.digest) == np.asarray(on.digest)).all()),
+    "events_equal": bool(
+        (np.asarray(off.events) == np.asarray(on.events)).all()),
+    "violations": int(np.asarray(on.integrity).max()),
+    "iv_mask": int(np.asarray(on.iv_mask).max()),
+}))
+"""
+
+
+@pytest.mark.parametrize("qb,k", [
+    pytest.param(0, 1, id="flat-k1"),
+    pytest.param(8, 4, id="bucketed-k4", marks=pytest.mark.slow),
+])
+def test_sentinel_world8_bit_identical(qb, k):
+    from tests.subproc import run_isolated_json
+
+    out = run_isolated_json(_W8_SCRIPT, qb, k, timeout=420)
+    assert out["digest_equal"] and out["events_equal"], out
+    assert out["violations"] == 0 and out["iv_mask"] == 0, out
+
+
+# ---------------------------------------------------------------------------
+# per-invariant white-box trips + controller classification. BOTH run in
+# ONE subprocess each (tests/subproc.py): a scribble-then-redispatch
+# sequence under the 8-virtual-device conftest is exactly this box's
+# documented corruption magnet — the child prints its verdicts as JSON,
+# so a teardown-flavor abort after the result line still yields the
+# verdicts, and a mid-run corruption death retries then skips loudly.
+# ---------------------------------------------------------------------------
+
+_TRIP_SCRIPT = """
+import dataclasses, json
+import jax, jax.numpy as jnp
+import numpy as np
+from shadow_tpu.core import Engine
+from shadow_tpu.core import integrity as ivmod
+from tests.engine_harness import build_sim, mk_hosts
+
+
+def phold_engine(qb=0, netobs=False):
+    cfg, m, params, mstate, events = build_sim(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+        2_000_000_000, loss=0.1, queue_block=qb, netobs=netobs,
+        integrity=True,
+    )
+    cfg = dataclasses.replace(cfg, rounds_per_chunk=8)
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    state = eng.run_chunk(state, params)  # one clean chunk first
+    assert not bool(state.done)
+    assert int(np.asarray(state.stats.integrity).max()) == 0
+    return eng, state, params
+
+
+def echo_engine_with_idle_host():
+    # server + active client + a client that never starts: a host with
+    # zero executed events whose digest lanes stay virgin (IV_DIGEST)
+    hosts = [
+        dict(host_id=0, name="server", start_time=0,
+             model_args={"role": "server"}),
+        dict(host_id=1, name="c1", start_time=0,
+             model_args={"role": "client", "peer": "server",
+                         "interval": "4 ms"}),
+        dict(host_id=2, name="idle", start_time=99_000_000_000,
+             model_args={"role": "client", "peer": "server",
+                         "interval": "4 ms"}),
+    ]
+    cfg, m, params, mstate, events = build_sim(
+        "udp_echo", hosts, 4_000_000_000, integrity=True,
+    )
+    cfg = dataclasses.replace(cfg, rounds_per_chunk=8)
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    state = eng.run_chunk(state, params)
+    assert not bool(state.done)
+    assert int(np.asarray(jax.device_get(state.stats.events))[2]) == 0
+    return eng, state, params
+
+
+def trip(builder, scribble):
+    eng, state, params = builder()
+    rounds0 = int(state.stats.rounds)
+    state = scribble(state)
+    state = eng.run_chunk(state, params)
+    return {
+        "total": int(np.asarray(state.stats.integrity).max()),
+        "mask": int(np.asarray(state.stats.iv_mask).max()),
+        "round": int(np.asarray(state.stats.iv_round).max()),
+        "rounds0": rounds0,
+        "rounds_after": int(state.stats.rounds),
+    }
+
+
+def s_time(st):
+    t = np.asarray(jax.device_get(st.queue.t)).copy()
+    t[0, 0] = 0  # a past-time event: the window collapses below `now`
+    return st._replace(queue=st.queue._replace(t=jnp.asarray(t)))
+
+
+def s_counter(st):
+    ev = np.asarray(jax.device_get(st.stats.events)).copy()
+    ev[3] = -7  # negative counter: impossible by construction
+    return st._replace(stats=st.stats._replace(events=jnp.asarray(ev)))
+
+
+def s_outbox(st):
+    sr = np.asarray(jax.device_get(st.sent_round)).copy()
+    sr[0] = 99  # cursor far past the send budget
+    return st._replace(sent_round=jnp.asarray(sr, jnp.int32))
+
+
+def s_fill(st):
+    bf = np.asarray(jax.device_get(st.queue.bfill)).copy()
+    bf[0, 0] += 3  # cache no longer matches the slab's occupancy
+    return st._replace(
+        queue=st.queue._replace(bfill=jnp.asarray(bf, jnp.int32)))
+
+
+def s_ec(st):
+    ec = np.asarray(jax.device_get(st.stats.ec_timer)).copy()
+    ec[0] += 5  # class sums no longer reconcile with the event counter
+    return st._replace(stats=st.stats._replace(ec_timer=jnp.asarray(ec)))
+
+
+def s_digest(st):
+    dg = np.asarray(jax.device_get(st.stats.digest)).copy()
+    dg[2] ^= 1  # the idle host's digest plane scribbled
+    return st._replace(stats=st.stats._replace(digest=jnp.asarray(dg)))
+
+
+def s_digest2(st):
+    d2 = np.asarray(jax.device_get(st.stats.digest2)).copy()
+    d2[2] ^= 1  # the flip the PRIMARY fold misses: dual lane only
+    return st._replace(stats=st.stats._replace(digest2=jnp.asarray(d2)))
+
+
+CASES = {
+    "time_monotonic": (phold_engine, s_time),
+    "counter_monotonic": (phold_engine, s_counter),
+    "outbox_budget": (phold_engine, s_outbox),
+    "queue_fill_cache": (lambda: phold_engine(qb=8), s_fill),
+    "event_class_reconcile": (lambda: phold_engine(netobs=True), s_ec),
+    "dual_digest_virgin": (echo_engine_with_idle_host, s_digest),
+    "dual_digest_flip2": (echo_engine_with_idle_host, s_digest2),
+}
+import sys
+builder, scribbler = CASES[sys.argv[1]]
+print(json.dumps(trip(builder, scribbler)))
+"""
+
+_TRIP_BITS = {
+    "time_monotonic": IV_TIME,
+    "counter_monotonic": IV_COUNTER,
+    "outbox_budget": IV_OUTBOX,
+    "queue_fill_cache": IV_QFILL,
+    "event_class_reconcile": IV_EC,
+    "dual_digest_virgin": IV_DIGEST,
+    "dual_digest_flip2": IV_DIGEST,
+}
+
+_trip_results: dict = {}
+
+
+def _trip_verdict(name, ok_fn):
+    """One child per trip (fresh-process exposure — multi-build
+    sequences in one process are the documented corruption magnet),
+    with the same deviation-classification posture as the drill: a
+    deviating verdict retries once in a fresh child; identical
+    deviations are a real bug, varying ones are the wave (skip)."""
+    from tests.subproc import run_isolated_json
+
+    cached = _trip_results.get(name)
+    if cached is not None:
+        return cached
+    v1 = run_isolated_json(_TRIP_SCRIPT, name, timeout=240)
+    if ok_fn(v1):
+        _trip_results[name] = v1
+        return v1
+    v2 = run_isolated_json(_TRIP_SCRIPT, name, timeout=240)
+    if ok_fn(v2):
+        _trip_results[name] = v2
+        return v2
+    assert v1 != v2, (
+        f"trip '{name}' deviated IDENTICALLY across two fresh child "
+        f"processes — a deterministic guard bug, not the documented "
+        f"scribble: {v1}"
+    )
+    pytest.skip(
+        f"trip '{name}' children returned varying deviations — the "
+        f"documented corruption wave, not a guard verdict: {v1} vs {v2}"
+    )
+
+
+@pytest.mark.parametrize("name", sorted(_TRIP_BITS))
+def test_guard_trips_on_crafted_violation(name):
+    """Each invariant guard fires on its crafted violation (host-side
+    scribble between chunks) and the chunk while_loop stops AT the
+    violating round. Asserts the EXPECTED bit is set rather than the
+    exact mask: a live corruption wave can legitimately set extra bits,
+    which is the sentinel working, not a failure."""
+    bit = _TRIP_BITS[name]
+
+    def ok(v):
+        return (
+            v["total"] > 0
+            and bool(v["mask"] & (1 << bit))
+            and v["round"] >= v["rounds0"]
+            # the violating round completes (and counts), then the loop
+            # exits — far short of the 8-round chunk bound
+            and v["rounds_after"] == v["round"] + 1
+        )
+
+    v = _trip_verdict(name, ok)
+    assert v["mask"] & (1 << bit), (
+        f"expected bit {bit} ({ivmod.IV_NAMES[bit]}) in mask "
+        f"{v['mask']:#x}: {v}"
+    )
+
+
+_CLASSIFY_SCRIPT = """
+import dataclasses, json
+import jax, jax.numpy as jnp
+import numpy as np
+from shadow_tpu.core import Engine
+from shadow_tpu.core.integrity import IntegrityAbort
+from shadow_tpu.core.pressure import ResilienceController
+from shadow_tpu.config.options import IntegrityOptions
+from tests.engine_harness import build_sim, mk_hosts
+
+
+def scribble(st):
+    t = np.asarray(jax.device_get(st.queue.t)).copy()
+    t[0, 0] = 0
+    return st._replace(queue=st.queue._replace(t=jnp.asarray(t)))
+
+
+def run(hook, max_replays=3):
+    # ~40 rounds at the harness's 50 ms latency (runahead-bound): the
+    # injection lands at rounds >= 16, leaving a couple of chunks to
+    # prove survival — kept short, since every extra chunk in one
+    # process is corruption exposure on this box (docs/corruption.md)
+    cfg, m, params, mstate, events = build_sim(
+        "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+        2_000_000_000, loss=0.1, integrity=True,
+    )
+    cfg = dataclasses.replace(cfg, rounds_per_chunk=8)
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    rc = ResilienceController(
+        integrity=IntegrityOptions(enabled=True, max_replays=max_replays))
+    rc.test_scribble = hook
+    err = None
+    try:
+        chunks = 0
+        while not bool(state.done):
+            state, _, _ = rc.run_chunk(
+                state, lambda s, g, c, b: eng.run_chunk(s, params))
+            chunks += 1
+            assert chunks < 500
+    except IntegrityAbort as e:
+        err = str(e)
+    d1 = d2 = None
+    if err is None:
+        d1 = int(np.bitwise_xor.reduce(
+            np.asarray(jax.device_get(state.stats.digest))))
+        d2 = int(np.bitwise_xor.reduce(
+            np.asarray(jax.device_get(state.stats.digest2))))
+    return {"transients": rc.iv_transients, "replays": rc.iv_replays,
+            "deterministic": rc.iv_deterministic, "error": err,
+            "digest": d1, "digest2": d2}
+
+
+fired = []
+def once(st, attempt):
+    if attempt == 0 and int(st.stats.rounds) >= 16 and not fired:
+        fired.append(1)
+        return scribble(st)
+    return st
+
+
+def always(st, attempt):
+    if int(st.stats.rounds) >= 16:
+        return scribble(st)
+    return st
+
+
+def s_cnt(st):
+    ev = np.asarray(jax.device_get(st.stats.events)).copy()
+    ev[3] = -7
+    return st._replace(stats=st.stats._replace(events=jnp.asarray(ev)))
+
+
+def s_ob(st):
+    sr = np.asarray(jax.device_get(st.sent_round)).copy()
+    sr[0] = 99
+    return st._replace(sent_round=jnp.asarray(sr, jnp.int32))
+
+
+count = [0]
+def varying(st, attempt):
+    # a DIFFERENT invariant each attempt -> a different bitmask in the
+    # (shard, round, mask) signature -> never reproduces
+    if int(st.stats.rounds) >= 8:
+        f = (scribble, s_cnt, s_ob)[count[0] % 3]
+        count[0] += 1
+        return f(st)
+    return st
+
+
+import sys
+mode = sys.argv[1]
+if mode == "clean":
+    print(json.dumps(run(None)))
+elif mode == "once":
+    print(json.dumps(run(once)))
+elif mode == "repro":
+    print(json.dumps(run(always)))
+else:
+    print(json.dumps(run(varying, max_replays=2)))
+"""
+
+_classify_results: dict = {}
+
+
+def _classify_verdict(mode, ok_fn):
+    """One child per mode, with the repo's deviation-classification
+    posture (tests/subproc.py, tools/soak.py, docs/corruption.md): the
+    injection lands at a KNOWN (round, mask), so any other verdict is
+    either this box's documented corruption striking the child (varies
+    across fresh processes -> skip) or a real sentinel bug (the SAME
+    deviation reproducing across fresh children -> fail)."""
+    from tests.subproc import run_isolated_json
+
+    cached = _classify_results.get(mode)
+    if cached is not None:
+        return cached
+    v1 = run_isolated_json(_CLASSIFY_SCRIPT, mode, timeout=300)
+    if ok_fn(v1):
+        _classify_results[mode] = v1
+        return v1
+    v2 = run_isolated_json(_CLASSIFY_SCRIPT, mode, timeout=300)
+    if ok_fn(v2):
+        _classify_results[mode] = v2
+        return v2
+    assert v1 != v2, (
+        f"'{mode}' deviated IDENTICALLY across two fresh child "
+        f"processes — a deterministic sentinel bug, not the documented "
+        f"scribble: {v1}"
+    )
+    pytest.skip(
+        f"'{mode}' classification children returned varying deviations "
+        f"— the documented corruption wave, not a sentinel verdict: "
+        f"{v1} vs {v2}"
+    )
+
+
+def _clean_ok(v):
+    return (
+        not v["error"] and v["transients"] == 0 and v["replays"] == 0
+        and v["digest"] is not None
+    )
+
+
+def _clean_verdict():
+    return _classify_verdict("clean", _clean_ok)
+
+
+def test_one_shot_scribble_is_transient_and_survived():
+    clean = _clean_verdict()
+
+    def ok(v):
+        return (
+            v["error"] is None and v["transients"] == 1
+            and v["replays"] == 1 and v["deterministic"] is None
+            and v["digest"] == clean["digest"]
+        )
+
+    once = _classify_verdict("once", ok)
+    # the survived run's digests equal the uninjected run's (BOTH lanes)
+    assert once["digest"] == clean["digest"]
+    assert once["digest2"] == clean["digest2"]
+
+
+def test_reproducing_scribble_raises_integrity_abort():
+    def ok(v):
+        msg = v["error"] or ""
+        # names the invariant, the INJECTED round, and the shard
+        return ("REPRODUCED" in msg and "time_monotonic" in msg
+                and "shard 0" in msg and "round 16" in msg
+                and v["deterministic"] is not None)
+
+    v = _classify_verdict("repro", ok)
+    assert "round 16" in v["error"]
+
+
+def test_nonreproducing_violations_are_bounded_by_max_replays():
+    """A scribble landing at a DIFFERENT invariant every attempt never
+    reproduces — the sentinel must still stop after max_replays instead
+    of replaying forever."""
+    v = _classify_verdict(
+        "varying",
+        lambda v: bool(v["error"]) and "without reproducing" in v["error"],
+    )
+    assert "without reproducing" in v["error"]
+
+
+_CASES_DRILL = (
+    "phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+    2_000_000_000,
+)
+
+
+def _run_to_done_drill():
+    return _run_to_done(*_CASES_DRILL, loss=0.1, integrity=True)
+
+
+# ---------------------------------------------------------------------------
+# driver-level classification drill (subprocess-isolated: the sequence
+# of compiled Simulations is this box's documented corruption magnet)
+# ---------------------------------------------------------------------------
+
+_DRIVER_DRILL = """
+import json, sys
+import jax.numpy as jnp
+import numpy as np
+import jax
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+mode, data_dir = sys.argv[1], sys.argv[2]
+base = {
+  'general': {'stop_time': '2 s', 'seed': 1, 'heartbeat_interval': None,
+              'data_directory': data_dir},
+  'experimental': {'event_queue_capacity': 32, 'rounds_per_chunk': 8},
+  'integrity': {'enabled': True},
+  'hosts': {'node': {'count': 8, 'network_node_id': 0,
+    'processes': [{'model': 'phold',
+                   'model_args': {'population': 2, 'mean_delay': '100 ms',
+                                  'size_bytes': 64}}]}},
+}
+
+def scribble(st):
+    t = np.asarray(jax.device_get(st.queue.t)).copy(); t[0, 0] = 0
+    return st._replace(queue=st.queue._replace(t=jnp.asarray(t)))
+
+sim = Simulation(ConfigOptions.from_dict(base), world=1)
+fired = []
+def hook(st, attempt):
+    rounds = int(st.stats.rounds)
+    if mode == 'once':
+        if attempt == 0 and rounds >= 16 and not fired:
+            fired.append(1)
+            return scribble(st)
+    elif mode == 'repro':
+        if rounds >= 16:
+            return scribble(st)
+    return st
+if mode != 'clean':
+    sim._integrity_test_scribble = hook
+rep = sim.run()
+sim.write_outputs(report=rep)
+iv = rep.get('integrity') or {}
+det = iv.get('deterministic') or {}
+print(json.dumps({
+    'digest': rep['determinism_digest'],
+    'digest2': iv.get('determinism_digest2'),
+    'transients': iv.get('transients'),
+    'replays': iv.get('replays'),
+    'aborted': bool(rep.get('integrity_aborted')),
+    'detail': det.get('detail'),
+    'rounds': rep['rounds'],
+}))
+"""
+
+
+def _drill(mode, tmp_path, tag):
+    from tests.subproc import run_isolated_json
+
+    return run_isolated_json(
+        _DRIVER_DRILL, mode, str(tmp_path / tag), timeout=300
+    )
+
+
+def test_driver_drill_end_to_end(tmp_path):
+    """The acceptance drill: one-shot scribble survived + counted with a
+    clean-equal digest; reproducing scribble -> IntegrityAbort naming
+    invariant/round/shard with last-good artifacts exported.
+
+    The injection lands at a KNOWN round (16); a violation reported at
+    any other round is this box's documented corruption striking the
+    worker itself — classified and retried, never judged (the
+    classify-then-retry posture, docs/corruption.md)."""
+    attempts = 0
+    while True:
+        attempts += 1
+        clean = _drill("clean", tmp_path, f"clean{attempts}")
+        once = _drill("once", tmp_path, f"once{attempts}")
+        repro = _drill("repro", tmp_path, f"repro{attempts}")
+        env_hit = (
+            clean["aborted"] or clean["transients"]
+            or once["aborted"]
+            or (repro["detail"] or "").find("round 16") < 0
+        )
+        if not env_hit:
+            break
+        if attempts >= 3:
+            pytest.skip(
+                f"driver drill hit the documented corruption wave in "
+                f"{attempts}/{attempts} attempts (results: {clean}, "
+                f"{once}, {repro}) — environment, not a sentinel verdict"
+            )
+    # one-shot: survived, counted, digest equal to the clean run's on
+    # BOTH digest planes
+    assert once["transients"] == 1 and once["replays"] == 1
+    assert once["digest"] == clean["digest"]
+    assert once["digest2"] == clean["digest2"]
+    # reproducing: loud deterministic abort naming invariant+round+shard
+    assert repro["aborted"]
+    assert "time_monotonic" in repro["detail"]
+    assert "shard 0" in repro["detail"] and "round 16" in repro["detail"]
+    # last-good artifacts: the export rewound to the pre-chunk snapshot
+    # (rounds 16, not the violating attempt), flagged integrity_aborted
+    assert repro["rounds"] == 16
+    stats = json.load(
+        open(os.path.join(str(tmp_path / f"repro{attempts}"),
+                          "sim-stats.json"))
+    )
+    assert stats["integrity_aborted"] and stats["aborted"]
+    assert "deterministic" in stats["integrity"]
+
+
+# ---------------------------------------------------------------------------
+# dual-digest pair classification + helpers (pure host side)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_digest_pair():
+    assert classify_digest_pair(1, 2, 1, 2) == "clean"
+    # primary flipped, dual agrees: the digest plane itself was
+    # scribbled — the flavor a single digest cannot see
+    assert classify_digest_pair(1 ^ 8, 2, 1, 2) == "digest-plane"
+    assert classify_digest_pair(1, 2 ^ 8, 1, 2) == "divergent"
+    assert classify_digest_pair(5, 2 ^ 8, 1, 2) == "divergent"
+    # without dual folds only clean/divergent are distinguishable
+    assert classify_digest_pair(1, None, 1, None) == "clean"
+    assert classify_digest_pair(1, None, 2, None) == "divergent"
+
+
+def test_signature_helpers():
+    assert mask_names(1 << IV_TIME) == ["time_monotonic"]
+    assert mask_names((1 << IV_EC) | (1 << IV_OUTBOX)) == [
+        "event_class_reconcile", "outbox_budget",
+    ]
+    sig = ((0, 12, 1 << IV_COUNTER),)
+    text = describe_signature(sig)
+    assert "shard 0" in text and "round 12" in text
+    assert "counter_monotonic" in text
+    assert describe_signature(()) == "no violating shard recorded"
+
+
+def test_violation_readers_on_clean_state():
+    s = _run_to_done_drill()
+    assert violation_total(s) == 0
+    assert violation_signature(s) == ()
+
+
+# ---------------------------------------------------------------------------
+# corruption-signature taxonomy (tools/corruption.py — satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_corruption_taxonomy_classify():
+    from tools import corruption as C
+
+    assert C.classify(134) == C.MALLOC_ABORT
+    assert C.classify(-6) == C.MALLOC_ABORT
+    assert C.classify(139) == C.SIGSEGV
+    assert C.classify(-11) == C.SIGSEGV
+    assert C.classify(timed_out=True) == C.TIMEOUT_HANG
+    assert C.classify(1) is None and C.classify(0) is None
+    # a worker that produced a verdict is never classified away
+    assert C.classify(134, output="ok\n") is None
+    assert C.classify(134, output=b"result") is None
+    assert C.classify(134, output="   \n") == C.MALLOC_ABORT
+    assert C.classify(timed_out=True, output="partial") is None
+    assert C.is_corruption_rc(134) and C.is_corruption_rc(-11)
+    assert not C.is_corruption_rc(0)
+    # the flow-counter-scribble bounds gate
+    assert C.counters_scribbled([0, 2, 93824992233120], 0, 2)
+    assert C.counters_scribbled([-1, 0], 0, 2)
+    assert not C.counters_scribbled([0, 1, 2], 0, 2)
+    # the canonical rc set is single-sourced: the re-export in
+    # tests/subproc.py IS this set
+    from tests.subproc import HEAP_CORRUPTION_RCS
+
+    assert HEAP_CORRUPTION_RCS is C.HEAP_CORRUPTION_RCS
+
+
+# ---------------------------------------------------------------------------
+# heartbeat / lanes / config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_iv_round_trips_strict(tmp_path):
+    from shadow_tpu.sim import heartbeat_line
+    from tools.parse_shadow import parse_heartbeats
+
+    lines = [
+        heartbeat_line(2_000_000_000, 3.0, 99, 198, 40, 4096, 7, iv=(1, 2)),
+        heartbeat_line(2_000_000_000, 3.0, 99, 198, 40, 4096, 7,
+                       ek=(31, 52), fct=12, iv=(0, 0), rep=(3, 6)),
+        # older formats must still parse byte-identically
+        heartbeat_line(2_000_000_000, 3.0, 99, 198, 40, 4096, 7),
+    ]
+    path = tmp_path / "hb.log"
+    path.write_text("\n".join(lines) + "\n")
+    hbs = parse_heartbeats(str(path), strict=True)
+    assert len(hbs) == 3
+    assert hbs[0]["iv_transient"] == 1 and hbs[0]["iv_replays"] == 2
+    assert hbs[1]["iv_transient"] == 0 and hbs[1]["rep_done"] == 3
+    assert "iv_transient" not in hbs[2]
+
+
+def test_iv_lanes_registered_and_priced():
+    """The new lanes are in the single-source registry with shapes the
+    HBM model can price: formula bytes == live carry leaf bytes."""
+    from shadow_tpu.core import lanes
+    from shadow_tpu.obs import memory as M
+
+    for path in ("stats.integrity", "stats.iv_mask", "stats.iv_round",
+                 "stats.digest2"):
+        assert path in lanes.STATE_LANES
+        assert path in lanes.STATE_LANE_SHAPES
+    for f in ("integrity", "iv_mask", "iv_round"):
+        assert f in lanes.STATS_EXPORT_EXEMPT
+
+    cfg, m, params, mstate, events = build_sim(
+        "phold", mk_hosts(4, {"mean_delay": "50 ms", "population": 2}),
+        200_000_000, integrity=True,
+    )
+    eng = Engine(cfg, m, None)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    dims = M.dims_of_config(eng.cfg)
+    priced = {
+        p: b for comp in M.registered_component_bytes(dims).values()
+        for p, b in comp.items()
+    }
+    for path in ("stats.integrity", "stats.iv_mask", "stats.iv_round",
+                 "stats.digest2"):
+        obj = state
+        for part in path.split("."):
+            obj = getattr(obj, part)
+        assert priced[path] == M.leaf_nbytes(obj), path
+
+
+def test_example_config_parses_and_validations_are_loud():
+    from shadow_tpu.config.options import load_config
+
+    cfg = load_config(os.path.join(_REPO, "examples", "integrity.yaml"))
+    assert cfg.integrity.enabled and cfg.integrity.dual_digest
+    assert cfg.integrity.max_replays == 3
+
+    with pytest.raises(ConfigError, match="max_replays"):
+        ConfigOptions.from_dict({
+            "general": {"stop_time": "1 s"},
+            "integrity": {"enabled": True, "max_replays": 0},
+            "hosts": {"a": {"network_node_id": 0, "processes": [
+                {"model": "phold", "model_args": {}}]}},
+        })
+    with pytest.raises(ConfigError, match="unknown integrity"):
+        ConfigOptions.from_dict({
+            "general": {"stop_time": "1 s"},
+            "integrity": {"enable": True},
+            "hosts": {"a": {"network_node_id": 0, "processes": [
+                {"model": "phold", "model_args": {}}]}},
+        })
+
+    base = {
+        "general": {"stop_time": "1 s"},
+        "integrity": {"enabled": True},
+        "hosts": {"a": {"network_node_id": 0, "processes": [
+            {"model": "phold", "model_args": {}}]}},
+    }
+    from shadow_tpu.sim import Simulation
+
+    bad = json.loads(json.dumps(base))
+    bad["experimental"] = {"scheduler": "cpu-reference"}
+    with pytest.raises(ConfigError, match="integrity.*cpu-reference"):
+        Simulation(ConfigOptions.from_dict(bad), world=1)
+
+    bad = json.loads(json.dumps(base))
+    bad["hosts"]["a"]["host_options"] = {"pcap_enabled": True}
+    with pytest.raises(ConfigError, match="integrity.*pcap"):
+        Simulation(ConfigOptions.from_dict(bad), world=1)
+
+    bad = json.loads(json.dumps(base))
+    bad["campaign"] = {"seeds": [1, 2]}
+    from tools.campaign import build_campaign
+
+    with pytest.raises(ConfigError, match="integrity"):
+        build_campaign(bad)
+
+
+def test_hybrid_sentinel_rides_the_device_plane():
+    """cosim: the device-plane guards trace into the guarded windows
+    (integrity_strict_time relaxed), the bridge guards run host-side,
+    and a clean hybrid run is digest-identical with the sentinel on,
+    zero violations, with the integrity block in its report."""
+    from shadow_tpu.cosim import HybridSimulation
+
+    hosts = {
+        "server": {
+            "network_node_id": 0,
+            "processes": [{"path": "udp_echo_server", "args": ["port=9000"]}],
+        },
+        "client": {
+            "network_node_id": 0,
+            "processes": [{
+                "path": "udp_ping",
+                "args": ["server=server", "port=9000", "count=3"],
+                "expected_final_state": {"exited": 0},
+            }],
+        },
+    }
+
+    def run(integrity):
+        d = {
+            "general": {"stop_time": "3 s", "seed": 7},
+            "network": {"graph": {"type": "1_gbit_switch"}},
+            "hosts": json.loads(json.dumps(hosts)),
+        }
+        if integrity:
+            d["integrity"] = {"enabled": True}
+        sim = HybridSimulation(ConfigOptions.from_dict(d))
+        rep = sim.run()
+        return sim, rep
+
+    sim_off, rep_off = run(False)
+    sim_on, rep_on = run(True)
+    assert rep_on["determinism_digest"] == rep_off["determinism_digest"]
+    assert sim_on.engine_cfg.integrity
+    assert not sim_on.engine_cfg.integrity_strict_time
+    assert violation_total(sim_on.state) == 0
+    assert "integrity" in rep_on and "integrity" not in rep_off
+    assert "determinism_digest2" in rep_on["integrity"]
+    assert not rep_on.get("integrity_aborted")
+    # the bridge guard's committed horizon advanced with the run
+    assert sim_on._iv_horizon > 0
+
+
+def test_engine_config_validation():
+    from shadow_tpu.core.engine import EngineConfig
+
+    with pytest.raises(ValueError, match="integrity_dual"):
+        EngineConfig(num_hosts=4, stop_time=1, integrity_dual=True)
+
+
+def test_bench_compare_flags_deterministic_violation(tmp_path):
+    """bench_compare: deterministic violation appearing = regression;
+    transient growth = warning only (satellite 4)."""
+    from tools.bench_compare import compare, _rows
+
+    old = _rows([{
+        "metric": "m", "value": 10.0,
+        "integrity": {"transients": 0, "replays": 0},
+    }])
+    new_det = _rows([{
+        "metric": "m", "value": 10.0,
+        "integrity": {"transients": 0, "replays": 1,
+                      "deterministic": {"detail": "shard 0: x at round 3"}},
+        "integrity_aborted": True,
+    }])
+    findings = compare(old, new_det, 0.10, 0.10)
+    regs = [f for f in findings if f["severity"] == "regression"]
+    assert any(f["kind"] == "integrity" for f in regs), findings
+
+    new_warn = _rows([{
+        "metric": "m", "value": 10.0,
+        "integrity": {"transients": 4, "replays": 4},
+    }])
+    findings = compare(old, new_warn, 0.10, 0.10)
+    assert not [f for f in findings if f["severity"] == "regression"]
+    assert any(
+        f["kind"] == "integrity" and f["severity"] == "warning"
+        for f in findings
+    ), findings

@@ -47,7 +47,11 @@ def _rows(blob) -> dict[str, dict]:
             item = {**item["parsed"],
                     **({"hbm": item["hbm"]} if "hbm" in item else {}),
                     **({"network": item["network"]}
-                       if "network" in item else {})}
+                       if "network" in item else {}),
+                    **({"integrity": item["integrity"]}
+                       if "integrity" in item else {}),
+                    **({"integrity_aborted": True}
+                       if item.get("integrity_aborted") else {})}
         if "metric" in item:
             out[str(item["metric"])] = item
         elif "n_devices" in item:
@@ -189,6 +193,29 @@ def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
         elif isinstance(o_net, dict) and n_net is None:
             add("network", name, "warning",
                 "OLD carried a network block, NEW has none")
+        # integrity-sentinel block (PR 11, bench config 10): a
+        # DETERMINISTIC violation appearing is always a regression — the
+        # engine reproducibly broke its own invariant; transient-SDC
+        # growth is a warning (an environment getting noisier is signal,
+        # and the transients were survived by construction)
+        o_iv, n_iv = o.get("integrity"), n.get("integrity")
+        if isinstance(n_iv, dict):
+            if n_iv.get("deterministic") or n.get("integrity_aborted"):
+                add("integrity", name, "regression",
+                    f"deterministic integrity violation appeared: "
+                    f"{(n_iv.get('deterministic') or {}).get('detail', 'integrity_aborted')}")
+            ot = (o_iv or {}).get("transients", 0) if isinstance(
+                o_iv, dict
+            ) else 0
+            nt = n_iv.get("transients", 0)
+            if nt > ot:
+                add("integrity", name, "warning",
+                    f"transient SDC count grew {ot} -> {nt} (survived, "
+                    f"but the box is getting noisier)")
+        elif isinstance(o_iv, dict) and n_iv is None:
+            add("integrity", name, "warning",
+                "OLD carried an integrity block, NEW has none "
+                "(sentinel coverage lost)")
     for name in sorted(set(new) - set(old)):
         add("coverage", name, "info", "new metric (no baseline)")
     return findings

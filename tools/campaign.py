@@ -181,6 +181,14 @@ class Campaign:
                 "the ensemble plane this round (gear replay would need "
                 "per-replica shed tracking across the vmap)"
             )
+        if base_cfg.integrity.enabled:
+            raise ConfigError(
+                "campaign: the integrity sentinel is not supported with "
+                "the ensemble plane this round (the quarantine-and-replay "
+                "classifier would need per-replica violation signatures "
+                "across the vmap); disable the integrity block or run the "
+                "scenarios solo"
+            )
         self.specs = expand_replicas(base_cfg)
         sims: list[Simulation] = []
         for spec in self.specs:
@@ -639,7 +647,7 @@ def smoke(timeout_s: float = 300.0) -> int:
     import subprocess
     import tempfile
 
-    from tests.subproc import HEAP_CORRUPTION_RCS as corruption_rcs
+    from tools.corruption import HEAP_CORRUPTION_RCS as corruption_rcs
     with tempfile.TemporaryDirectory() as tmp:
         cmd = [
             sys.executable, os.path.abspath(__file__),

@@ -30,6 +30,13 @@
 # and the model's own flow counts). Subprocess-isolated with the same
 # corruption-signature SKIP posture as the hbm stage.
 #
+# Optional stage: TIER1_INTEGRITY=1 runs the integrity-sentinel soak
+# (tools/soak.py --sentinel --smoke: N uninterrupted iterations with the
+# in-jit invariant guards ON, asserting zero deterministic violations
+# and digest-exactness, reporting the transient-SDC count — "every
+# round's invariants held", not just "the final digest matched").
+# Same corruption-signature SKIP posture as the soak stage.
+#
 # Optional third stage: TIER1_CAMPAIGN=1 runs the ensemble-plane smoke
 # (tools/campaign.py --smoke: an A/A control campaign that must hold +
 # a forced-divergence A/B campaign whose bisection must agree with the
@@ -91,6 +98,14 @@ if [ -n "${TIER1_NET:-}" ]; then
   net_rc=$?
   echo "NET_RC=$net_rc"
   [ "$rc" -eq 0 ] && rc=$net_rc
+fi
+if [ -n "${TIER1_INTEGRITY:-}" ]; then
+  echo "== integrity-sentinel soak (TIER1_INTEGRITY) =="
+  timeout -k 10 "${TIER1_INTEGRITY_TIMEOUT:-150}" \
+    env JAX_PLATFORMS=cpu python tools/soak.py --sentinel --smoke
+  integrity_rc=$?
+  echo "INTEGRITY_RC=$integrity_rc"
+  [ "$rc" -eq 0 ] && rc=$integrity_rc
 fi
 if [ -n "${TIER1_CAMPAIGN:-}" ]; then
   echo "== campaign smoke (TIER1_CAMPAIGN) =="

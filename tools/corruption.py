@@ -1,0 +1,95 @@
+"""The corruption-signature taxonomy: ONE classifier for this box's
+documented jaxlib-0.4.37 failure flavors.
+
+Four drifting copies of the signature set used to live in
+tests/subproc.py, tools/soak.py, tools/net_report.py, and
+tools/hbm_report.py; new flavors (and any rc-set change) now land here
+once. docs/corruption.md is the prose companion: which paths are stable
+vs corruption magnets, and the classify-then-retry posture every
+consumer follows.
+
+The flavors (see also shadow_tpu/core/integrity.py, which detects the
+silent flavors IN the round they happen instead of post-mortem):
+
+  malloc-abort   glibc heap-corruption abort (malloc_consolidate /
+                 "corrupted size" / "munmap_chunk: invalid pointer"),
+                 SIGABRT: rc 134 shell-style or -6 Python-style. Often
+                 at interpreter teardown AFTER a valid result printed.
+  sigsegv        segmentation fault, rc 139 / -11 — same family, often
+                 inside jax array._value or compiled dispatch.
+  timeout-hang   the hang flavor: the worker wedges silently and a
+                 subprocess timeout fires with no output produced.
+  wrong-digest   the SILENT flavor: the run completes rc 0 but device
+                 state was scribbled mid-flight and the final digest is
+                 wrong. Only detectable by comparison (a replay, a
+                 reference digest, or the integrity sentinel's dual
+                 digest lane) — `classify` cannot see it from (rc,
+                 output); callers use `WRONG_DIGEST` as the flavor name
+                 when their own comparison finds it.
+  flow-scribble  the counter-scribble flavor: pointer-sized garbage over
+                 small model-state buffers (per-host counters reading
+                 ~9e13 or negative) while the digest stays intact —
+                 `counters_scribbled` is the bounds gate for it.
+
+Stdlib-only by design: tools import it for plain report runs and the
+test infra imports it at collection — neither may pull in JAX (the
+corruption this module classifies can kill any process that compiles).
+"""
+
+from __future__ import annotations
+
+# SIGABRT/SIGSEGV as seen through shell (128+N) and Python (-N)
+# conventions — THE canonical rc set (every consumer reads it from here)
+MALLOC_ABORT_RCS = (134, -6)
+SIGSEGV_RCS = (139, -11)
+HEAP_CORRUPTION_RCS = MALLOC_ABORT_RCS + SIGSEGV_RCS
+
+# flavor names (`classify` returns these; WRONG_DIGEST/FLOW_SCRIBBLE are
+# comparison-judged by callers, never derivable from an exit status)
+MALLOC_ABORT = "malloc-abort"
+SIGSEGV = "sigsegv"
+TIMEOUT_HANG = "timeout-hang"
+WRONG_DIGEST = "wrong-digest"
+FLOW_SCRIBBLE = "flow-counter-scribble"
+
+
+def is_corruption_rc(rc) -> bool:
+    """True when `rc` matches the documented abort/segfault signatures."""
+    return rc in HEAP_CORRUPTION_RCS
+
+
+def classify(
+    rc=None, *, timed_out: bool = False, output: str | bytes | None = None
+) -> str | None:
+    """Classify one worker outcome against the documented corruption
+    signatures. Returns a flavor name, or None for "not the known
+    corruption — judge it as a real result".
+
+    `output` is the worker's verdict-bearing output (usually stdout):
+    a worker that produced a verdict before dying got far enough that
+    its death is NOT classified away — the caller must surface the
+    verdict (or, for a post-result teardown abort, parse it; see
+    tests/subproc.py run_isolated_json). Pass None to skip the guard
+    when the caller has already applied its own.
+    """
+    if output is not None:
+        text = output.decode(errors="replace") if isinstance(
+            output, bytes
+        ) else output
+        if text.strip():
+            return None
+    if timed_out:
+        return TIMEOUT_HANG
+    if rc in MALLOC_ABORT_RCS:
+        return MALLOC_ABORT
+    if rc in SIGSEGV_RCS:
+        return SIGSEGV
+    return None
+
+
+def counters_scribbled(values, lo, hi) -> bool:
+    """The flow-counter-scribble gate: True when any counter sits
+    outside its physically-possible [lo, hi] bounds — pointer garbage,
+    not simulation output (tools/net_report.py's scribble gate and
+    bench.py's solo-leg poison gate both judge this way)."""
+    return any(v < lo or v > hi for v in values)

@@ -53,9 +53,9 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 # this box's documented jaxlib-0.4.37 corruption signatures (CHANGES.md
-# env notes; tests/subproc.py owns the canonical set — duplicated here so
-# a plain report run never imports the test infra)
-HEAP_CORRUPTION_RCS = (134, 139, -6, -11)
+# env notes): ONE taxonomy + classify() in tools/corruption.py —
+# stdlib-only, so a plain report run still imports no test infra or JAX
+from tools.corruption import classify as classify_corruption  # noqa: E402
 
 DEFAULT_HBM_GIB = 15.75  # one v5e chip
 
@@ -303,11 +303,13 @@ def main(argv=None) -> int:
                 continue
             sys.stdout.write(proc.stdout)
             sys.stderr.write(proc.stderr)
-            if proc.returncode in HEAP_CORRUPTION_RCS and (
+            flavor = classify_corruption(proc.returncode)
+            if flavor is not None and (
                 "ok" not in proc.stdout and "FAILED" not in proc.stderr
             ):
                 print(f"attempt {attempt + 1}: known corruption signature "
-                      f"rc={proc.returncode}; retrying", file=sys.stderr)
+                      f"({flavor}, rc={proc.returncode}); retrying",
+                      file=sys.stderr)
                 continue
             return proc.returncode
         print("SKIP: every attempt died of the known jaxlib corruption "

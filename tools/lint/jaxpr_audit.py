@@ -76,6 +76,16 @@ AUDIT_CONFIGS = {
         kw=dict(qcap=16, trace_rounds=8, netobs=True, flow_records=16,
                 sends_budget=16),
     ),
+    # integrity sentinel ON (ISSUE 11): the per-round invariant guards,
+    # the violation lanes, and the dual digest traced in — pins the
+    # GATED program's compile surface while `echo`/`phold` above pin
+    # that the default (sentinel-off) programs stay byte-unchanged.
+    "phold_integrity": dict(
+        model="phold",
+        hosts=None,  # mk_hosts(4) below
+        stop=200_000_000,
+        kw=dict(qcap=16, integrity=True),
+    ),
 }
 
 
@@ -173,7 +183,9 @@ def _build(name, spec):
 def run_audit(
     root: str | None = None,
     update: bool = False,
-    configs: tuple[str, ...] = ("echo", "phold", "tgen_netobs"),
+    configs: tuple[str, ...] = (
+        "echo", "phold", "tgen_netobs", "phold_integrity",
+    ),
     fingerprint_file: str = FINGERPRINT_FILE,
 ):
     """Returns (findings, report dict per config)."""

@@ -36,9 +36,9 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 # this box's documented jaxlib-0.4.37 corruption signatures (CHANGES.md
-# env notes; tests/subproc.py owns the canonical set — duplicated so a
-# plain report run never imports the test infra)
-HEAP_CORRUPTION_RCS = (134, 139, -6, -11)
+# env notes): ONE taxonomy + classify() in tools/corruption.py —
+# stdlib-only, so a plain report run still imports no test infra or JAX
+from tools.corruption import classify as classify_corruption  # noqa: E402
 
 
 def load_network_block(path: str) -> tuple[dict, dict]:
@@ -191,10 +191,12 @@ def run_check(tmp_dir: str) -> int:
     # client bound (or negative) is physically impossible — classify the
     # run as poisoned (rc 3: the parent retries, then SKIPs) instead of
     # reporting a false reconciliation failure.
+    from tools.corruption import counters_scribbled
+
     flows_bound = 2  # flows per client in _check_config
     for label, sim in (("off", sim_off), ("on", sim_on)):
         fd = np.asarray(jax.device_get(sim.state.model["flows_done"]))
-        if (fd < 0).any() or (fd > flows_bound).any():
+        if counters_scribbled(fd.tolist(), 0, flows_bound):
             print(
                 f"POISONED: {label}-run model flow counters {fd.tolist()} "
                 f"outside [0, {flows_bound}] — the documented silent-"
@@ -323,11 +325,13 @@ def main(argv=None) -> int:
                 print(f"attempt {attempt + 1}: worker self-classified "
                       f"poisoned device state; retrying", file=sys.stderr)
                 continue
-            if proc.returncode in HEAP_CORRUPTION_RCS and (
+            flavor = classify_corruption(proc.returncode)
+            if flavor is not None and (
                 "ok" not in proc.stdout and "FAILED" not in proc.stderr
             ):
                 print(f"attempt {attempt + 1}: known corruption signature "
-                      f"rc={proc.returncode}; retrying", file=sys.stderr)
+                      f"({flavor}, rc={proc.returncode}); retrying",
+                      file=sys.stderr)
                 continue
             return proc.returncode
         print("SKIP: every attempt died of the known jaxlib corruption "

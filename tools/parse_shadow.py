@@ -41,6 +41,10 @@ HEARTBEAT_RE = re.compile(
     # cumulative; fct=<flows completed> (flow-ledger runs only)
     r"(?:ek=(?P<ek_timer>\d+)/(?P<ek_pkt>\d+) )?"
     r"(?:fct=(?P<fct_done>\d+) )?"
+    # PR 11 integrity-sentinel field (only emitted when the `integrity:`
+    # block is enabled): iv=<transient SDC survived>/<sentinel replays>,
+    # cumulative
+    r"(?:iv=(?P<iv_transient>\d+)/(?P<iv_replays>\d+) )?"
     # PR 6 ensemble-campaign field (only emitted by tools/campaign.py):
     # rep=<replicas done>/<total replicas>
     r"(?:rep=(?P<rep_done>\d+)/(?P<rep_total>\d+) )?"
