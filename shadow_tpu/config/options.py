@@ -215,6 +215,27 @@ class ExperimentalOptions:
     # microstep (default). Sweep tools/bench_popk.py to pick K; see
     # docs/architecture.md "K-way microsteps".
     microstep_events: int = 1
+    # Device-resident per-host timer wheel (ops/wheel.py): calendar slots
+    # for the model's declared timer_kinds (tgen RTO/DELACK, echo tick,
+    # phold job). Timers route to the [H, S] wheel instead of occupying
+    # event-queue slots; the microstep pops the (time, order) minimum of
+    # queue ∪ wheel, so dispatch order / digests / events / drops are
+    # bit-identical to the wheel-off path (tests/test_wheel.py gates it).
+    # A full wheel spills to the queue (stats wheel{} block counts it —
+    # a sizing signal, never a loss). 0 = off. Sweep tools/bench_wheel.py
+    # to pick S; see docs/architecture.md "Timer wheel and calendar
+    # merge". Requires microstep_events = 1 this round.
+    timer_wheel: int = 0
+    # wheel block-cache block size; 0 = auto (divisor of timer_wheel
+    # near sqrt — the bucketed-queue balance rule)
+    timer_wheel_block: int = 0
+    # Sort-free calendar-queue exchange merge (ops/merge.py
+    # merge_scatter_free): non-shedding rounds bucket incoming rows by
+    # destination via scatter-add instead of the full (dst, t, order)
+    # sort; overflow rounds fall back to the sort in-jit, so results are
+    # bit-identical on every workload. Measured CPU win (the CPU merge
+    # is sort-dominated); off by default.
+    merge_scatter: bool = False
 
     def resolve_shapes(self, num_hosts: int) -> tuple[int, int, int]:
         """(queue_capacity, send_budget, rounds_per_chunk) with 0-valued
@@ -347,6 +368,7 @@ class ExperimentalOptions:
             "use_codel",
             "packet_breadcrumbs",
             "use_cpu_pinning",
+            "merge_scatter",
         ):
             if f in d:
                 setattr(e, f, bool(d.pop(f)))
@@ -360,6 +382,8 @@ class ExperimentalOptions:
             "microstep_events",
             "host_workers",
             "merge_rows",
+            "timer_wheel",
+            "timer_wheel_block",
         ):
             if f in d:
                 setattr(e, f, int(d.pop(f)))
@@ -372,6 +396,27 @@ class ExperimentalOptions:
             raise ConfigError(
                 f"experimental.microstep_events must be >= 1, "
                 f"got {e.microstep_events}"
+            )
+        if e.timer_wheel < 0:
+            raise ConfigError(
+                f"experimental.timer_wheel must be >= 0 (0 = off), "
+                f"got {e.timer_wheel}"
+            )
+        if e.timer_wheel_block < 0 or (
+            e.timer_wheel and e.timer_wheel_block
+            and e.timer_wheel % e.timer_wheel_block
+        ):
+            raise ConfigError(
+                f"experimental.timer_wheel_block="
+                f"{e.timer_wheel_block} must be 0 (auto) or divide "
+                f"timer_wheel={e.timer_wheel} evenly"
+            )
+        if e.timer_wheel and e.microstep_events > 1:
+            raise ConfigError(
+                "experimental.timer_wheel requires microstep_events=1 "
+                "this round (the K-way fold needs merged-batch clear/"
+                "reserve accounting to stay exact with a wheel) — drop "
+                "one of the two knobs"
             )
         if d:
             raise ConfigError(f"unknown experimental options: {sorted(d)}")

@@ -113,6 +113,17 @@ def _refresh_queue_caches(state):
             )
         else:
             state = state._replace(queue=bucket_rebuild(q, q.block))
+    # the timer wheel IS the BucketQueue machinery — same derived-cache
+    # rule on restore (ops/wheel.py)
+    w = getattr(state, "wheel", None)
+    if isinstance(w, BucketQueue):
+        if w.t.ndim == 3:
+            block = w.t.shape[2] // w.bt.shape[2]
+            state = state._replace(
+                wheel=jax.vmap(lambda ww: bucket_rebuild(ww, block))(w)
+            )
+        else:
+            state = state._replace(wheel=bucket_rebuild(w, w.block))
     return state
 
 
@@ -148,6 +159,12 @@ _MIGRATABLE_CFG_FIELDS = (
     "max_round_inserts",
     "microstep_limit",
     "a2a_block",
+    # timer-wheel shape (ops/wheel.py): slots/block migrate through the
+    # same exactness-gated ops as the queue capacity. Wheel PRESENCE
+    # (on vs off) changes the state treedef, which both fingerprints
+    # carry — an on/off cross-restore still refuses loudly.
+    "wheel_slots",
+    "wheel_block",
 )
 
 
@@ -176,11 +193,19 @@ def _state_shape_meta(state) -> dict:
     from shadow_tpu.ops.events import BucketQueue
 
     q = state.queue
-    return {
+    meta = {
         "queue_capacity": int(q.t.shape[-1]),
         "queue_block": int(q.block) if isinstance(q, BucketQueue) else 0,
         "sends_per_host_round": int(state.outbox.t.shape[-1]),
     }
+    # wheel keys only when a wheel exists: wheel-off checkpoints keep the
+    # pre-wheel meta byte-for-byte, so older checkpoints (no wheel keys)
+    # still compare equal against wheel-off sims and load the exact path
+    w = getattr(state, "wheel", None)
+    if w is not None:
+        meta["wheel_slots"] = int(w.t.shape[-1])
+        meta["wheel_block"] = int(w.block)
+    return meta
 
 
 def _shaped_template(state, meta: dict):
@@ -197,6 +222,13 @@ def _shaped_template(state, meta: dict):
             "checkpoint queue layout (flat vs bucketed) does not match "
             "this simulation; migration cannot cross layout kinds"
         )
+    wheel_slots = int(meta.get("wheel_slots", 0))
+    if (wheel_slots > 0) != (getattr(state, "wheel", None) is not None):
+        raise CheckpointError(
+            "checkpoint timer-wheel presence (on vs off) does not match "
+            "this simulation; migration cannot cross the wheel boundary "
+            "— rebuild with the same experimental.timer_wheel setting"
+        )
     h = state.queue.t.shape[0]
     queue = (
         make_bucket_queue(h, meta["queue_capacity"], meta["queue_block"])
@@ -206,7 +238,14 @@ def _shaped_template(state, meta: dict):
     outbox = make_empty_outbox(
         h, meta["sends_per_host_round"], state.outbox.count
     )
-    return state._replace(queue=queue, outbox=outbox)
+    state = state._replace(queue=queue, outbox=outbox)
+    if wheel_slots:
+        from shadow_tpu.ops.wheel import make_wheel
+
+        state = state._replace(
+            wheel=make_wheel(h, wheel_slots, int(meta.get("wheel_block", 0)))
+        )
+    return state
 
 
 def _migrate_restored(state, sim):
@@ -268,6 +307,34 @@ def _migrate_restored(state, sim):
                 state.outbox.t.shape[0], target_budget, state.outbox.count
             )
         )
+    # timer wheel: same exactness-gated migration as the queue (slot
+    # positions unobservable; live timers must fit the target). Presence
+    # was already matched by _shaped_template / the treedef guard.
+    w = getattr(state, "wheel", None)
+    if w is not None:
+        from shadow_tpu.ops.wheel import migrate_wheel, resolve_wheel_block
+
+        target_slots = cfg.wheel_slots
+        target_block = resolve_wheel_block(target_slots, cfg.wheel_block)
+        if (
+            int(w.t.shape[-1]) != target_slots
+            or int(w.block) != target_block
+        ):
+            if int(w.t.shape[-1]) > target_slots and not bool(
+                jnp.all(migration_fits(w, target_slots))
+            ):
+                occ = int(jnp.max(jnp.sum(
+                    (w.t != TIME_MAX).astype(jnp.int32), axis=-1
+                )))
+                raise CheckpointError(
+                    f"cannot resume at wheel_slots {target_slots}: the "
+                    f"checkpoint holds up to {occ} live timers per host "
+                    f"(written at {int(w.t.shape[-1])} slots) — resume "
+                    f"at >= {occ} slots"
+                )
+            state = state._replace(
+                wheel=migrate_wheel(w, target_slots, cfg.wheel_block)
+            )
     if sim.engine.mesh is not None:
         specs = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(sim.engine.mesh, s),

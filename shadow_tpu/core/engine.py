@@ -75,11 +75,18 @@ from shadow_tpu.ops import (
     merge_flat_events,
     pack_order,
     q_clear_popped,
+    q_head,
     q_len,
     q_next_time,
     q_pop_k,
     q_pop_min,
     q_push_many,
+)
+from shadow_tpu.ops.wheel import (
+    wheel_free,
+    wheel_next_time,
+    wheel_pop_min,
+    wheel_push_many,
 )
 from shadow_tpu.obs.tracer import (
     COL_A2A_SHED,
@@ -122,7 +129,7 @@ from shadow_tpu.core.faults import (
     window_effects,
 )
 from shadow_tpu.ops.events import unpack_order_src
-from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS
+from shadow_tpu.ops.events import EVENT_PAYLOAD_WORDS, Event
 from shadow_tpu.ops.rng import RngState, rng_init, rng_uniform
 from shadow_tpu.simtime import TIME_MAX
 
@@ -264,6 +271,15 @@ class Stats(NamedTuple):
     # detectable by cross-checking the two (core/integrity.
     # classify_digest_pair) instead of silently reporting a wrong digest.
     digest2: Any = None  # u64[H] | None
+    # Timer-wheel lanes (ops/wheel.py; None unless cfg.wheel_active —
+    # the default program carries neither and stays byte-identical).
+    # `wheel_spilled` counts timer pushes diverted to the event queue
+    # because the wheel was full (spill-to-queue semantics: never a
+    # loss, but a sizing signal — sweep tools/bench_wheel.py);
+    # `wheel_occ_hwm` is the per-host wheel-occupancy high-water,
+    # sampled once per round like q_occ_hwm.
+    wheel_spilled: Any = None  # i64[H] | None
+    wheel_occ_hwm: Any = None  # i64[H] | None
 
 
 class SimState(NamedTuple):
@@ -293,6 +309,14 @@ class SimState(NamedTuple):
     # chunk boundaries, observes values the handler already computed,
     # feeds nothing back into scheduling.
     flows: Any = None  # FlowLedger | None
+    # device-resident timer wheel (ops/wheel.py): None unless
+    # cfg.wheel_active. A per-host [H, S] calendar slab (BucketQueue
+    # machinery) holding the model's timer events (timer_kinds) so they
+    # never occupy event-queue slots or feed the exchange-merge's free
+    # ranking; the microstep pops the (time, order) minimum of
+    # queue ∪ wheel, so dispatch order is bit-identical to wheel-off
+    # (tests/test_wheel.py is the gate).
+    wheel: Any = None  # TimerWheel | None
 
 
 class EngineParams(NamedTuple):
@@ -500,6 +524,32 @@ class EngineConfig:
     # therefore builds with False and keeps the slab-floor sub-check
     # plus its own host-side bridge guards (cosim._bridge_guard).
     integrity_strict_time: bool = True
+    # Device-resident timer wheel (ops/wheel.py; experimental.timer_wheel):
+    # per-host calendar slots for the model's declared timer_kinds. 0 = off
+    # (no wheel in the carry, no routing/pop-merge code traced — the
+    # program stays byte-identical to before the wheel existed). With
+    # S > 0, model timer pushes route to the [H, S] wheel (overflow spills
+    # to the event queue, counted in stats.wheel_spilled, never silent),
+    # wheel heads fold into the round's min-next-event reduction, and the
+    # microstep pops the lexicographic (time, order) minimum of
+    # queue ∪ wheel — dispatch order, digests, events, and drop counters
+    # are bit-identical to the wheel-off path whenever the queue itself
+    # does not overflow (the wheel frees queue slots, so a run the off
+    # path would overflow can only drop LESS; sized workloads see zero
+    # drops either way — tests/test_wheel.py is the gate).
+    wheel_slots: int = 0
+    # wheel block size (slots per block of the wheel's block-min caches);
+    # 0 = auto (a divisor of wheel_slots near sqrt — ops/wheel.py
+    # resolve_wheel_block). Must divide wheel_slots.
+    wheel_block: int = 0
+    # Sort-free calendar-queue exchange merge (ops/merge.py
+    # merge_scatter_free): bucket incoming exchange rows by destination
+    # via scatter-add + scatter-max peeling instead of the full
+    # (dst, t, order) sort on the non-shedding fast path; any round where
+    # a destination would overflow falls back to the sort path in-jit, so
+    # digests/events/drops are bit-identical on every workload. False
+    # (default) keeps the sort merge and traces no scatter code.
+    merge_scatter: bool = False
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -564,6 +614,26 @@ class EngineConfig:
                 "integrity_dual requires integrity=True (the dual digest "
                 "is an integrity-sentinel lane)"
             )
+        if self.wheel_slots < 0:
+            raise ValueError(
+                f"wheel_slots={self.wheel_slots} must be >= 0 (0 = off)"
+            )
+        if self.wheel_block < 0 or (
+            self.wheel_slots and self.wheel_block
+            and self.wheel_slots % self.wheel_block
+        ):
+            raise ValueError(
+                f"wheel_block={self.wheel_block} must be 0 (auto) or divide "
+                f"wheel_slots={self.wheel_slots} evenly"
+            )
+        if self.wheel_slots and self.microstep_events > 1:
+            raise ValueError(
+                "timer wheel + K-way microsteps (microstep_events > 1) is "
+                "not supported yet: the K-way fold would need a merged "
+                "2K-candidate batch with split clear/reserve accounting to "
+                "stay exact — run the wheel with microstep_events=1 (the "
+                "measured CPU winner) or keep the wheel off"
+            )
 
     @property
     def a2a_block_size(self) -> int:
@@ -627,6 +697,13 @@ class EngineConfig:
         """True iff the flow-completion ledger is traced into the round
         body (network observatory on AND a ring capacity declared)."""
         return self.netobs and self.flow_records > 0
+
+    @property
+    def wheel_active(self) -> bool:
+        """True iff the timer wheel is traced into the round body (the
+        wheel carry, push routing, and merged pops exist only then —
+        the wheel-off program stays byte-identical)."""
+        return self.wheel_slots > 0
 
     @property
     def gear_active(self) -> bool:
@@ -711,6 +788,10 @@ def _init_stats(cfg: EngineConfig) -> Stats:
             jnp.full((h,), _DIGEST2_OFFSET, jnp.uint64)
             if cfg.integrity_dual else None
         ),
+        # timer-wheel lanes (ops/wheel.py): absent unless the wheel is
+        # traced in — distinct buffers per field (donation rule above)
+        wheel_spilled=zi() if cfg.wheel_active else None,
+        wheel_occ_hwm=zi() if cfg.wheel_active else None,
     )
 
 
@@ -748,12 +829,47 @@ def seed_queue(
     Returns (queue, seq[H]) with per-host seq counters advanced past the
     seeded events so later emissions keep globally unique order keys.
     """
+    queue, _, seq = _seed_slabs(cfg, initial_events, ())
+    return queue, seq
+
+
+def seed_queue_wheel(
+    cfg: EngineConfig,
+    initial_events: list[tuple[int, int, int, tuple]],
+    timer_kinds: tuple[int, ...],
+) -> tuple[EventQueue, Any, Array]:
+    """`seed_queue` for wheel-active programs: seeded TIMER events (model
+    kind in `timer_kinds`) land in the wheel slab, everything else in the
+    queue — the boot-time form of the runtime push routing, so a
+    timer-dominant boot population (the 1M-lane phold/tgen seeds) never
+    constrains the queue capacity. A full wheel spills seeds back to the
+    queue (same contract as the runtime route); order keys advance in
+    event-list order regardless of destination, so the (time, order)
+    total order — hence dispatch and digests — is identical to seeding
+    everything into one queue. Returns (queue, wheel_slabs, seq) with
+    wheel_slabs the flat (t, order, kind, payload) numpy planes (the
+    caller wraps them via bucket_rebuild)."""
+    return _seed_slabs(cfg, initial_events, tuple(timer_kinds))
+
+
+def _seed_slabs(
+    cfg: EngineConfig,
+    initial_events: list[tuple[int, int, int, tuple]],
+    timer_kinds: tuple[int, ...],
+):
     h, c = cfg.num_hosts, cfg.queue_capacity
+    s = cfg.wheel_slots if timer_kinds else 0
     t = np.full((h, c), TIME_MAX, np.int64)
     order = np.full((h, c), ORDER_MAX, np.int64)
     kind = np.zeros((h, c), np.int32)
     payload = np.zeros((h, c, EVENT_PAYLOAD_WORDS), np.int32)
     fill = np.zeros((h,), np.int32)
+    if s:
+        wt = np.full((h, s), TIME_MAX, np.int64)
+        worder = np.full((h, s), ORDER_MAX, np.int64)
+        wkind = np.zeros((h, s), np.int32)
+        wpayload = np.zeros((h, s, EVENT_PAYLOAD_WORDS), np.int32)
+        wfill = np.zeros((h,), np.int32)
     seq = np.zeros((h,), np.int64)
     # order keys are packed in numpy for the whole batch: calling the
     # (jax) pack_order per event built three traced scalars per call and
@@ -761,31 +877,47 @@ def seed_queue(
     from shadow_tpu.ops.events import _LOCAL_SHIFT, _SRC_SHIFT, SEQ_MASK
 
     for host, t_ns, k, pl in initial_events:
+        okey = (
+            (np.int64(1) << _LOCAL_SHIFT)
+            | (np.int64(host) << _SRC_SHIFT)
+            | (np.int64(seq[host]) & SEQ_MASK)
+        )
+        seq[host] += 1
+        if s and k in timer_kinds and wfill[host] < s:
+            slot = wfill[host]
+            wt[host, slot] = t_ns
+            worder[host, slot] = okey
+            wkind[host, slot] = k
+            wpayload[host, slot, : len(pl)] = pl
+            wfill[host] += 1
+            continue
         slot = fill[host]
         if slot >= c:
             raise ValueError(
                 f"host {host}: {slot + 1} initial events exceed queue capacity {c}"
             )
         t[host, slot] = t_ns
-        order[host, slot] = (
-            (np.int64(1) << _LOCAL_SHIFT)
-            | (np.int64(host) << _SRC_SHIFT)
-            | (np.int64(seq[host]) & SEQ_MASK)
-        )
+        order[host, slot] = okey
         kind[host, slot] = k
         payload[host, slot, : len(pl)] = pl
         fill[host] += 1
-        seq[host] += 1
-    return (
-        EventQueue(
-            t=jnp.asarray(t, jnp.int64),
-            order=jnp.asarray(order, jnp.int64),
-            kind=jnp.asarray(kind, jnp.int32),
-            payload=jnp.asarray(payload, jnp.int32),
-            dropped=jnp.zeros((h,), jnp.int64),
-        ),
-        jnp.asarray(seq, jnp.int64),
+    queue = EventQueue(
+        t=jnp.asarray(t, jnp.int64),
+        order=jnp.asarray(order, jnp.int64),
+        kind=jnp.asarray(kind, jnp.int32),
+        payload=jnp.asarray(payload, jnp.int32),
+        dropped=jnp.zeros((h,), jnp.int64),
     )
+    wheel = None
+    if s:
+        wheel = EventQueue(
+            t=jnp.asarray(wt, jnp.int64),
+            order=jnp.asarray(worder, jnp.int64),
+            kind=jnp.asarray(wkind, jnp.int32),
+            payload=jnp.asarray(wpayload, jnp.int32),
+            dropped=jnp.zeros((h,), jnp.int64),
+        )
+    return queue, wheel, jnp.asarray(seq, jnp.int64)
 
 
 # --------------------------------------------------------------------------
@@ -887,6 +1019,13 @@ class Engine:
     def __init__(self, cfg: EngineConfig, model, mesh: Mesh | None = None):
         if (mesh is None) != (cfg.world == 1):
             raise ValueError("mesh must be provided iff cfg.world > 1")
+        if cfg.wheel_active and not tuple(getattr(model, "timer_kinds", ())):
+            raise ValueError(
+                f"timer wheel enabled (wheel_slots={cfg.wheel_slots}) but "
+                f"model {getattr(model, 'name', model)!r} declares no "
+                f"timer_kinds — nothing would ever route to the wheel; "
+                f"drop experimental.timer_wheel or use a model with timers"
+            )
         self.cfg = cfg
         self.model = model
         self.mesh = mesh
@@ -1082,6 +1221,8 @@ class Engine:
                 iv_mask=sh if self.cfg.integrity else None,
                 iv_round=sh if self.cfg.integrity else None,
                 digest2=sh if self.cfg.integrity_dual else None,
+                wheel_spilled=sh if self.cfg.wheel_active else None,
+                wheel_occ_hwm=sh if self.cfg.wheel_active else None,
             ),
             trace=(
                 TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
@@ -1090,6 +1231,13 @@ class Engine:
             flows=(
                 FlowLedger(rows=sh, cursor=sh)
                 if self.cfg.flow_ledger_active else None
+            ),
+            wheel=(
+                BucketQueue(
+                    t=sh, order=sh, kind=sh, payload=sh, dropped=sh,
+                    bt=sh, bo=sh, bfill=sh,
+                )
+                if self.cfg.wheel_active else None
             ),
         )
 
@@ -1178,7 +1326,23 @@ class Engine:
         self._has_rows = params.lat_rows is not None
         self._build_run_chunk()
         with host_build_context():
-            queue, seq = seed_queue(cfg, initial_events)
+            if cfg.wheel_active:
+                # seeded timer events boot straight into the wheel —
+                # same routing as runtime pushes, so a timer-dominant
+                # boot population never constrains queue capacity
+                from shadow_tpu.ops.wheel import resolve_wheel_block
+
+                queue, wheel_flat, seq = seed_queue_wheel(
+                    cfg, initial_events,
+                    tuple(getattr(self.model, "timer_kinds", ())),
+                )
+                wheel = bucket_rebuild(
+                    wheel_flat,
+                    resolve_wheel_block(cfg.wheel_slots, cfg.wheel_block),
+                )
+            else:
+                queue, seq = seed_queue(cfg, initial_events)
+                wheel = None
             if cfg.queue_block:
                 queue = bucket_rebuild(queue, cfg.queue_block)
             state = SimState(
@@ -1206,6 +1370,7 @@ class Engine:
                     if cfg.flow_ledger_active
                     else None
                 ),
+                wheel=wheel,
             )
         if self.mesh is not None:
             state = jax.device_put(
@@ -1472,6 +1637,15 @@ def _window_step(
         q_occ_hwm=jnp.maximum(st_x.stats.q_occ_hwm, occ),
         outbox_hwm=jnp.maximum(st_x.stats.outbox_hwm, ob_hwm[None]),
     )
+    if cfg.wheel_active:
+        # wheel-occupancy high-water, same cadence as q_occ_hwm (cheap:
+        # the wheel always reads its bfill caches). The exchange never
+        # touches the wheel, so the post-exchange sample is the round's
+        # post-push peak.
+        w_occ = q_len(st_x.wheel).astype(jnp.int64)
+        stats = stats._replace(
+            wheel_occ_hwm=jnp.maximum(stats.wheel_occ_hwm, w_occ)
+        )
     if cfg.netobs:
         # this shard bound the barrier this round (done-rounds are not
         # scheduling rounds and do not count, mirroring stats.rounds)
@@ -1662,8 +1836,15 @@ def _integrity_round_check(
     )
 
     checks: list[tuple[int, Any]] = []
-    gmin_raw = _pmin(jnp.min(st0.queue.t), axis)
-    t_bad = jnp.min(st_x.queue.t) < gmin_raw
+    entry_min = jnp.min(st0.queue.t)
+    post_min = jnp.min(st_x.queue.t)
+    if cfg.wheel_active:
+        # the wheel's time plane is part of the same slab-floor law:
+        # pending timers obey the identical >= entry-minimum argument
+        entry_min = jnp.minimum(entry_min, jnp.min(st0.wheel.t))
+        post_min = jnp.minimum(post_min, jnp.min(st_x.wheel.t))
+    gmin_raw = _pmin(entry_min, axis)
+    t_bad = post_min < gmin_raw
     if cfg.integrity_strict_time and not cfg.use_dynamic_runahead:
         # see the IV_TIME (a) derivation above: valve-bound rounds under
         # DYNAMIC runahead (shrinking ra) and the hybrid bridge
@@ -1685,9 +1866,27 @@ def _integrity_round_check(
             IV_QFILL,
             jnp.any(occ_true != jnp.sum(st_m.queue.bfill, axis=1)),
         ))
+    if cfg.wheel_active:
+        # the wheel's fill caches obey the same incremental-maintenance
+        # invariant (it IS the BucketQueue machinery; no merge rebuild
+        # ever masks a divergence, so post-exchange is equally valid)
+        w_occ_true = jnp.sum(
+            st_m.wheel.t != TIME_MAX, axis=1, dtype=jnp.int32
+        )
+        checks.append((
+            IV_QFILL,
+            jnp.any(w_occ_true != jnp.sum(st_m.wheel.bfill, axis=1)),
+        ))
     c_bad = jnp.any(st_x.queue.dropped < st0.queue.dropped) | jnp.any(
         st_x.queue.dropped < 0
     )
+    if cfg.wheel_active:
+        # spill routing pre-empts every wheel overflow: a nonzero wheel
+        # drop counter means the free accounting (or the slab) is corrupt
+        c_bad = c_bad | jnp.any(st_x.wheel.dropped != 0)
+        c_bad = c_bad | jnp.any(
+            stats.wheel_spilled < st0.stats.wheel_spilled
+        ) | jnp.any(stats.wheel_spilled < 0)
     for get in (
         lambda s: s.events,
         lambda s: s.pkts_sent,
@@ -1740,6 +1939,11 @@ def _effective_next(cfg: EngineConfig, st: SimState, faults=None):
     the host's restart time (a down host's events defer to its up_t —
     same mechanics, different clock)."""
     nt = q_next_time(st.queue)
+    if cfg.wheel_active:
+        # the timer wheel's head folds into the same min: a due timer is
+        # as executable as a due queue event (TIME_MAX sentinels pass
+        # through the minimum unchanged)
+        nt = jnp.minimum(nt, wheel_next_time(st.wheel))
     if cfg.cpu_delay_ns > 0:
         nt = jnp.where(nt == TIME_MAX, nt, jnp.maximum(nt, st.cpu_busy_until))
     if faults is not None:
@@ -2136,11 +2340,13 @@ def _flow_append(cfg: EngineConfig, ledger: FlowLedger, host_gid, entries):
 
 def _finish_microstep(
     cfg: EngineConfig, st: SimState, c: _EvCarry, queue, ob_entries,
-    used_lats, flow_entries, host_gid,
+    used_lats, flow_entries, host_gid, wheel=None,
 ):
     """Apply a microstep's accumulated outbox appends (one fused slab pass)
     and flow-ledger appends, fold the used-latency lookahead, and
-    reassemble the SimState."""
+    reassemble the SimState. `wheel` is the post-pop/post-push timer
+    wheel on wheel-active programs (None otherwise — SimState.wheel
+    stays None)."""
     outbox = st.outbox
     ob_lost = jnp.zeros((), jnp.int64)
     if ob_entries:
@@ -2159,6 +2365,7 @@ def _finish_microstep(
     stats = c.stats._replace(ob_dropped=c.stats.ob_dropped + ob_lost[None])
     return st._replace(
         queue=queue,
+        wheel=wheel,
         rng=c.rng,
         seq=c.seq,
         sent_round=c.sent_round,
@@ -2195,13 +2402,23 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         # restart exactly as _effective_next (the barrier's view) says it
         # will. TIME_MAX heads stay TIME_MAX through the maximum.
         ht = q_next_time(st.queue)
+        if cfg.wheel_active:
+            # the candidate execution time is the COMBINED head: a due
+            # wheel timer is the next event exactly like a queue head
+            ht = jnp.minimum(ht, wheel_next_time(st.wheel))
         if floor is not None:
             ht = jnp.maximum(ht, floor)
         down_h, resume_h = down_and_resume(params.faults, ht)
         floor = resume_h if floor is None else jnp.maximum(floor, resume_h)
+    limit = window_end
     if floor is not None:
-        limit_h = jnp.where(floor < window_end, window_end, jnp.int64(0))
-        queue, ev, active = q_pop_min(st.queue, limit_h)
+        limit = jnp.where(floor < window_end, window_end, jnp.int64(0))
+    if cfg.wheel_active:
+        queue, wheel, ev, active = _pop_min_merged(st.queue, st.wheel, limit)
+    else:
+        queue, ev, active = q_pop_min(st.queue, limit)
+        wheel = st.wheel
+    if floor is not None:
         exec_t = jnp.maximum(ev.t, floor)
         ev = ev._replace(t=jnp.where(active, exec_t, ev.t))
         if cfg.cpu_delay_ns > 0:
@@ -2218,8 +2435,6 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
                     faults_delayed=st.stats.faults_delayed + (active & down_h)
                 )
             )
-    else:
-        queue, ev, active = q_pop_min(st.queue, window_end)
 
     if cfg.fault_clear:
         # queue-clear crash semantics: an event whose execution time falls
@@ -2237,16 +2452,116 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
     c, push_list, ob_entries, used_lats, flow_entries = _event_body(
         cfg, model, _ev_carry_of(st), params, host_gid, window_end, ev, active
     )
+    if cfg.wheel_active and push_list:
+        # route model timer pushes to the wheel (spill-to-queue when
+        # full); everything else — packets, app events, ingress
+        # requeues, spills — stays queue-bound. Static pre-filter: the
+        # requeue (first entry under shaping) is a packet by
+        # construction, and models may declare which push PORTS can
+        # carry timers (`timer_push_ports`, e.g. tgen's port_b) — the
+        # other entries skip the wheel pass entirely.
+        n_req = 1 if cfg.shaping else 0
+        tports = getattr(model, "timer_push_ports", None)
+        route_mask = [
+            i >= n_req and (tports is None or (i - n_req) in tports)
+            for i in range(len(push_list))
+        ]
+        push_list, push_w, spilled = _route_timer_pushes(
+            cfg, wheel, push_list,
+            tuple(getattr(model, "timer_kinds", ())), route_mask,
+        )
+        wheel = wheel_push_many(wheel, push_w)
+        c = c._replace(
+            stats=c.stats._replace(
+                wheel_spilled=c.stats.wheel_spilled + spilled
+            )
+        )
     if push_list:
         queue = q_push_many(queue, push_list)
     return _finish_microstep(
-        cfg, st, c, queue, ob_entries, used_lats, flow_entries, host_gid
+        cfg, st, c, queue, ob_entries, used_lats, flow_entries, host_gid,
+        wheel=wheel,
     )
 
 
 def _lex_less(at, ao, bt, bo):
     """(at, ao) < (bt, bo) on the (time, order) total key."""
     return (at < bt) | ((at == bt) & (ao < bo))
+
+
+def _pop_min_merged(queue, wheel, limit):
+    """Pop each host's earliest event from queue ∪ wheel under the
+    (time, order) total key — the wheel integration's dispatch-order
+    exactness hinge: the winner is chosen by comparing the two heads
+    (cache-cheap for the wheel and bucketed queues), then each structure
+    runs its pop masked to the hosts it won, so exactly one event pops
+    per active host and it is the same event the wheel-off path would
+    pop from its single combined queue. Ties are impossible between live
+    events (order keys are globally unique); the all-empty tie on the
+    (TIME_MAX, ORDER_MAX) sentinels picks the wheel side, whose pop then
+    does nothing (TIME_MAX is never < limit). Returns
+    (queue', wheel', event, active)."""
+    qt, qo = q_head(queue)
+    wt, wo = q_head(wheel)
+    q_wins = _lex_less(qt, qo, wt, wo)
+    z = jnp.int64(0)
+    queue2, ev_q, act_q = q_pop_min(queue, jnp.where(q_wins, limit, z))
+    wheel2, ev_w, act_w = wheel_pop_min(wheel, jnp.where(q_wins, z, limit))
+    ev = Event(
+        t=jnp.where(act_w, ev_w.t, ev_q.t),
+        order=jnp.where(act_w, ev_w.order, ev_q.order),
+        kind=jnp.where(act_w, ev_w.kind, ev_q.kind),
+        payload=jnp.where(act_w[:, None], ev_w.payload, ev_q.payload),
+    )
+    return queue2, wheel2, ev, act_q | act_w
+
+
+def _route_timer_pushes(cfg: EngineConfig, wheel, push_list, timer_kinds,
+                        route_mask=None):
+    """Split a microstep's push list into queue-bound and wheel-bound
+    entries. A push routes to the wheel iff it is a model timer event
+    (no KIND_PKT flag, model kind in the STATIC timer_kinds tuple — the
+    exact predicate the network observatory's ec_timer class uses) AND
+    the wheel has a free slot left after this microstep's earlier wheel
+    pushes; otherwise it stays queue-bound. Timer pushes that found the
+    wheel full SPILL to the queue — behaviorally identical to the
+    wheel-off path for that event (the pop merge re-derives the total
+    order from wherever events sit), counted per host into
+    stats.wheel_spilled, never silent. The running `taken` counter makes
+    the free check exact across multiple wheel pushes in one microstep,
+    so the wheel itself can never overflow (its `dropped` lane is an
+    invariant zero — the sentinel's IV_COUNTER asserts it).
+
+    `route_mask` is a per-entry STATIC list: False entries are known at
+    trace time to never carry a timer (the ingress requeue — packets by
+    construction — and model ports outside `timer_push_ports`), so they
+    skip the classification AND the wheel's one-hot write pass entirely.
+    Each skipped entry removes one [H, S]-shaped push pass per
+    microstep, which is most of the wheel's routing overhead on models
+    with several ports (tgen: 3 pushes, 1 possible timer).
+
+    Returns (queue_pushes, wheel_pushes, spilled i64[H])."""
+    free = wheel_free(wheel)  # [H] i32, post-pop occupancy
+    taken = jnp.zeros_like(free)
+    push_q, push_w = [], []
+    spilled = jnp.zeros((free.shape[0],), jnp.int64)
+    for i, push in enumerate(push_list):
+        mask, t, order, kind, payload = push[:5]
+        if route_mask is not None and not route_mask[i]:
+            push_q.append(push)
+            continue
+        kind = jnp.asarray(kind, jnp.int32)
+        is_timer = (
+            mask
+            & ((kind & KIND_PKT) == 0)
+            & kind_in(kind & KIND_MASK, timer_kinds)
+        )
+        fits = is_timer & (taken < free)
+        taken = taken + fits.astype(jnp.int32)
+        spilled = spilled + (is_timer & ~fits)
+        push_w.append((fits, t, order, kind, payload))
+        push_q.append((mask & ~fits, t, order, kind, payload))
+    return push_q, push_w, spilled
 
 
 def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
@@ -2558,7 +2873,28 @@ def _merge_into_queue(cfg, queue0, flat, has_sends):
     [K]-vector sorted fields, cheap to copy at every capacity. The apply
     runs unconditionally as a single where-pass."""
     q_flat = as_flat(queue0)
-    if jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
+    if cfg.merge_scatter:
+        # sort-free calendar scatter (ops/merge.py merge_scatter_free):
+        # non-shedding rounds bucket rows by destination via scatter-add
+        # peeling — no (dst, t, order) sort at all; a round where any
+        # destination would overflow falls back to the sort path IN-JIT,
+        # so shed order (hence digests/drops) is identical on every
+        # workload. Runs in the fused-cond form: the fast path reads the
+        # whole queue for its free ranking, so the plan split's
+        # time-plane-only cond has nothing to buy here.
+        from shadow_tpu.ops.merge import merge_scatter_free
+
+        merged = lax.cond(
+            has_sends,
+            lambda queue: merge_scatter_free(
+                queue, *flat, cfg.max_round_inserts,
+                shed_urgency=not cfg.cheap_shed,
+                merge_rows=cfg.merge_rows,
+            ),
+            lambda queue: queue,
+            q_flat,
+        )
+    elif jax.default_backend() == "cpu" or cfg.queue_capacity < 48:
         # Fused merge inside the cond. On CPU the scatter path is faster
         # and branch copies are cheap. On TPU this wins at SMALL slab
         # capacities (measured: PHOLD-torus cap 16 ran 40% slower with the

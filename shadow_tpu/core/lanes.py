@@ -198,6 +198,42 @@ STATE_LANES: dict[str, str] = {
     "stats.iv_round": "int64",
     "stats.digest2": "uint64",
     "stats.digest": "uint64",
+    # timer-wheel planes (ops/wheel.py; present only when
+    # experimental.timer_wheel > 0). The wheel IS the BucketQueue
+    # machinery re-aimed at timers, so every wheel lane mirrors its
+    # queue.* counterpart's width — WHEEL_LANE_OF_QUEUE below states the
+    # pairing and the shadowlint wheel rule enforces the lockstep.
+    "wheel.t": "int64",
+    "wheel.order": "int64",
+    "wheel.kind": "int32",
+    "wheel.payload": "int32",
+    "wheel.dropped": "int64",
+    "wheel.bt": "int64",
+    "wheel.bo": "int64",
+    "wheel.bfill": "int32",
+    "stats.wheel_spilled": "int64",
+    "stats.wheel_occ_hwm": "int64",
+}
+
+# ---------------------------------------------------------------------------
+# Timer-wheel lane pairing (ops/wheel.py): the wheel reuses the bucketed
+# queue's slab + cache machinery verbatim, so each wheel.* lane must keep
+# the SAME registered width as the queue.* lane the shared ops read and
+# write. Narrowing one side but not the other would make the shared ops
+# silently reinterpret bits. shadowlint's wheel rule (tools/lint/schema.py
+# check_wheel_registry) asserts this dict is total over the wheel.* paths
+# and that every pair agrees; the jaxpr audit pins the traced dtypes.
+# ---------------------------------------------------------------------------
+
+WHEEL_LANE_OF_QUEUE: dict[str, str] = {
+    "wheel.t": "queue.t",
+    "wheel.order": "queue.order",
+    "wheel.kind": "queue.kind",
+    "wheel.payload": "queue.payload",
+    "wheel.dropped": "queue.dropped",
+    "wheel.bt": "queue.bt",
+    "wheel.bo": "queue.bo",
+    "wheel.bfill": "queue.bfill",
 }
 
 # ---------------------------------------------------------------------------
@@ -218,6 +254,9 @@ STATE_LANES: dict[str, str] = {
 #   F   len(TRACE_FIELDS) (obs/tracer.py ring columns)
 #   FR  flow_records (flow-ledger ring rows; flows planes absent when 0)
 #   FF  len(FLOW_FIELDS) (obs/netobs.py ledger columns)
+#   WS  wheel_slots (timer-wheel slots per host; wheel planes absent
+#       when 0 — the wheel-off carry has no wheel at all)
+#   WNB wheel block-cache blocks = WS // resolved wheel block
 #
 # Integer entries are literal dimensions. Stage A stays jax-free: tokens
 # only, no imports. tests/test_memory.py asserts this dict covers
@@ -268,6 +307,16 @@ STATE_LANE_SHAPES: dict[str, tuple] = {
     "stats.digest": ("H",),
     "stats.digest2": ("H",),
     "stats.rounds": (),
+    "wheel.t": ("H", "WS"),
+    "wheel.order": ("H", "WS"),
+    "wheel.kind": ("H", "WS"),
+    "wheel.payload": ("H", "WS", "P"),
+    "wheel.dropped": ("H",),
+    "wheel.bt": ("H", "WNB"),
+    "wheel.bo": ("H", "WNB"),
+    "wheel.bfill": ("H", "WNB"),
+    "stats.wheel_spilled": ("H",),
+    "stats.wheel_occ_hwm": ("H",),
 }
 
 # ---------------------------------------------------------------------------

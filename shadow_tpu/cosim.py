@@ -172,6 +172,15 @@ class HybridSimulation:
                 "across queue shapes; use policy drop/abort or model the "
                 "hosts"
             )
+        if ex.timer_wheel:
+            # the hybrid device plane runs the bridge model, which has no
+            # timer_kinds (timers live in the real CPU processes) — a
+            # wheel would be dead HBM; reject loudly rather than carry it
+            raise ConfigError(
+                "experimental.timer_wheel is not supported on hybrid "
+                "(program) simulations — the bridge model declares no "
+                "timer_kinds; drop the knob or model the hosts"
+            )
         if (cfg.faults.supervisor.enabled
                 and cfg.faults.supervisor.checkpoint_file is not None):
             # same principle as crashes above: the hybrid supervisor runs
@@ -234,6 +243,9 @@ class HybridSimulation:
             # microstep loop / the cross-shard merge), so the CPU plane
             # sees identical deliveries either way
             microstep_events=ex.microstep_events,
+            # the sort-free calendar merge acts below the bridge (the
+            # cross-shard merge), so it rides along like the K-way fold
+            merge_scatter=ex.merge_scatter,
             exchange=ex.resolve_exchange(world),
             a2a_block=ex.a2a_block,
             world=world,

@@ -122,6 +122,11 @@ class TgenTcpModel:
     # events ROADMAP item 2's timer-wheel decision needs counted. TICK
     # (flow pacing) and TX (transmit continuation) classify as app.
     timer_kinds = (KIND_RTO, KIND_DELACK)
+    # static routing hint for the device timer wheel (core/engine.py
+    # _route_timer_pushes): only push port B (timer chain / tick /
+    # delack) can carry a timer kind — port A is always KIND_TX, so the
+    # wheel router skips its per-microstep classification + write pass
+    timer_push_ports = (1,)
     flow_ledger = True  # handle() emits FlowDone records at FIN-ACK
 
     def build(self, hosts, seed):

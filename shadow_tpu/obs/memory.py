@@ -81,6 +81,8 @@ def dims_of(
     flow_records: int = 0,
     integrity: bool = False,
     integrity_dual: bool = False,
+    wheel_slots: int = 0,
+    wheel_block: int = 0,
     payload_words: int | None = None,
     trace_cols: int | None = None,
     flow_cols: int | None = None,
@@ -103,6 +105,12 @@ def dims_of(
         from shadow_tpu.obs.netobs import FLOW_COLS
 
         flow_cols = FLOW_COLS
+    if wheel_slots:
+        from shadow_tpu.ops.wheel import resolve_wheel_block
+
+        wnb = int(wheel_slots) // resolve_wheel_block(wheel_slots, wheel_block)
+    else:
+        wnb = 0
     return {
         "H": int(hosts_per_shard),
         "C": int(queue_capacity),
@@ -114,6 +122,8 @@ def dims_of(
         "F": int(trace_cols),
         "FR": int(flow_records) if netobs else 0,
         "FF": int(flow_cols),
+        "WS": int(wheel_slots),
+        "WNB": wnb,
         "pressure": 1 if pressure else 0,
         "netobs": 1 if netobs else 0,
         "integrity": 1 if integrity else 0,
@@ -134,6 +144,8 @@ def dims_of_config(cfg) -> dict[str, int]:
         flow_records=cfg.flow_records,
         integrity=cfg.integrity,
         integrity_dual=cfg.integrity_dual,
+        wheel_slots=cfg.wheel_slots,
+        wheel_block=cfg.wheel_block,
     )
 
 
@@ -161,6 +173,12 @@ def dims_of_state(cfg, state) -> dict[str, int]:
         ),
         integrity=state.stats.integrity is not None,
         integrity_dual=state.stats.digest2 is not None,
+        wheel_slots=(
+            int(state.wheel.t.shape[-1]) if state.wheel is not None else 0
+        ),
+        wheel_block=(
+            int(state.wheel.block) if state.wheel is not None else 0
+        ),
     )
 
 
@@ -195,6 +213,12 @@ def lane_plane_bytes(path: str, dims: dict[str, int]) -> int | None:
     ):
         return None
     if path.startswith("flows.") and dims.get("FR", 0) == 0:
+        return None
+    # timer-wheel planes (ops/wheel.py): absent unless the wheel is on
+    if (
+        path.startswith("wheel.")
+        or path in ("stats.wheel_spilled", "stats.wheel_occ_hwm")
+    ) and dims.get("WS", 0) == 0:
         return None
     n = 1
     for tok in shape:
@@ -352,6 +376,8 @@ def state_bytes_at(cfg, capacity: int, send_budget: int) -> int:
         flow_records=cfg.flow_records,
         integrity=cfg.integrity,
         integrity_dual=cfg.integrity_dual,
+        wheel_slots=cfg.wheel_slots,
+        wheel_block=cfg.wheel_block,
     )
     return sum(component_totals(registered_component_bytes(dims)).values())
 

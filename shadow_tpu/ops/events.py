@@ -839,6 +839,25 @@ def q_pop_min(q, limit):
     return bq_pop_min(q, limit) if isinstance(q, BucketQueue) else pop_min(q, limit)
 
 
+def q_head(q) -> tuple[Array, Array]:
+    """Per-host head key: the (time, order) pair `q_pop_min` would pop
+    next, (TIME_MAX, ORDER_MAX) where the queue is empty. The timer-wheel
+    engine integration compares the queue head against the wheel head to
+    decide which structure pops this microstep (core/engine.py
+    `_pop_min_merged`), so this must agree with the pop selection
+    bit-for-bit: bucketed queues reduce the [H, C/B] caches (each block's
+    `bo` is the order AT its min time, so the min over blocks at the
+    global min time is the head order — block selection exactness as in
+    `bq_pop_min`); flat queues pay one [H, C] reduction pair."""
+    if isinstance(q, BucketQueue):
+        t, o = q.bt, q.bo
+    else:
+        t, o = q.t, q.order
+    tmin = jnp.min(t, axis=1)
+    omin = jnp.min(jnp.where(t == tmin[:, None], o, ORDER_MAX), axis=1)
+    return tmin, omin
+
+
 def q_len(q) -> Array:
     """Per-host live-slot count (occupancy) for either queue type. The
     bucketed queue sums its [H, C/B] `bfill` caches instead of scanning

@@ -1,0 +1,170 @@
+"""Device-resident per-host timer wheel: a fixed-slot calendar for model
+timer events (RTO / delayed-ACK / periodic ticks) that keeps them out of
+the packet event queue entirely.
+
+Blueprint (PAPERS.md): "A Grouped Sorting Queue Supporting Dynamic
+Updates for Timer Management" (arxiv 2601.09081) and Eiffel's bucketed
+FFS queues (arxiv 1810.03060). Both observe that timer workloads are
+dominated by push/cancel churn on entries that are NOT due yet, so the
+structure should make `next-due` and `pop-due` cheap without keeping a
+totally-ordered heap. The TPU recast: the wheel is a per-host `[H, S]`
+SoA slab with per-block (min-time, min-order, fill) caches — literally
+the `BucketQueue` machinery from `ops/events.py` re-aimed at timers.
+The block-min cache plane plays the role of Eiffel's find-first-set
+bitmap: `next_time` is one `[H, S/B]` reduction, a pop touches one
+victim block, and a push is a running-occupancy one-hot. Grouped
+sorting's "dynamic update" is `wheel_cancel`: order keys are globally
+unique, so a cancel is one masked compare over the slab plus a victim-
+block cache recompute — no re-sort, no tombstones.
+
+Why this is EXACT (the property the engine integration leans on,
+tests/test_wheel.py is the gate): slot positions are unobservable — the
+engine pops the lexicographic (time, order) minimum of queue ∪ wheel,
+so any split of the pending-event multiset between the two structures
+dispatches the identical sequence. Capacity is the one observable
+difference: a wheel push that would overflow SPILLS to the event queue
+(`route` masks in core/engine.py), so no event is ever lost to the
+wheel — spills are counted (stats.wheel_spilled), never silent, and the
+wheel's own `dropped` lane is structurally zero.
+
+All lane dtypes are sourced from the registry (core/lanes.py `wheel.*`
+entries mirror the `queue.*` widths — the wheel reuses the queue's
+machinery, so the widths must stay in lockstep; shadowlint's wheel rule
+checks exactly that).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from shadow_tpu.ops.events import (
+    BucketQueue,
+    bq_next_time,
+    bq_pop_min,
+    bq_push_many,
+    make_bucket_queue,
+    migrate_queue,
+    q_len,
+)
+from shadow_tpu.simtime import TIME_MAX
+from shadow_tpu.ops.events import ORDER_MAX
+
+# The wheel IS a BucketQueue: same SoA planes ([H, S] t/order/kind/payload
+# + dropped), same block-min caches (bt/bo/bfill over S/WB blocks), same
+# incremental maintenance ops. The alias is the design statement — every
+# exactness property proven for the bucketed queue (tests/test_bucketq.py)
+# transfers to the wheel for free, and checkpoint/migration/HBM pricing
+# reuse the queue paths verbatim.
+TimerWheel = BucketQueue
+
+
+def resolve_wheel_block(slots: int, block: int = 0) -> int:
+    """The wheel's block size: an explicit divisor wins; 0 auto-picks the
+    divisor of `slots` nearest sqrt(slots) (ties prefer the larger block
+    — `B ~ sqrt(S)` balances the [H, S/B] cache reduction against the
+    [H, B] victim-block recompute, the same rule the bucketed queue's
+    sweep settled on, tools/bench_bucketq.py)."""
+    slots = int(slots)
+    if slots < 1:
+        raise ValueError(f"wheel slots must be >= 1, got {slots}")
+    block = int(block)
+    if block:
+        if block < 1 or slots % block:
+            raise ValueError(
+                f"wheel block={block} must divide slots={slots} evenly"
+            )
+        return block
+    target = slots ** 0.5
+    divisors = [b for b in range(1, slots + 1) if slots % b == 0]
+    return min(divisors, key=lambda b: (abs(b - target), -b))
+
+
+def make_wheel(num_hosts: int, slots: int, block: int = 0) -> TimerWheel:
+    """A fresh (empty) per-host timer wheel: [H, S] lanes + block caches."""
+    return make_bucket_queue(num_hosts, slots, resolve_wheel_block(slots, block))
+
+
+def wheel_next_time(w: TimerWheel) -> Array:
+    """Per-host earliest pending timer (i64[H], TIME_MAX = none) from the
+    [H, S/B] caches alone — the term the engine folds into its round
+    min-next-event reduction (`_effective_next`)."""
+    return bq_next_time(w)
+
+
+def wheel_len(w: TimerWheel) -> Array:
+    """Per-host live timer count (i32[H]) from the fill caches."""
+    return q_len(w)
+
+
+def wheel_free(w: TimerWheel) -> Array:
+    """Per-host free slots (i32[H]) — the spill-routing input: a push is
+    diverted to the event queue when no slot is free, so the wheel itself
+    can never drop (its `dropped` lane is an invariant zero)."""
+    return jnp.int32(w.t.shape[1]) - q_len(w)
+
+
+def wheel_push_many(w: TimerWheel, pushes) -> TimerWheel:
+    """Push routed timer events (same (mask, t, order, kind, payload)
+    tuples as the queue ops). The CALLER masks overflow away via
+    `wheel_free` (core/engine._route_timer_pushes) — by that contract the
+    running-occupancy push can never hit a full wheel."""
+    return bq_push_many(w, pushes)
+
+
+def wheel_pop_min(w: TimerWheel, limit) -> tuple[TimerWheel, "object", Array]:
+    """Pop each host's earliest due timer strictly before `limit` (i64
+    scalar or [H]) — identical semantics to `q_pop_min`; the engine
+    merges the result with the queue pop under the (time, order)
+    tie-break so dispatch order is bit-identical to the wheel-off path."""
+    return bq_pop_min(w, limit)
+
+
+def wheel_cancel(w: TimerWheel, mask, order) -> tuple[TimerWheel, Array]:
+    """Cancel (remove without firing) the pending timer whose packed
+    order key equals `order[h]` for each masked host. Returns
+    (wheel', found bool[H]).
+
+    Order keys are globally unique (ops/events.pack_order), so at most
+    one slot per host can match — the removal is one masked compare over
+    the [H, S] key plane plus a victim-block cache recompute, the
+    grouped-sorting-queue "dynamic update" with no re-sort. A miss
+    (timer already fired, spilled to the queue, or never existed) leaves
+    the wheel untouched and reports found=False — callers that must
+    cancel spilled timers fall back to their queue-side lazy-cancel
+    path."""
+    mask = jnp.asarray(mask, bool)
+    order = jnp.asarray(order, jnp.int64)
+    h, s = w.t.shape
+    nb = w.bt.shape[1]
+    b = s // nb
+    hit = mask[:, None] & (w.order == order[:, None]) & (w.t != TIME_MAX)
+    found = jnp.any(hit, axis=1)
+    new_t = jnp.where(hit, TIME_MAX, w.t)
+    new_order = jnp.where(hit, ORDER_MAX, w.order)
+    hit3 = hit.reshape(h, nb, b)
+    touched = jnp.any(hit3, axis=2)  # [H, WB] blocks that lost their slot
+    t3 = new_t.reshape(h, nb, b)
+    o3 = new_order.reshape(h, nb, b)
+    nbt = jnp.min(t3, axis=2)
+    nbo = jnp.min(jnp.where(t3 == nbt[:, :, None], o3, ORDER_MAX), axis=2)
+    return (
+        w._replace(
+            t=new_t,
+            order=new_order,
+            bt=jnp.where(touched, nbt, w.bt),
+            bo=jnp.where(touched, nbo, w.bo),
+            # dtype pinned: the i32 fill cache must not widen through the
+            # sum (registry width, core/lanes.py)
+            bfill=w.bfill - jnp.sum(hit3, axis=2, dtype=jnp.int32),
+        ),
+        found,
+    )
+
+
+def migrate_wheel(w: TimerWheel, new_slots: int, block: int = 0) -> TimerWheel:
+    """Re-seat the wheel at `new_slots` slots per host — the checkpoint
+    cross-shape restore path (core/checkpoint.py). Same exactness
+    argument as `migrate_queue` (slot positions unobservable); the
+    caller checks `migration_fits` before a shrink."""
+    return migrate_queue(w, new_slots, block=resolve_wheel_block(new_slots, block))

@@ -509,6 +509,16 @@ class Simulation:
                     f"pressure.max_outbox={press.max_outbox} is below the "
                     f"configured send budget {send_budget}"
                 )
+        # timer wheel (ops/wheel.py): validated here so a model with no
+        # timer_kinds fails at config parse, not engine build
+        if ex.timer_wheel and not tuple(
+            getattr(self.model, "timer_kinds", ())
+        ):
+            raise ConfigError(
+                f"experimental.timer_wheel: model {self.model.name!r} "
+                f"declares no timer_kinds — nothing would route to the "
+                f"wheel; drop the knob or use a model with timers"
+            )
         self.engine_cfg = EngineConfig(
             num_hosts=num_hosts,
             stop_time=cfg.general.stop_time,
@@ -561,6 +571,12 @@ class Simulation:
             # code (the default program stays byte-identical)
             integrity=cfg.integrity.enabled,
             integrity_dual=cfg.integrity.enabled and cfg.integrity.dual_digest,
+            # timer wheel + sort-free calendar merge (ops/wheel.py,
+            # ops/merge.py): both off by default — the default program
+            # stays byte-identical (jaxpr fingerprints are the gate)
+            wheel_slots=ex.timer_wheel,
+            wheel_block=ex.timer_wheel_block,
+            merge_scatter=ex.merge_scatter,
         )
         # occupancy-adaptive merge gears (core/gears.py): resolved against
         # the (possibly auto-sized) send budget; [] = disabled
@@ -1153,6 +1169,23 @@ class Simulation:
                 self._model_hosts(),
             ),
         }
+        if self.engine_cfg.wheel_active:
+            # timer-wheel block (ops/wheel.py): occupancy high-water +
+            # spill count — the slot-sizing signal (tools/bench_wheel.py
+            # sweeps S; tools/net_report.py breaks this out in its
+            # verdict). wheel_dropped is an invariant zero (spill
+            # routing pre-empts overflow; the sentinel guards it).
+            report["wheel"] = {
+                "slots": self.engine_cfg.wheel_slots,
+                "block": self.state.wheel.block,
+                "occupancy_hwm": int(s.wheel_occ_hwm[:n].max()) if n else 0,
+                "spilled": int(s.wheel_spilled[:n].sum()),
+                "dropped": int(
+                    np.asarray(
+                        jax.device_get(self.state.wheel.dropped)
+                    )[:n].sum()
+                ),
+            }
         if self._gearctl is not None:
             report["gears"] = self._gearctl.report()
         if self._pressctl is not None:

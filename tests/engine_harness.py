@@ -40,6 +40,9 @@ def build_sim(
     bootstrap_end: int = 0,
     rounds_per_chunk: int = 64,
     microstep_limit: int = 0,
+    wheel_slots: int = 0,
+    wheel_block: int = 0,
+    merge_scatter: bool = False,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
@@ -92,6 +95,9 @@ def build_sim(
             integrity if integrity_dual is None else integrity_dual
         ),
         merge_rows=merge_rows,
+        wheel_slots=wheel_slots,
+        wheel_block=wheel_block,
+        merge_scatter=merge_scatter,
         **fault_kw,
     )
     model = get_model(model_name)()

@@ -162,6 +162,64 @@ def test_r4_fires_on_item_and_lane_int(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# R6: timer-wheel registry lockstep
+# --------------------------------------------------------------------------
+
+
+def test_r6_fires_on_wheel_registry_drift(tmp_path):
+    """Every failure mode of the wheel/queue width lockstep: a width
+    disagreement, an unpaired wheel lane, a missing shape entry, and a
+    BucketQueue field with no wheel.* registration."""
+    _mk(tmp_path, "shadow_tpu/core/lanes.py", """
+        STATE_LANES = {
+            "queue.t": "int64",
+            "queue.order": "int64",
+            "wheel.t": "int32",
+            "wheel.order": "int64",
+            "wheel.ghost": "int64",
+        }
+        STATE_LANE_SHAPES = {
+            "queue.t": ("H", "C"),
+            "wheel.t": ("H", "WS"),
+            "wheel.order": ("H", "WS"),
+        }
+        WHEEL_LANE_OF_QUEUE = {
+            "wheel.t": "queue.t",
+            "wheel.order": "queue.order",
+            "wheel.ghost": "queue.nonexistent",
+        }
+        STATS_EXPORT_EXEMPT = {}
+        HEARTBEAT_LEGACY_KEYS = frozenset()
+        LANE_WIDTHS = {}
+        FUNC_RETURN_LANES = {}
+        BITS = {"int64": 64, "int32": 32}
+        def lane_width_bits(name):
+            return None
+    """)
+    _mk(tmp_path, "shadow_tpu/ops/events.py", """
+        from typing import NamedTuple
+
+        class BucketQueue(NamedTuple):
+            t: int
+            order: int
+            extra_plane: int
+    """)
+    proj = Project(str(tmp_path), extra_dirs=())
+    fs = lint_schema.check_wheel_registry(proj)
+    msgs = "\n".join(f.msg for f in fs)
+    assert "disagree on width" in msgs, msgs  # wheel.t int32 vs queue.t int64
+    assert "`queue.nonexistent`, which is not in STATE_LANES" in msgs, msgs
+    assert "wheel.ghost has no STATE_LANE_SHAPES entry" in msgs, msgs
+    assert "BucketQueue.extra_plane" in msgs, msgs
+    assert "`wheel.ghost` is registered but BucketQueue" in msgs, msgs
+
+
+def test_r6_clean_on_repo():
+    proj = Project(REPO)
+    assert lint_schema.check_wheel_registry(proj) == []
+
+
+# --------------------------------------------------------------------------
 # R3: stats schema + trace columns
 # --------------------------------------------------------------------------
 

@@ -216,6 +216,47 @@ def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
             add("integrity", name, "warning",
                 "OLD carried an integrity block, NEW has none "
                 "(sentinel coverage lost)")
+        # timer-wheel block (PR 12, bench config 11): with the wheel
+        # enabled, the event-class accounting must still reconcile
+        # EXACTLY (timer + packet + app == total — ec_timer is the
+        # wheel's traffic, so drift here means the wheel routing lost or
+        # double-counted events), the wheel may never drop (spill
+        # routing pre-empts overflow), and spill growth is a sizing
+        # warning.
+        o_wh = (o.get("counters") or {}).get("wheel")
+        n_wh = (n.get("counters") or {}).get("wheel")
+        if isinstance(n_wh, dict):
+            if n_wh.get("dropped"):
+                add("wheel", name, "regression",
+                    f"wheel dropped {n_wh['dropped']} events — the "
+                    f"spill-to-queue contract makes this structurally "
+                    f"zero; the wheel lost events")
+            n_ec = (n.get("network") or {}).get("event_classes") or {}
+            tot = n_ec.get("total")
+            if isinstance(tot, (int, float)):
+                parts = (
+                    (n_ec.get("timer") or 0)
+                    + (n_ec.get("packet") or 0)
+                    + (n_ec.get("app") or 0)
+                )
+                if parts != tot:
+                    add("wheel", name, "regression",
+                        f"event-class reconciliation drift with the "
+                        f"wheel enabled: timer+packet+app = {parts} != "
+                        f"total {tot}")
+            os_ = (o_wh or {}).get("spilled", 0) if isinstance(
+                o_wh, dict
+            ) else 0
+            ns_ = n_wh.get("spilled", 0)
+            if ns_ > os_:
+                add("wheel", name, "warning",
+                    f"wheel spill count grew {os_} -> {ns_} (exact but "
+                    f"paying the queue path — size slots up, "
+                    f"tools/bench_wheel.py)")
+        elif isinstance(o_wh, dict) and n_wh is None:
+            add("wheel", name, "warning",
+                "OLD carried a wheel block, NEW has none (wheel "
+                "coverage lost)")
     for name in sorted(set(new) - set(old)):
         add("coverage", name, "info", "new metric (no baseline)")
     return findings

@@ -1,0 +1,207 @@
+"""Sweep timer-wheel slot counts (ISSUE 12 satellite).
+
+Two legs, mirroring tools/bench_bucketq.py / bench_popk.py:
+
+  pair leg  — the microstep-visible op pair in isolation: the merged
+              queue∪wheel head-compare + pop + timer push against the
+              queue-only pop + push it replaces, at H hosts, queue
+              capacity C, and a ladder of wheel slot counts S. Shows the
+              raw per-microstep delta the wheel costs/saves.
+
+  e2e leg   — a small tgen-TCP engine run (the flagship model) end to
+              end at each S (plus the wheel-off baseline), reporting
+              wall-clock, wheel occupancy high-water, and spill counts —
+              the slot-sizing signal: pick the smallest S whose spill
+              count is zero (a spilled timer is exact but pays the queue
+              path it was supposed to leave).
+
+Usage:  python tools/bench_wheel.py [--hosts 10000] [--cap 28]
+            [--slots 2,4,8,16] [--iters 50] [--e2e] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _mk_queue(h: int, cap: int, fill: int, seed: int):
+    from shadow_tpu.ops.events import make_queue, pack_order, push_one
+
+    rng = np.random.default_rng(seed)
+    q = make_queue(h, cap)
+    for j in range(fill):
+        t = rng.integers(1_000, 1_000_000, size=h).astype(np.int64)
+        order = np.asarray(pack_order(1, np.arange(h), np.full(h, j)))
+        q = push_one(
+            q, jnp.ones((h,), bool), jnp.asarray(t), jnp.asarray(order),
+            jnp.full((h,), 3, jnp.int32), jnp.zeros((h, 4), jnp.int32),
+        )
+    return q
+
+
+def bench_pair(h: int, cap: int, slots: int, iters: int) -> dict:
+    """Median wall of one jitted (pop + push) step: queue-only baseline
+    vs merged queue∪wheel with the timer push routed to the wheel."""
+    from shadow_tpu.core.engine import _pop_min_merged
+    from shadow_tpu.ops.events import pack_order, q_pop_min, q_push_many
+    from shadow_tpu.ops.wheel import make_wheel, wheel_push_many
+
+    q = _mk_queue(h, cap, fill=max(cap // 2, 1), seed=1)
+    limit = jnp.int64(2_000_000)
+    t_new = jnp.full((h,), 500_000, jnp.int64)
+    order_new = jnp.asarray(pack_order(1, jnp.arange(h), jnp.full((h,), 99)))
+    kind = jnp.full((h,), 3, jnp.int32)
+    payload = jnp.zeros((h, 4), jnp.int32)
+    mask = jnp.ones((h,), bool)
+
+    @jax.jit
+    def base(queue):
+        queue, ev, active = q_pop_min(queue, limit)
+        return q_push_many(queue, [(mask, t_new, order_new, kind, payload)])
+
+    w = make_wheel(h, slots)
+    # pre-load the wheel halfway so pops/pushes touch realistic caches
+    for j in range(max(slots // 2, 1)):
+        o = jnp.asarray(pack_order(1, jnp.arange(h), jnp.full((h,), 50 + j)))
+        w = wheel_push_many(
+            w, [(mask, jnp.full((h,), 800_000 + j, jnp.int64), o, kind,
+                 payload)]
+        )
+
+    @jax.jit
+    def wheeled(queue, wheel):
+        queue, wheel, ev, active = _pop_min_merged(queue, wheel, limit)
+        wheel = wheel_push_many(
+            wheel, [(mask, t_new, order_new, kind, payload)]
+        )
+        return queue, wheel
+
+    def timeit(fn, *args):
+        fn(*args)  # compile
+        walls = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls))
+
+    t_base = timeit(base, q)
+    t_wheel = timeit(wheeled, q, w)
+    return {
+        "hosts": h, "cap": cap, "slots": slots, "iters": iters,
+        "queue_only_us": round(t_base * 1e6, 1),
+        "merged_us": round(t_wheel * 1e6, 1),
+        "ratio": round(t_wheel / max(t_base, 1e-12), 3),
+    }
+
+
+def bench_e2e(slots: int, hosts: int = 60, stop_s: int = 20) -> dict:
+    """Small tgen-TCP engine leg at one wheel size (0 = off baseline)."""
+    from tests.engine_harness import build_sim, mk_hosts
+    from shadow_tpu.core.engine import Engine
+
+    cfg, model, params, mstate, events = build_sim(
+        "tgen_tcp",
+        mk_hosts(hosts, {"flow_segs": 12, "flows": 4, "cwnd_cap": 8,
+                         "rto_min": "100 ms"}),
+        stop_s * 1_000_000_000,
+        loss=0.03, latency=10_000_000, sends_budget=16, qcap=28,
+        queue_block=7, wheel_slots=slots, rounds_per_chunk=256,
+    )
+    eng = Engine(cfg, model)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    state = eng.run_chunk(state, params)  # compile + first chunk
+    t0 = time.perf_counter()
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        if chunks > 2000:
+            raise SystemExit("e2e leg failed to terminate")
+    jax.block_until_ready(state.stats.events)
+    wall = time.perf_counter() - t0
+    s = jax.device_get(state.stats)
+    out = {
+        "slots": slots,
+        "wall_s": round(wall, 3),
+        "sim_s_per_wall_s": round(
+            int(state.now) / 1e9 / max(wall, 1e-9), 2
+        ),
+        "events": int(np.asarray(s.events).sum()),
+        "digest_xor": f"{int(np.bitwise_xor.reduce(s.digest)):016x}",
+    }
+    if slots:
+        out["wheel_occ_hwm"] = int(np.asarray(s.wheel_occ_hwm).max())
+        out["wheel_spilled"] = int(np.asarray(s.wheel_spilled).sum())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hosts", type=int, default=10_000)
+    ap.add_argument("--cap", type=int, default=28)
+    ap.add_argument("--slots", default="2,4,8,16")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--e2e", action="store_true",
+                    help="also run the small tgen end-to-end ladder")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    ladder = [int(s) for s in str(args.slots).split(",") if s]
+
+    rows = {"pair": [], "e2e": []}
+    for s in ladder:
+        r = bench_pair(args.hosts, args.cap, s, args.iters)
+        rows["pair"].append(r)
+        if not args.json:
+            print(
+                f"pair H={r['hosts']} C={r['cap']} S={s}: "
+                f"queue-only {r['queue_only_us']} us, merged "
+                f"{r['merged_us']} us (x{r['ratio']})"
+            )
+    if args.e2e:
+        base = bench_e2e(0)
+        rows["e2e"].append(base)
+        if not args.json:
+            print(f"e2e S=off: {base['sim_s_per_wall_s']} sim-s/wall-s "
+                  f"digest {base['digest_xor']}")
+        for s in ladder:
+            r = bench_e2e(s)
+            rows["e2e"].append(r)
+            if not args.json:
+                match = "OK" if r["digest_xor"] == base["digest_xor"] else (
+                    "DIGEST MISMATCH"
+                )
+                print(
+                    f"e2e S={s}: {r['sim_s_per_wall_s']} sim-s/wall-s, "
+                    f"occ_hwm {r['wheel_occ_hwm']}, spilled "
+                    f"{r['wheel_spilled']} [{match}]"
+                )
+        bad = [r for r in rows["e2e"][1:]
+               if r["digest_xor"] != base["digest_xor"]]
+        if bad:
+            print("FAIL: wheel digests diverged from the off baseline",
+                  file=sys.stderr)
+            return 1
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

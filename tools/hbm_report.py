@@ -252,6 +252,11 @@ def main(argv=None) -> int:
                    "allocator limit, else 15.75)")
     p.add_argument("--safety", type=float, default=1.25)
     p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--wheel-slots", type=int, default=None,
+                   help="price the timer wheel at S slots per host "
+                   "(overrides experimental.timer_wheel in the config; "
+                   "the wheel planes then appear as their own component "
+                   "in the capacity plan)")
     p.add_argument("--tol", type=float, default=0.10)
     p.add_argument("--no-ledger", action="store_true")
     p.add_argument("--json", action="store_true")
@@ -277,6 +282,17 @@ def main(argv=None) -> int:
             cfg_dict = yaml.safe_load(f.read())
     else:
         cfg_dict = flagship_config_dict()
+    if args.wheel_slots is not None:
+        # the wheel charges H x S event rows + the block caches; the
+        # registry-driven byte model prices it like every other plane,
+        # so the max-hosts/device prediction accounts for wheel bytes.
+        # microstep_events pins to 1: the wheel rejects K > 1 (the
+        # flagship config wires K=4 on TPU backends — exactly where the
+        # planner runs), and K does not change state bytes, so the
+        # priced shape is unaffected.
+        ex = cfg_dict.setdefault("experimental", {})
+        ex["timer_wheel"] = args.wheel_slots
+        ex["microstep_events"] = 1
 
     if args.check_worker:
         return run_check(cfg_dict, args.tol)

@@ -86,6 +86,18 @@ AUDIT_CONFIGS = {
         stop=200_000_000,
         kw=dict(qcap=16, integrity=True),
     ),
+    # timer wheel + sort-free calendar merge ON (ISSUE 12): the wheel
+    # carry lanes, merged queue∪wheel pops, spill routing, and the
+    # scatter-merge fast/fallback cond traced in — pins the GATED
+    # program's compile surface (and audits the wheel.* lane dtypes)
+    # while `echo`/`phold` above pin that the default (wheel-off)
+    # programs stay byte-unchanged.
+    "phold_wheel": dict(
+        model="phold",
+        hosts=None,  # mk_hosts(4) below
+        stop=200_000_000,
+        kw=dict(qcap=16, wheel_slots=8, merge_scatter=True),
+    ),
 }
 
 
@@ -184,7 +196,7 @@ def run_audit(
     root: str | None = None,
     update: bool = False,
     configs: tuple[str, ...] = (
-        "echo", "phold", "tgen_netobs", "phold_integrity",
+        "echo", "phold", "tgen_netobs", "phold_integrity", "phold_wheel",
     ),
     fingerprint_file: str = FINGERPRINT_FILE,
 ):

@@ -390,6 +390,99 @@ def check_heartbeat_compat(
     return out
 
 
+# --------------------------------------------------------------------------
+# R6: timer-wheel registry lockstep
+# --------------------------------------------------------------------------
+
+
+def check_wheel_registry(project: Project) -> list[Finding]:
+    """The timer wheel (ops/wheel.py) reuses the BucketQueue machinery,
+    so every wheel array's dtype/width MUST be sourced from the lane
+    registry and stay in lockstep with its queue counterpart — the shared
+    ops read and write both structures through one code path, and a width
+    drifting on one side silently reinterprets bits on the other.
+
+    Checks (all against core/lanes.py, the single source):
+      1. every `wheel.*` path in STATE_LANES is paired in
+         WHEEL_LANE_OF_QUEUE (and vice versa), the paired `queue.*` path
+         exists, and the two registered widths AGREE;
+      2. every `wheel.*` path has a STATE_LANE_SHAPES entry (the HBM
+         byte model prices the wheel like every other plane);
+      3. the field set of the BucketQueue NamedTuple (ops/events.py —
+         the wheel's actual layout) equals the set of registered
+         `wheel.<field>` paths, so adding a plane to the shared
+         structure without registering the wheel's copy fails lint."""
+    out: list[Finding] = []
+    lanes = project.lanes
+    lanes_path = "shadow_tpu/core/lanes.py"
+    pairing = getattr(lanes, "WHEEL_LANE_OF_QUEUE", None)
+    if pairing is None:
+        return [Finding(
+            "R6", lanes_path, 1, "WHEEL_LANE_OF_QUEUE registry missing",
+        )]
+    wheel_paths = {p for p in lanes.STATE_LANES if p.startswith("wheel.")}
+    for p in sorted(wheel_paths - set(pairing)):
+        out.append(Finding(
+            "R6", lanes_path, 1,
+            f"{p} is registered in STATE_LANES but has no "
+            f"WHEEL_LANE_OF_QUEUE pairing — state which queue lane its "
+            f"width mirrors",
+        ))
+    for wp, qp in sorted(pairing.items()):
+        if wp not in lanes.STATE_LANES:
+            out.append(Finding(
+                "R6", lanes_path, 1,
+                f"WHEEL_LANE_OF_QUEUE names `{wp}`, which is not in "
+                f"STATE_LANES",
+            ))
+            continue
+        if qp not in lanes.STATE_LANES:
+            out.append(Finding(
+                "R6", lanes_path, 1,
+                f"{wp} pairs to `{qp}`, which is not in STATE_LANES",
+            ))
+            continue
+        if lanes.STATE_LANES[wp] != lanes.STATE_LANES[qp]:
+            out.append(Finding(
+                "R6", lanes_path, 1,
+                f"{wp} ({lanes.STATE_LANES[wp]}) and {qp} "
+                f"({lanes.STATE_LANES[qp]}) disagree on width — the "
+                f"wheel reuses the queue machinery, widths must move in "
+                f"lockstep",
+            ))
+    for p in sorted(wheel_paths):
+        if p not in lanes.STATE_LANE_SHAPES:
+            out.append(Finding(
+                "R6", lanes_path, 1,
+                f"{p} has no STATE_LANE_SHAPES entry — the HBM byte "
+                f"model cannot price the wheel plane",
+            ))
+    ev = project.modules.get("shadow_tpu.ops.events")
+    if ev is not None:
+        cls = _find_class(ev.tree, "BucketQueue")
+        if cls is None:
+            out.append(Finding(
+                "R6", ev.path, 1, "BucketQueue NamedTuple not found",
+            ))
+        else:
+            fields = set(_namedtuple_fields(cls))
+            registered = {p.split(".", 1)[1] for p in wheel_paths}
+            for f in sorted(fields - registered):
+                out.append(Finding(
+                    "R6", lanes_path, 1,
+                    f"BucketQueue.{f} (the wheel's layout) has no "
+                    f"`wheel.{f}` registry entry — register its "
+                    f"width/shape so the audit and byte model see it",
+                ))
+            for f in sorted(registered - fields):
+                out.append(Finding(
+                    "R6", lanes_path, 1,
+                    f"`wheel.{f}` is registered but BucketQueue has no "
+                    f"such field",
+                ))
+    return out
+
+
 def run_schema_rules(
     root: str | None = None, project: Project | None = None
 ) -> list[Finding]:
@@ -399,4 +492,5 @@ def run_schema_rules(
     findings += check_stats_schema(project)
     findings += check_trace_columns(project)
     findings += check_heartbeat_compat(project)
+    findings += check_wheel_registry(project)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.msg))
