@@ -13,7 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "events",
 tracked since round 1 as a secondary continuity metric.
 
 Usage: python bench.py                    (full: TPU + CPU-subprocess baseline)
-       python bench.py --config N [--cpu] (one BASELINE config, 1-11)
+       python bench.py --config N [--cpu] (one BASELINE config, 1-12)
        python bench.py --self [--cpu]     (bare PHOLD ratio, prints a float)
 """
 
@@ -530,7 +530,50 @@ def baseline_config(n: int, small: bool) -> tuple[dict, str, int]:
         ex["microstep_events"] = 1
         cfg["observability"] = {"network": True}
         return cfg, "tgen_tcp_wheel_sim_seconds_per_wall_second", stop_s
-    raise SystemExit(f"unknown --config {n} (1-11 supported)")
+    if n == 12:
+        # fluid-traffic-plane bench (PR 13): the flagship tgen-TCP torus
+        # (config 6) as the packet-exact FOREGROUND plus a flash-crowd
+        # BACKGROUND schedule on the fluid plane — the first ISP/CDN-
+        # scale scenario shape the pure packet engine cannot reach
+        # (emulating the crowd packet-exactly would blow the event
+        # budget). Four staggered background classes converge on torus
+        # node 0 from t=5s (the flash ramp — EARLY, inside the
+        # foreground's active phase: the fluid plane is passive, it
+        # generates no events, so a drained foreground ends the sim
+        # regardless of pending background windows), each demanding most
+        # of a 2 Gbit access link, so background bytes dwarf the tgen
+        # foreground byte volume while
+        # the DropTail clip and the >= 1.0x latency coupling stay
+        # honest: coupling is latency-only here (loss_max 0), so the
+        # foreground sees congestion as inflated RTTs — zero unexplained
+        # drops — and the FCT distribution (network{} block) quantifies
+        # the foreground cost against config 6's fluid-off calibration.
+        # The fluid{} block carries bg_bytes/bg_dropped for
+        # tools/bench_compare.py's coverage gates.
+        cfg, _, stop_s = baseline_config(6, small)
+        cfg["observability"] = {"network": True}
+        # shorter chunks than config 6's 256: on this box the documented
+        # jaxlib-0.4.37 corruption (docs/corruption.md) hits the
+        # inflated-RTT execution profile's LONG single dispatches at a
+        # very high per-attempt rate (rpc=256 aborted ~9/10 attempts
+        # with glibc "corrupted double-linked list"; rpc<=128 completes
+        # with BIT-IDENTICAL results — bg/digest equal across every
+        # surviving rpc, so this is dispatch-length exposure, not a
+        # results change). 128 keeps the leg inside the classify-then-
+        # retry posture's budget.
+        cfg["experimental"]["rounds_per_chunk"] = 128
+        cfg["fluid"] = {
+            "link_capacity": "2 Gbit",
+            "latency_factor_max": 1.5,
+            "util_threshold": 0.5,
+            "classes": [
+                {"name": f"crowd{i}", "src_zone": z, "dst_zone": 0,
+                 "rate": "1500 Mbit", "start": f"{5 + i} s"}
+                for i, z in enumerate((1, 2, 3, 5))
+            ],
+        }
+        return cfg, "tgen_tcp_fluid_sim_seconds_per_wall_second", stop_s
+    raise SystemExit(f"unknown --config {n} (1-12 supported)")
 
 
 def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
@@ -820,6 +863,21 @@ def _bench_network(sim, state, s, netcol) -> dict:
         model_state=model_view,
         flow_ledger=sim.engine_cfg.flow_ledger_active,
         collector=netcol,
+    ))
+
+
+def _bench_fluid(sim, state, s) -> dict:
+    """The BENCH row's compact fluid{} block: the SAME shared assembly
+    sim-stats uses (net/fluid.assemble_fluid_report), compacted to the
+    diffable bench shape — rows cannot drift from sim-stats."""
+    import jax as _jax
+
+    from shadow_tpu.net.fluid import assemble_fluid_report, bench_fluid_block
+
+    return bench_fluid_block(assemble_fluid_report(
+        stats=s,
+        fluid_state=_jax.device_get(state.fluid),
+        cfg=sim.engine_cfg,
     ))
 
 
@@ -1155,6 +1213,14 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         },
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
+        # fluid block (fluid traffic plane, PR 13): the background
+        # byte/drop accounting and hot-link utilization — diffed by
+        # tools/bench_compare.py as background-coverage gates (the
+        # foreground cost shows up in the network{} FCT gates)
+        **(
+            {"fluid": _bench_fluid(sim, state, s)}
+            if sim.engine_cfg.fluid_active else {}
+        ),
         # network block (network observatory, PR 10): the timer-vs-packet
         # event share ROADMAP item 2's timer-wheel decision gates on, the
         # FCT distribution, and the per-link hot-spot — diffed by

@@ -656,6 +656,120 @@ class IntegrityOptions:
 
 
 @dataclass
+class FluidClassOptions:
+    """One background traffic class of the fluid plane (net/fluid.py):
+    an aggregate src-zone -> dst-zone demand active over [start, end).
+    Zones are graph node ids — the class occupies BOTH zones' access
+    links. Compose flash crowds from several staggered windows."""
+
+    src_zone: int = 0
+    dst_zone: int = 0
+    rate: int = 0  # offered demand while active, bits/sec
+    start: int = 0  # ns
+    end: int = 0  # ns; 0 = the simulation horizon
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "FluidClassOptions":
+        d = dict(d)
+        if "rate" not in d:
+            raise ConfigError("fluid.classes entries need a rate")
+        d.pop("name", None)  # labels are allowed, purely documentary
+        c = FluidClassOptions(
+            src_zone=int(d.pop("src_zone", 0)),
+            dst_zone=int(d.pop("dst_zone", 0)),
+            rate=parse_bits_per_sec(d.pop("rate")),
+            start=parse_time_ns(d.pop("start", 0), TimeUnit.SEC),
+            end=parse_time_ns(d.pop("end", 0), TimeUnit.SEC),
+        )
+        if c.rate <= 0:
+            raise ConfigError(
+                f"fluid.classes: rate must be > 0, got {c.rate}"
+            )
+        if c.end and c.end <= c.start:
+            raise ConfigError(
+                f"fluid.classes: end {c.end} <= start {c.start}"
+            )
+        if d:
+            raise ConfigError(f"unknown fluid class options: {sorted(d)}")
+        return c
+
+
+@dataclass
+class FluidOptions:
+    """The fluid traffic plane (net/fluid.py + docs/architecture.md
+    "Fluid traffic plane"): background-flow rate ODEs advanced once per
+    round inside the jitted round body, conservatively coupled to the
+    packet engine — background utilization inflates foreground latency
+    (>= 1.0x, the fault plane's conservative rule) and optionally loss,
+    while measured foreground bytes subtract from fluid capacity. With
+    the block absent (no classes) the engine traces ZERO fluid code and
+    the default program is byte-identical to the fluid-free build."""
+
+    # per-link (graph-node access aggregate) capacity, bits/sec
+    link_capacity: int = parse_bits_per_sec("1 Gbit")
+    # rate-relaxation time constant of the forward-Euler ODE (ns)
+    tau: int = parse_time_ns("50 ms")
+    # offered utilization where coupling starts ramping (RED min-th)
+    util_threshold: float = 0.7
+    # extra foreground loss probability at full overload (0 = coupling
+    # is latency-only; drops land in pkts_lost, attributed per link by
+    # the network observatory's links fold)
+    loss_max: float = 0.0
+    # foreground latency multiplier at full overload; >= 1.0 (inflation
+    # only — deflation would break the conservative-lookahead bound)
+    latency_factor_max: float = 2.0
+    seed: int | None = None  # None = general.seed (the loss-draw hash)
+    classes: list[FluidClassOptions] = field(default_factory=list)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.classes)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any] | None) -> "FluidOptions":
+        d = dict(d or {})
+        seed = d.pop("seed", None)
+        f = FluidOptions(
+            link_capacity=parse_bits_per_sec(
+                d.pop("link_capacity", "1 Gbit")
+            ),
+            tau=parse_time_ns(d.pop("tau", "50 ms"), TimeUnit.MS),
+            util_threshold=float(d.pop("util_threshold", 0.7)),
+            loss_max=float(d.pop("loss_max", 0.0)),
+            latency_factor_max=float(d.pop("latency_factor_max", 2.0)),
+            seed=int(seed) if seed is not None else None,
+            classes=[
+                FluidClassOptions.from_dict(c)
+                for c in d.pop("classes", []) or []
+            ],
+        )
+        if f.link_capacity <= 0:
+            raise ConfigError(
+                f"fluid.link_capacity must be > 0, got {f.link_capacity}"
+            )
+        if f.tau <= 0:
+            raise ConfigError(f"fluid.tau must be > 0, got {f.tau}")
+        if not 0.0 <= f.util_threshold < 1.0:
+            raise ConfigError(
+                f"fluid.util_threshold must be in [0, 1), "
+                f"got {f.util_threshold}"
+            )
+        if not 0.0 <= f.loss_max <= 1.0:
+            raise ConfigError(
+                f"fluid.loss_max must be in [0, 1], got {f.loss_max}"
+            )
+        if f.latency_factor_max < 1.0:
+            raise ConfigError(
+                f"fluid.latency_factor_max must be >= 1.0 (got "
+                f"{f.latency_factor_max}; deflation would shrink latency "
+                f"below the conservative-lookahead bound)"
+            )
+        if d:
+            raise ConfigError(f"unknown fluid options: {sorted(d)}")
+        return f
+
+
+@dataclass
 class FaultChurnOptions:
     """Seeded host-churn: each host crashes once with probability `prob`
     at a uniform time in [bootstrap_end_time, stop_time), down for an
@@ -1153,6 +1267,7 @@ class ConfigOptions:
     faults: FaultOptions = field(default_factory=FaultOptions)
     pressure: PressureOptions = field(default_factory=PressureOptions)
     integrity: IntegrityOptions = field(default_factory=IntegrityOptions)
+    fluid: FluidOptions = field(default_factory=FluidOptions)
     campaign: CampaignOptions = field(default_factory=CampaignOptions)
     host_option_defaults: HostDefaultOptions = field(default_factory=HostDefaultOptions)
     hosts: list[HostOptions] = field(default_factory=list)
@@ -1185,6 +1300,7 @@ class ConfigOptions:
             faults=FaultOptions.from_dict(d.pop("faults", None)),
             pressure=PressureOptions.from_dict(d.pop("pressure", None)),
             integrity=IntegrityOptions.from_dict(d.pop("integrity", None)),
+            fluid=FluidOptions.from_dict(d.pop("fluid", None)),
             campaign=CampaignOptions.from_dict(d.pop("campaign", None)),
             host_option_defaults=defaults,
             hosts=hosts,

@@ -121,6 +121,14 @@ from shadow_tpu.obs.tracer import (
     COL_FLOWS,
 )
 from shadow_tpu.obs.netobs import FlowLedger, make_flow_ledger
+from shadow_tpu.net.fluid import (
+    FluidParams,
+    FluidState,
+    fluid_advance,
+    fluid_host_effects,
+    fluid_send_uniform,
+    make_fluid_state,
+)
 from shadow_tpu.ops.events import kind_in
 from shadow_tpu.core.faults import (
     FaultParams,
@@ -280,6 +288,14 @@ class Stats(NamedTuple):
     # sampled once per round like q_occ_hwm.
     wheel_spilled: Any = None  # i64[H] | None
     wheel_occ_hwm: Any = None  # i64[H] | None
+    # Fluid traffic plane (net/fluid.py; None unless cfg.fluid_active —
+    # the default program carries neither and stays byte-identical).
+    # Cumulative background bytes the fluid ODE delivered / DropTail-
+    # dropped, as REPLICATED i64 scalars (shape (), like stats.rounds):
+    # the ODE is global, computed identically on every shard from psum'd
+    # inputs, so a per-shard lane would multiply the total at export.
+    fl_bg_bytes: Any = None  # i64[] | None
+    fl_bg_dropped: Any = None  # i64[] | None
 
 
 class SimState(NamedTuple):
@@ -317,6 +333,12 @@ class SimState(NamedTuple):
     # queue ∪ wheel, so dispatch order is bit-identical to wheel-off
     # (tests/test_wheel.py is the gate).
     wheel: Any = None  # TimerWheel | None
+    # fluid traffic plane (net/fluid.py): None unless cfg.fluid_active.
+    # The background-flow ODE's carry lanes (per-class carried rates +
+    # per-link offered utilization), advanced once per round inside the
+    # round body; replicated across the mesh (the ODE is global math
+    # over psum'd foreground byte counts, identical on every shard).
+    fluid: Any = None  # FluidState | None
 
 
 class EngineParams(NamedTuple):
@@ -352,6 +374,11 @@ class EngineParams(NamedTuple):
     # when the `faults:` block is absent — the engine then traces no fault
     # code at all and the program is bit-identical to the fault-free build.
     faults: Any = None  # FaultParams | None
+    # compiled fluid schedule (net/fluid.py FluidParams): per-class
+    # zones/demand/windows + per-link capacity, all replicated (classes
+    # and links are global). None when the `fluid:` block declares no
+    # classes — the engine then traces no fluid code at all.
+    fluid: Any = None  # FluidParams | None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -550,6 +577,19 @@ class EngineConfig:
     # digests/events/drops are bit-identical on every workload. False
     # (default) keeps the sort merge and traces no scatter code.
     merge_scatter: bool = False
+    # Fluid traffic plane statics (net/fluid.py; config `fluid:`). The
+    # ARRAYS live in EngineParams.fluid; these are the trace-time
+    # shape/coupling knobs the round body specializes on. fluid_classes
+    # = 0 (the default) traces ZERO fluid code — the program is
+    # byte-identical to the fluid-free engine (the default jaxpr
+    # fingerprints are the gate; `tgen_fluid` pins the gated surface).
+    fluid_classes: int = 0  # K background traffic classes
+    fluid_links: int = 0  # N links (graph nodes) the ODE state covers
+    fluid_tau_ns: int = 50_000_000  # rate-relaxation time constant
+    fluid_util_threshold: float = 0.7  # coupling ramp start (RED min-th)
+    fluid_loss_max: float = 0.0  # extra fg loss prob at full overload
+    fluid_lat_max_x1000: int = 2000  # fg latency multiplier cap (x1000)
+    fluid_seed: int = 1  # the counter-based loss-draw hash seed
     # Trace-time affine-routing constant, set by Engine.init_state when the
     # host->node map is uniform contiguous blocks (node_of[h] == h // g, the
     # shape every `count:`-group config produces): the per-send node lookup
@@ -626,6 +666,37 @@ class EngineConfig:
                 f"wheel_block={self.wheel_block} must be 0 (auto) or divide "
                 f"wheel_slots={self.wheel_slots} evenly"
             )
+        if self.fluid_classes < 0 or self.fluid_links < 0:
+            raise ValueError(
+                f"fluid dims must be >= 0, got classes="
+                f"{self.fluid_classes} links={self.fluid_links}"
+            )
+        if self.fluid_classes and self.fluid_links < 1:
+            raise ValueError(
+                "fluid_classes > 0 requires fluid_links >= 1 (the ODE "
+                "needs at least one link to cover)"
+            )
+        if self.fluid_classes:
+            if self.fluid_tau_ns <= 0:
+                raise ValueError(
+                    f"fluid_tau_ns must be > 0, got {self.fluid_tau_ns}"
+                )
+            if not 0.0 <= self.fluid_util_threshold < 1.0:
+                raise ValueError(
+                    f"fluid_util_threshold must be in [0, 1), got "
+                    f"{self.fluid_util_threshold}"
+                )
+            if not 0.0 <= self.fluid_loss_max <= 1.0:
+                raise ValueError(
+                    f"fluid_loss_max must be in [0, 1], got "
+                    f"{self.fluid_loss_max}"
+                )
+            if self.fluid_lat_max_x1000 < 1000:
+                raise ValueError(
+                    f"fluid_lat_max_x1000 must be >= 1000 (inflation "
+                    f"only — the conservative-lookahead bound), got "
+                    f"{self.fluid_lat_max_x1000}"
+                )
         if self.wheel_slots and self.microstep_events > 1:
             raise ValueError(
                 "timer wheel + K-way microsteps (microstep_events > 1) is "
@@ -704,6 +775,14 @@ class EngineConfig:
         wheel carry, push routing, and merged pops exist only then —
         the wheel-off program stays byte-identical)."""
         return self.wheel_slots > 0
+
+    @property
+    def fluid_active(self) -> bool:
+        """True iff the fluid traffic plane is traced into the round
+        body (the ODE carry, the per-round advance, the outbox byte
+        fold, and the coupling factors exist only then — the fluid-off
+        program stays byte-identical)."""
+        return self.fluid_classes > 0
 
     @property
     def gear_active(self) -> bool:
@@ -792,6 +871,14 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         # traced in — distinct buffers per field (donation rule above)
         wheel_spilled=zi() if cfg.wheel_active else None,
         wheel_occ_hwm=zi() if cfg.wheel_active else None,
+        # fluid-plane byte counters (net/fluid.py): replicated scalars,
+        # absent unless the fluid ODE is traced in
+        fl_bg_bytes=(
+            jnp.zeros((), jnp.int64) if cfg.fluid_active else None
+        ),
+        fl_bg_dropped=(
+            jnp.zeros((), jnp.int64) if cfg.fluid_active else None
+        ),
     )
 
 
@@ -1223,6 +1310,8 @@ class Engine:
                 digest2=sh if self.cfg.integrity_dual else None,
                 wheel_spilled=sh if self.cfg.wheel_active else None,
                 wheel_occ_hwm=sh if self.cfg.wheel_active else None,
+                fl_bg_bytes=rep if self.cfg.fluid_active else None,
+                fl_bg_dropped=rep if self.cfg.fluid_active else None,
             ),
             trace=(
                 TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
@@ -1238,6 +1327,10 @@ class Engine:
                     bt=sh, bo=sh, bfill=sh,
                 )
                 if self.cfg.wheel_active else None
+            ),
+            fluid=(
+                FluidState(rates=rep, link_util=rep)
+                if self.cfg.fluid_active else None
             ),
         )
 
@@ -1259,6 +1352,14 @@ class Engine:
                 win_loss=rep if lw else None,
                 win_lat=rep if lw else None,
             )
+        # fluid schedule: classes and links are global — replicated,
+        # mirroring the None structure of EngineParams.fluid exactly
+        fluid = None
+        if self.cfg.fluid_active:
+            fluid = FluidParams(
+                src_zone=rep, dst_zone=rep, demand=rep,
+                win_start=rep, win_end=rep, capacity=rep,
+            )
         return EngineParams(
             node_of=rep,
             lat_ns=rep,
@@ -1271,6 +1372,7 @@ class Engine:
             loss_rows=rows,
             jit_rows=rows,
             faults=faults,
+            fluid=fluid,
         )
 
     # ---- initialization ----------------------------------------------------
@@ -1291,6 +1393,12 @@ class Engine:
                 "declares fault windows (fault_crash_windows/"
                 "fault_loss_windows) — build both from one FaultSchedule "
                 "(core/faults.compile_faults)"
+            )
+        if (params.fluid is not None) != cfg.fluid_active:
+            raise ValueError(
+                "EngineParams.fluid must be provided iff the EngineConfig "
+                "declares fluid classes (fluid_classes > 0) — build both "
+                "from one FluidSchedule (net/fluid.compile_fluid)"
             )
         self._model_state_spec_tree = self._model_specs(model_state)
         self._model_param_spec_tree = self._model_specs(params.model)
@@ -1371,6 +1479,11 @@ class Engine:
                     else None
                 ),
                 wheel=wheel,
+                fluid=(
+                    make_fluid_state(cfg.fluid_classes, cfg.fluid_links)
+                    if cfg.fluid_active
+                    else None
+                ),
             )
         if self.mesh is not None:
             state = jax.device_put(
@@ -1545,6 +1658,22 @@ def _window_step(
     )
     host_gid = shard_start + jnp.arange(h_local, dtype=jnp.int64)
 
+    # ---- fluid traffic plane (net/fluid.py): the fluid->packet half of
+    # the conservative coupling, computed ONCE per round from the
+    # PREVIOUS round's ODE state (the round's committed window has not
+    # run yet — using last round's utilization keeps the factors
+    # loop-invariant across this round's microsteps). Per-host extra
+    # loss probability and latency multiplier (>= 1.0 by construction:
+    # inflation only, so the conservative-lookahead bound — which uses
+    # the pre-inflation minimum — stays valid; the safe-window psum is
+    # untouched). Zero background load yields loss 0.0 / multiplier
+    # exactly 1.0x on every host — value-identical to fluid-off.
+    fluid_fx = None
+    if cfg.fluid_active:
+        fluid_fx = fluid_host_effects(
+            cfg, params.fluid, st.fluid, _host_nodes(cfg, params, host_gid)
+        )
+
     # ---- safe-window telemetry (network observatory): which shard's
     # local min event time bound this round's all-reduce-min barrier —
     # the critical-path shard (ties to the lowest shard id, so the value
@@ -1591,7 +1720,7 @@ def _window_step(
         def micro_body(carry):
             stc, valve, steps = carry
             stc, executed = _microstep_k(
-                cfg, model, stc, params, host_gid, window_end
+                cfg, model, stc, params, host_gid, window_end, fluid_fx
             )
             return stc, valve + executed.astype(jnp.int64), steps + 1
 
@@ -1611,7 +1740,9 @@ def _window_step(
 
         def micro_body(carry):
             stc, steps = carry
-            stc = _microstep(cfg, model, stc, params, host_gid, window_end)
+            stc = _microstep(
+                cfg, model, stc, params, host_gid, window_end, fluid_fx
+            )
             return stc, steps + 1
 
         with jax.named_scope("shadow_microsteps"):
@@ -1689,6 +1820,24 @@ def _window_step(
                 stats.iv_round,
             ),
         )
+    fluid_new = None
+    if cfg.fluid_active:
+        # packet->fluid half of the coupling + the ODE advance: the
+        # pre-exchange outbox's bytes per link (psum'd — every shard
+        # sees the GLOBAL count) subtract from fluid capacity, then one
+        # forward-Euler step over the committed window updates the
+        # replicated rate/utilization lanes and the background byte
+        # counters. Runs on the post-microstep outbox (st_m), BEFORE the
+        # exchange cleared it.
+        fg_link = _fluid_fg_link_bytes(cfg, axis, st_m.outbox, params,
+                                       host_gid)
+        fluid_new, bg_dlv, bg_drp = fluid_advance(
+            cfg, params.fluid, st.fluid, fg_link, st.now, window_end, done
+        )
+        stats = stats._replace(
+            fl_bg_bytes=stats.fl_bg_bytes + bg_dlv,
+            fl_bg_dropped=stats.fl_bg_dropped + bg_drp,
+        )
     min_used = _pmin(st_x.min_used_lat, axis)
     out = st_x._replace(
         now=jnp.where(done, st.now, window_end),
@@ -1696,6 +1845,8 @@ def _window_step(
         min_used_lat=min_used,
         stats=stats,
     )
+    if cfg.fluid_active:
+        out = out._replace(fluid=fluid_new)
     if cfg.trace_rounds:
         out = out._replace(
             trace=_trace_round(
@@ -1924,6 +2075,49 @@ def _integrity_round_check(
     return viol, mask
 
 
+def _host_nodes(cfg: EngineConfig, params: EngineParams, host_gid):
+    """Per-host graph-node index (the fluid plane's link id): the affine
+    divide when init_state detected the uniform-blocks map, else one
+    gather from the replicated node_of table — the same two routes the
+    send path's destination lookup takes."""
+    if cfg.hosts_per_node > 0:
+        return (host_gid // cfg.hosts_per_node).astype(jnp.int32)
+    return params.node_of[host_gid].astype(jnp.int32)
+
+
+def _fluid_fg_link_bytes(cfg: EngineConfig, axis, ob: Outbox,
+                         params: EngineParams, host_gid):
+    """The packet->fluid half of the coupling: this round's foreground
+    bytes per fluid link, folded from the pre-exchange outbox — uplink
+    bytes charge each sender's access link, downlink bytes the
+    destination's. INTEGER scatter-adds only (order-free, so the fold
+    is bit-deterministic), psum'd across the mesh so every shard sees
+    the GLOBAL count and the replicated ODE stays identical on every
+    shard and across mesh shapes."""
+    n = cfg.fluid_links
+    valid = ob.t != TIME_MAX
+    size = jnp.where(
+        valid, ob.payload[:, :, PAYLOAD_SIZE_WORD].astype(jnp.int64),
+        jnp.int64(0),
+    )
+    src_node = jnp.clip(_host_nodes(cfg, params, host_gid), 0, n - 1)
+    up = jnp.zeros((n,), jnp.int64).at[src_node].add(
+        jnp.sum(size, axis=1)
+    )
+    dst_f = jnp.clip(
+        ob.dst.reshape(-1).astype(jnp.int64), 0, cfg.num_hosts - 1
+    )
+    if cfg.hosts_per_node > 0:
+        dnode = (dst_f // cfg.hosts_per_node).astype(jnp.int32)
+    else:
+        dnode = params.node_of[dst_f].astype(jnp.int32)
+    down = jnp.zeros((n,), jnp.int64).at[jnp.clip(dnode, 0, n - 1)].add(
+        size.reshape(-1)
+    )
+    tot = up + down
+    return lax.psum(tot, axis) if axis else tot
+
+
 def _hold_faults(cfg: EngineConfig, params: EngineParams):
     """The fault schedule iff queue-HOLD crash semantics are in force —
     the only fault mode that floors next-event times (clear mode drops at
@@ -1969,7 +2163,8 @@ class _EvCarry(NamedTuple):
     model: Any
 
 
-def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, active):
+def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev,
+                active, fluid_fx=None):
     """Execute one event per `active` host: digest, ingress shaping, model
     dispatch, and egress staging. Returns (carry', push_list, ob_entries,
     used_lats): queue pushes and outbox appends are RETURNED, not applied —
@@ -2147,6 +2342,19 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
         # same window: bootstrap-phase traffic stays undisturbed (and
         # uncounted in faults_delayed)
         f_inflate = (f_lat > LAT_SCALE) & (ev.t >= cfg.bootstrap_end_time)
+    if cfg.fluid_active:
+        # fluid congestion coupling (net/fluid.py): this round's per-host
+        # extra-loss probability and latency multiplier, computed once at
+        # round start from the background ODE's utilization. Inflation
+        # honors bootstrap_end_time like every loss plane, and the loss
+        # draw below is a COUNTER-BASED hash (fluid_send_uniform) that
+        # never advances the RNG lanes — at zero background load the
+        # factors are exactly (0.0, 1.0x) and every value downstream is
+        # bit-identical to the fluid-off program.
+        bg_loss, bg_lat = fluid_fx
+        bg_inflate = (bg_lat > LAT_SCALE) & (
+            ev.t >= cfg.bootstrap_end_time
+        )
     for s in out.sends:
         cmax = int(getattr(s, "count_max", 1) or 1)
         mask0 = s.mask & dispatch
@@ -2252,6 +2460,33 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
                 )
             else:
                 flost = None
+            if cfg.fluid_active:
+                bglost = None
+                if cfg.fluid_loss_max > 0.0:
+                    # fluid congestion loss AFTER the path/fault draws
+                    # (precedence: path loss > unreachable > fault loss
+                    # > fluid loss > budget, each counted exactly once).
+                    # The uniform is a pure hash of (fluid seed, global
+                    # host id, emission counter) — unique per send,
+                    # mesh-shape invariant, and side-effect-free on the
+                    # RNG stream. Drops fold into pkts_lost (congestion
+                    # loss IS path loss to the protocol; the links fold
+                    # attributes it). loss_max is a trace-time static:
+                    # latency-only coupling (the default) traces NO draw
+                    # — bg_loss would be identically 0.0 yet the hash is
+                    # per send segment on the measured dispatch path.
+                    ub = fluid_send_uniform(cfg.fluid_seed, host_gid, seq)
+                    bglost = (
+                        mask & ~lost & ~unreachable & (ub < bg_loss)
+                        & (ev.t >= cfg.bootstrap_end_time)
+                    )
+                    if flost is not None:
+                        bglost = bglost & ~flost
+                lat_j = jnp.where(
+                    bg_inflate, (lat_j * bg_lat) // LAT_SCALE, lat_j
+                )
+            else:
+                bglost = None
             send_ok = mask & ~lost & ~unreachable & ~over_budget
             budget_dropped = mask & ~lost & ~unreachable & over_budget
             if flost is not None:
@@ -2262,6 +2497,9 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
                     faults_delayed=stats.faults_delayed
                     + (send_ok & f_inflate),
                 )
+            if bglost is not None:
+                send_ok = send_ok & ~bglost
+                budget_dropped = budget_dropped & ~bglost
             ob_col = sent_round  # lane column (cursor pre-increment)
             sent_round = sent_round + send_ok.astype(jnp.int32)
             # conservative-PDES clamp (worker.rs:411-414): never before
@@ -2279,7 +2517,10 @@ def _event_body(cfg, model, c: _EvCarry, params, host_gid, window_end, ev, activ
             used_lats.append(jnp.where(send_ok, lat_bound0, TIME_MAX))
             stats = stats._replace(
                 pkts_sent=stats.pkts_sent + mask,
-                pkts_lost=stats.pkts_lost + lost,
+                # bglost is disjoint from lost by construction (drawn on
+                # the ~lost survivors), so the OR is an exact sum
+                pkts_lost=stats.pkts_lost
+                + (lost if bglost is None else lost | bglost),
                 pkts_unreachable=stats.pkts_unreachable + unreachable,
                 pkts_budget_dropped=stats.pkts_budget_dropped + budget_dropped,
             )
@@ -2378,9 +2619,12 @@ def _finish_microstep(
     )
 
 
-def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
+def _microstep(cfg, model, st: SimState, params, host_gid, window_end,
+               fluid_fx=None):
     """The single-event microstep (microstep_events = 1): pop each host's
-    earliest event, execute, apply pushes and appends."""
+    earliest event, execute, apply pushes and appends. `fluid_fx` is the
+    round's loop-invariant fluid coupling factors (None when the fluid
+    plane is off)."""
     # execution-time floor: the CPU model's busy horizon and/or the fault
     # plane's queue-hold restart time. A host floored past the window does
     # not pop at all; events stay in the queue so their (time, order)
@@ -2450,7 +2694,8 @@ def _microstep(cfg, model, st: SimState, params, host_gid, window_end):
         )
 
     c, push_list, ob_entries, used_lats, flow_entries = _event_body(
-        cfg, model, _ev_carry_of(st), params, host_gid, window_end, ev, active
+        cfg, model, _ev_carry_of(st), params, host_gid, window_end, ev,
+        active, fluid_fx,
     )
     if cfg.wheel_active and push_list:
         # route model timer pushes to the wheel (spill-to-queue when
@@ -2564,7 +2809,8 @@ def _route_timer_pushes(cfg: EngineConfig, wheel, push_list, timer_kinds,
     return push_q, push_w, spilled
 
 
-def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
+def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end,
+                 fluid_fx=None):
     """The K-way microstep (microstep_events = K > 1): peek each host's K
     earliest in-window events in ONE slab pass (`q_pop_k`), fold them
     through the model handler with an unrolled inner loop, then remove the
@@ -2666,7 +2912,8 @@ def _microstep_k(cfg, model, st: SimState, params, host_gid, window_end):
             fault_drop = fault_drop + fd
             exec_j = cons_j & ~fd
         c, push_list, entries, lats, flows_j = _event_body(
-            cfg, model, c, params, host_gid, window_end, ev, exec_j
+            cfg, model, c, params, host_gid, window_end, ev, exec_j,
+            fluid_fx,
         )
         flow_entries += flows_j
         # accumulate this event's push keys into the guard minimum AFTER

@@ -78,7 +78,22 @@ COUNTER_LANES = frozenset({
     # count, the per-shard invariant bitmask, and the first-violation
     # round index (-1 = none) — i64 like every control-signal lane
     "integrity", "iv_mask", "iv_round",
+    # fluid traffic plane (net/fluid.py): cumulative background bytes
+    # delivered / DropTail-dropped — bytes at Gbit-scale demand pass
+    # 2^31 in seconds of sim time, so i64 like fl_bytes
+    "fl_bg_bytes", "fl_bg_dropped",
 })
+
+# Fluid-plane f64 lanes (net/fluid.py FluidState): the per-class carried
+# rates and per-link offered utilization the round body's forward-Euler
+# step maintains. float64 deliberately — the ODE is replicated global
+# math whose drift across shards would break the mesh-shape determinism
+# gate; f32 accumulation error at Gbit rates over long horizons is a
+# real divergence risk. Never narrow.
+FLUID_LANES: dict[str, str] = {
+    "rates": "float64",
+    "link_util": "float64",
+}
 
 # Digest lanes: uint64 (FNV-1a fold, core/engine.py _digest_update;
 # digest2 is the integrity sentinel's independently-folded dual lane,
@@ -102,6 +117,7 @@ LANE_WIDTHS: dict[str, str] = {
     **{n: "int64" for n in ORDER_LANES},
     **{n: "int64" for n in COUNTER_LANES},
     **{n: "uint64" for n in DIGEST_LANES},
+    **FLUID_LANES,
     **NARROW_LANES,
 }
 
@@ -198,6 +214,15 @@ STATE_LANES: dict[str, str] = {
     "stats.iv_round": "int64",
     "stats.digest2": "uint64",
     "stats.digest": "uint64",
+    # fluid traffic plane (net/fluid.py; present only when the `fluid:`
+    # block declares classes — the default program carries None here and
+    # traces no fluid code). The ODE carry lanes are replicated f64; the
+    # byte counters are replicated i64 scalars (the ODE is global, so a
+    # per-shard lane would multiply the total at export).
+    "fluid.rates": "float64",
+    "fluid.link_util": "float64",
+    "stats.fl_bg_bytes": "int64",
+    "stats.fl_bg_dropped": "int64",
     # timer-wheel planes (ops/wheel.py; present only when
     # experimental.timer_wheel > 0). The wheel IS the BucketQueue
     # machinery re-aimed at timers, so every wheel lane mirrors its
@@ -257,6 +282,9 @@ WHEEL_LANE_OF_QUEUE: dict[str, str] = {
 #   WS  wheel_slots (timer-wheel slots per host; wheel planes absent
 #       when 0 — the wheel-off carry has no wheel at all)
 #   WNB wheel block-cache blocks = WS // resolved wheel block
+#   FK  fluid background-traffic classes (net/fluid.py; fluid planes
+#       absent when 0 — the fluid-off carry has no fluid at all)
+#   FN  fluid links (graph nodes the per-link ODE state covers)
 #
 # Integer entries are literal dimensions. Stage A stays jax-free: tokens
 # only, no imports. tests/test_memory.py asserts this dict covers
@@ -317,6 +345,12 @@ STATE_LANE_SHAPES: dict[str, tuple] = {
     "wheel.bfill": ("H", "WNB"),
     "stats.wheel_spilled": ("H",),
     "stats.wheel_occ_hwm": ("H",),
+    # fluid plane (net/fluid.py): replicated global ODE state + the
+    # replicated scalar byte counters (shape () like stats.rounds)
+    "fluid.rates": ("FK",),
+    "fluid.link_util": ("FN",),
+    "stats.fl_bg_bytes": (),
+    "stats.fl_bg_dropped": (),
 }
 
 # ---------------------------------------------------------------------------
@@ -338,6 +372,13 @@ STATS_EXPORT_EXEMPT: dict[str, str] = {
         "ec_timer", "ec_pkt", "ec_app",
         "fl_done", "fl_bytes", "fl_rtx", "win_bound",
     )},
+    **{f: (
+        "exported through the sim-stats fluid{} block assembled by "
+        "net/fluid.assemble_fluid_report (the ONE shared helper sim.py "
+        "and bench.py both call — it reads the lane directly so the "
+        "block's shape cannot drift between exporters); gated on the "
+        "fluid: block declaring classes, None otherwise"
+    ) for f in ("fl_bg_bytes", "fl_bg_dropped")},
     "gear_shed": (
         "transient gear-abort control signal: a shedding chunk is "
         "discarded and replayed from its pre-chunk snapshot, so the "

@@ -107,6 +107,17 @@ class HybridSimulation:
         self.specs = simmod.expand_hosts_hybrid(cfg, self.graph)
         if not self.specs:
             raise ConfigError("config defines no hosts")
+        if cfg.fluid.active:
+            # the fluid plane's coupling rides the device engine's send
+            # path; the CPU host plane's packets never see it, so a
+            # hybrid run would model background congestion for HALF the
+            # traffic — reject loudly instead of silently under-coupling
+            raise ConfigError(
+                "fluid: the hybrid (managed-process) driver does not "
+                "support the fluid traffic plane yet — the CPU plane's "
+                "packets would bypass the background coupling; run a "
+                "pure device-model sim or drop the fluid block"
+            )
         self.staging_cap = staging_cap
         # mixed simulations: any spec carrying a device model makes the
         # lane plane heterogeneous (models/mixed.py); pure-program configs

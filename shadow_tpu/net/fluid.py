@@ -1,0 +1,386 @@
+"""Fluid traffic plane: device-resident background-flow rate ODEs,
+conservatively coupled to the packet engine (ROADMAP item 5).
+
+Emulating a flash crowd or an elephant/mice mix with a packet per
+keystroke would blow both the event budget and HBM; Rain (PAPERS.md,
+arxiv 2606.03352) argues the microsecond-scale foreground must stay
+packet-exact — so the answer is a hybrid. A `fluid:` config block
+compiles a set of background traffic CLASSES (src-zone -> dst-zone
+demand with an active [start, end) window) into per-link fluid rate
+ODEs advanced ONCE PER ROUND inside the jitted round body:
+
+  forward-Euler over the round's committed window [now, window_end):
+    rate_k'   = rate_k + min(dt/tau, 1) * (demand_k(t) - rate_k)
+    bg[n]     = sum_k rate_k' over classes whose src or dst zone is n
+    avail[n]  = max(capacity[n] - fg_rate[n], 0)      # packet plane first
+    share[n]  = min(1, avail[n] / bg[n])              # DropTail clip
+    g_k       = min(share[src_k], share[dst_k])       # class bottleneck
+    carried_k = rate_k' * g_k                         # the new rate state
+    util[n]   = (bg[n] + fg_rate[n]) / capacity[n]    # offered, may be >1
+
+`fg_rate[n]` is the PACKET plane's measured bytes on link n this round
+(the outbox fold, psum'd across the mesh) — foreground bytes subtract
+from fluid capacity at round granularity, so the background can never
+starve the exact plane. Carried background bytes accumulate into
+`stats.fl_bg_bytes`, the DropTail-clipped remainder into
+`stats.fl_bg_dropped` (counted, never silent). The clip-to-carried rate
+update gives the classes an AIMD-flavored sawtooth: relax toward demand,
+multiplicative clip at congestion.
+
+Conservative coupling, one-way-safe in each direction:
+
+  fluid -> packet: at round START, each host's access-link offered
+  utilization (from the PREVIOUS round's ODE state) maps to a latency
+  multiplier >= 1.0 (x1000 integer math, the fault plane's LAT_SCALE
+  rule) and an extra loss probability in [0, fluid_loss_max], both
+  ramping linearly from `util_threshold` to full overload and BOTH
+  gated on background actually being present on the link (bg[n] > 0).
+  Inflation can only GROW latency, so the conservative-lookahead bound
+  — which uses the pre-inflation minimum — stays valid, exactly the
+  fault plane's latency_factor argument; the safe-window psum is
+  untouched. The loss draw is a COUNTER-BASED splitmix64 hash of
+  (fluid seed, global host id, the host's emission counter) — a pure
+  function that never advances the engine's per-host RNG lanes, so a
+  zero-demand fluid block leaves every draw, every event, and every
+  digest bit-identical to the fluid-off program.
+
+  packet -> fluid: only the per-round byte fold above. The background
+  plane reads aggregate bytes, never event content.
+
+Determinism: the ODE is replicated f64 math over psum'd integer inputs
+(identical on every shard, invariant to mesh shape), class/link folds
+are fixed-order one-hot reductions (no float scatter-adds), and the
+loss hash is pure in (seed, host, seq) — same seed => same digests,
+across reruns AND mesh shapes (tests/test_fluid.py is the gate). With
+the block absent the engine traces ZERO fluid code and the default
+echo/phold/tgen jaxpr fingerprints are byte-unchanged; the gated
+surface is pinned by the `tgen_fluid` fingerprint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import numpy as np
+
+from shadow_tpu.simtime import TIME_MAX
+
+# Latency multipliers are parts-per-thousand integers (core/faults.py
+# LAT_SCALE): inflation stays pure i64 math in-jit. The import is
+# DEFERRED (function-level, like this module's jnp imports): core's
+# __init__ pulls in core.engine, which imports this module at load —
+# a top-level core import here would make `import shadow_tpu.net.fluid`
+# crash with a partially-initialized-module ImportError whenever it is
+# the process's first shadow_tpu import (tools/net_report.py's fluid
+# branch is exactly that entry point).
+
+
+class FluidParams(NamedTuple):
+    """Device-side compiled fluid schedule (EngineParams.fluid). All
+    arrays are replicated — classes and links are global objects, like
+    the engine's routing tables."""
+
+    src_zone: Any  # i32[K] class source link (graph-node index)
+    dst_zone: Any  # i32[K] class destination link
+    demand: Any  # f64[K] offered demand while active, BYTES per second
+    win_start: Any  # i64[K] activity window start (ns)
+    win_end: Any  # i64[K] activity window end (ns)
+    capacity: Any  # f64[N] per-link capacity, BYTES per second
+
+
+@dataclasses.dataclass(frozen=True)
+class FluidSchedule:
+    """compile_fluid result: the static dims/knobs the EngineConfig
+    needs plus the compiled arrays (None when no class is declared)."""
+
+    classes: int  # K (0 = no fluid plumbing traced in)
+    links: int  # N
+    tau_ns: int
+    util_threshold: float
+    loss_max: float
+    lat_max_x1000: int
+    seed: int
+    params: FluidParams | None
+
+    @property
+    def active(self) -> bool:
+        return self.classes > 0
+
+
+class FluidState(NamedTuple):
+    """The fluid plane's carry lanes (SimState.fluid), registered in
+    core/lanes.py (`fluid.rates` / `fluid.link_util`, float64) so the
+    lane registry, shadowlint, the HBM byte model, and checkpoint
+    save/restore all see them. Replicated across the mesh: every shard
+    computes the identical global ODE from psum'd inputs."""
+
+    rates: Any  # f64[K] current per-class carried rate, bytes/s
+    link_util: Any  # f64[N] per-link offered utilization (may exceed 1)
+
+
+def make_fluid_state(classes: int, links: int) -> FluidState:
+    import jax.numpy as jnp
+
+    return FluidState(
+        rates=jnp.zeros((classes,), jnp.float64),
+        link_util=jnp.zeros((links,), jnp.float64),
+    )
+
+
+# ---------------------------------------------------------------- compile
+
+
+def compile_fluid(
+    fopts,
+    *,
+    num_links: int,
+    default_seed: int = 1,
+    zone_of=None,
+) -> FluidSchedule:
+    """FluidOptions -> FluidSchedule. Host-side numpy; deterministic in
+    the config alone (the ODE needs no compile-time draws, and the
+    schedule is horizon-independent — a window past this run's stop
+    time simply never activates). `zone_of` maps a config zone id (GML
+    node id) to a graph-node index; identity with a bounds check by
+    default."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.faults import LAT_SCALE
+
+    if zone_of is None:
+        def zone_of(z):  # noqa: E731 - simple identity resolver
+            z = int(z)
+            if not 0 <= z < num_links:
+                raise ValueError(
+                    f"fluid zone {z} out of range [0, {num_links})"
+                )
+            return z
+
+    seed = default_seed if fopts.seed is None else fopts.seed
+    classes = list(fopts.classes)
+    sched_kw = dict(
+        links=num_links,
+        tau_ns=int(fopts.tau),
+        util_threshold=float(fopts.util_threshold),
+        loss_max=float(fopts.loss_max),
+        lat_max_x1000=int(round(fopts.latency_factor_max * LAT_SCALE)),
+        seed=int(seed),
+    )
+    if not classes:
+        return FluidSchedule(classes=0, params=None, **sched_kw)
+    src = np.zeros((len(classes),), np.int32)
+    dst = np.zeros((len(classes),), np.int32)
+    dem = np.zeros((len(classes),), np.float64)
+    ws = np.zeros((len(classes),), np.int64)
+    we = np.zeros((len(classes),), np.int64)
+    for i, c in enumerate(classes):
+        src[i] = zone_of(c.src_zone)
+        dst[i] = zone_of(c.dst_zone)
+        dem[i] = c.rate / 8.0  # bits/s -> bytes/s
+        ws[i] = c.start
+        # end 0 = open-ended (runs to the simulation horizon, whatever
+        # it is — TIME_MAX keeps a window that starts past THIS run's
+        # horizon legal: it simply never activates)
+        we[i] = c.end if c.end else TIME_MAX
+        if we[i] <= ws[i]:
+            raise ValueError(
+                f"fluid class {i}: window [{ws[i]}, {we[i]}) is empty"
+            )
+    cap_bytes = fopts.link_capacity / 8.0
+    return FluidSchedule(
+        classes=len(classes),
+        params=FluidParams(
+            src_zone=jnp.asarray(src, jnp.int32),
+            dst_zone=jnp.asarray(dst, jnp.int32),
+            demand=jnp.asarray(dem, jnp.float64),
+            win_start=jnp.asarray(ws, jnp.int64),
+            win_end=jnp.asarray(we, jnp.int64),
+            capacity=jnp.full((num_links,), cap_bytes, jnp.float64),
+        ),
+        **sched_kw,
+    )
+
+
+# ---------------------------------------------------------------- jit side
+
+
+def _bg_link_load(fp: FluidParams, rates, links: int):
+    """Per-link background load from per-class rates: a fixed-order
+    one-hot [K, N] reduction (NOT a float scatter-add — the jaxpr audit
+    pins float scatter-adds as a determinism hazard)."""
+    import jax.numpy as jnp
+
+    n_idx = jnp.arange(links, dtype=jnp.int32)[None, :]  # [1, N]
+    charge = (
+        (fp.src_zone[:, None] == n_idx).astype(jnp.float64)
+        + (fp.dst_zone[:, None] == n_idx).astype(jnp.float64)
+    )  # [K, N]: a class occupies its source AND destination access link
+    return jnp.sum(rates[:, None] * charge, axis=0)  # f64[N]
+
+
+def fluid_advance(cfg, fp: FluidParams, st: FluidState, fg_link_bytes,
+                  now, window_end, done):
+    """One forward-Euler step over the committed window (module
+    docstring spells out the scheme). `fg_link_bytes` is the psum'd
+    i64[N] foreground byte count this round. Returns
+    (FluidState', delivered_bytes i64[], dropped_bytes i64[]) with the
+    state held and the deltas zeroed on the done-round (which is not a
+    scheduling round, mirroring stats.rounds)."""
+    import jax.numpy as jnp
+
+    n = cfg.fluid_links
+    dt_ns = jnp.maximum(window_end - now, jnp.int64(0))
+    dt_s = dt_ns.astype(jnp.float64) * 1e-9
+    live = dt_s > 0.0
+
+    active = (fp.win_start <= now) & (now < fp.win_end)
+    demand = jnp.where(active, fp.demand, jnp.float64(0.0))
+    alpha = jnp.minimum(dt_s / (cfg.fluid_tau_ns * 1e-9), 1.0)
+    r = st.rates + alpha * (demand - st.rates)
+
+    # foreground-first capacity: the packet plane's measured bytes this
+    # round subtract from what the background may carry
+    fg_rate = jnp.where(
+        live, fg_link_bytes.astype(jnp.float64) / jnp.maximum(dt_s, 1e-18),
+        jnp.float64(0.0),
+    )
+    bg = _bg_link_load(fp, r, n)
+    avail = jnp.maximum(fp.capacity - fg_rate, 0.0)
+    share = jnp.where(bg > avail, avail / jnp.maximum(bg, 1e-18), 1.0)
+    # per-class bottleneck share: min over its two links (gathers from a
+    # tiny replicated [N] table with trace-time-constant index arrays)
+    g = jnp.minimum(share[fp.src_zone], share[fp.dst_zone])
+    carried = r * g
+    util = jnp.where(
+        fp.capacity > 0.0, (bg + fg_rate) / fp.capacity, jnp.float64(0.0)
+    )
+
+    delivered = jnp.floor(jnp.sum(carried) * dt_s).astype(jnp.int64)
+    dropped = jnp.floor(jnp.sum(r - carried) * dt_s).astype(jnp.int64)
+    hold = done | ~live
+    new = FluidState(
+        rates=jnp.where(hold, st.rates, carried),
+        link_util=jnp.where(hold, st.link_util, util),
+    )
+    z = jnp.int64(0)
+    return new, jnp.where(hold, z, delivered), jnp.where(hold, z, dropped)
+
+
+def fluid_host_effects(cfg, fp: FluidParams, st: FluidState, node_idx):
+    """Per-host coupling factors at round start, from the PREVIOUS
+    round's ODE state: (loss f32[H], lat_x1000 i64[H]).
+
+    Both ramp linearly from `util_threshold` (no effect) to utilization
+    1.0 (full effect: loss_max / lat_max) and saturate beyond, and both
+    are gated on background load actually being present on the host's
+    access link — a fluid block at zero demand (rates identically 0)
+    therefore yields loss 0.0 and multiplier exactly LAT_SCALE on every
+    host, leaving every downstream value bit-identical to the fluid-off
+    program. The multiplier is >= LAT_SCALE by construction: inflation
+    only (the conservative-lookahead argument in the module docstring).
+    """
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.faults import LAT_SCALE
+
+    n = cfg.fluid_links
+    idx = jnp.clip(node_idx.astype(jnp.int32), 0, n - 1)
+    bg = _bg_link_load(fp, st.rates, n)  # f64[N]
+    util_h = st.link_util[idx]  # [H] gather from a tiny replicated table
+    bg_h = bg[idx] > 0.0
+    thr = cfg.fluid_util_threshold
+    over = jnp.clip((util_h - thr) / max(1.0 - thr, 1e-9), 0.0, 1.0)
+    over = jnp.where(bg_h, over, jnp.float64(0.0))
+    loss = (over * cfg.fluid_loss_max).astype(jnp.float32)
+    lat = jnp.int64(LAT_SCALE) + jnp.floor(
+        over * (cfg.fluid_lat_max_x1000 - LAT_SCALE)
+    ).astype(jnp.int64)
+    return loss, jnp.maximum(lat, jnp.int64(LAT_SCALE))
+
+
+def fluid_send_uniform(seed: int, host_gid, ctr):
+    """float32 in [0, 1): pure counter draw keyed on (fluid seed, global
+    host id, the host's emission counter) — unique per send, invariant
+    to mesh shape, and side-effect-free on the RNG lanes. The jnp mirror
+    of core/faults.fault_uniform, built from the SAME pieces: the stride
+    constants come from core/faults (one keying recipe) and the mix from
+    ops/rng._splitmix64 (one jnp splitmix) — no third copy to drift."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.faults import _CTR_STRIDE, _HOST_STRIDE
+    from shadow_tpu.ops.rng import _splitmix64
+
+    x = (
+        jnp.uint64(seed & (2**64 - 1))
+        + host_gid.astype(jnp.uint64) * jnp.uint64(int(_HOST_STRIDE))
+        + ctr.astype(jnp.uint64) * jnp.uint64(int(_CTR_STRIDE))
+    )
+    _, z = _splitmix64(x)
+    _, u = _splitmix64(z)
+    return ((u >> jnp.uint64(40)).astype(jnp.float32)) * jnp.float32(
+        1.0 / (1 << 24)
+    )
+
+
+# ---------------------------------------------------------------- reports
+
+
+def assemble_fluid_report(*, stats, fluid_state, cfg) -> dict:
+    """The ONE driver-side assembly of the sim-stats `fluid{}` block
+    (the netobs assemble_network_report pattern): sim.py, bench.py, and
+    tools read this shape, so it cannot drift between exporters.
+    `stats` is the device-got Stats tuple — the gated fl_bg_* lanes are
+    read here."""
+    from shadow_tpu.core.faults import LAT_SCALE
+
+    bg_bytes = int(np.asarray(stats.fl_bg_bytes))
+    bg_dropped = int(np.asarray(stats.fl_bg_dropped))
+    offered = bg_bytes + bg_dropped
+    util = [round(float(u), 4) for u in np.asarray(fluid_state.link_util)]
+    return {
+        "classes": int(cfg.fluid_classes),
+        "links": int(cfg.fluid_links),
+        "bg_bytes": bg_bytes,
+        "bg_dropped": bg_dropped,
+        "delivered_share": (
+            round(bg_bytes / offered, 4) if offered else None
+        ),
+        "link_util_final": util,
+        "link_util_max": max(util) if util else 0.0,
+        "loss_max": float(cfg.fluid_loss_max),
+        "latency_factor_max": cfg.fluid_lat_max_x1000 / LAT_SCALE,
+    }
+
+
+def bench_fluid_block(report_fluid: dict) -> dict:
+    """The compact `fluid{}` block BENCH rows carry (and
+    tools/bench_compare.py diffs): background byte/drop coverage plus
+    the hot-link utilization."""
+    return {
+        "bg_bytes": report_fluid.get("bg_bytes", 0),
+        "bg_dropped": report_fluid.get("bg_dropped", 0),
+        "delivered_share": report_fluid.get("delivered_share"),
+        "link_util_max": report_fluid.get("link_util_max", 0.0),
+    }
+
+
+def background_share_sentence(fluid_block: dict, fg_bytes: int | None) -> str:
+    """The net_report verdict's background-share sentence: how much of
+    the modeled traffic rode the fluid plane (vs the packet-exact
+    foreground, when the flow ledger measured it)."""
+    bg = int(fluid_block.get("bg_bytes", 0))
+    drp = int(fluid_block.get("bg_dropped", 0))
+    if fg_bytes:
+        total = bg + fg_bytes
+        share = bg / total if total else 0.0
+        return (
+            f"background fluid plane carried {bg} bytes "
+            f"({share * 100:.1f}% of all modeled bytes vs {fg_bytes} "
+            f"packet-exact foreground bytes), {drp} dropped at congestion"
+        )
+    return (
+        f"background fluid plane carried {bg} bytes "
+        f"({drp} dropped at congestion); no foreground flow ledger to "
+        f"compare against"
+    )

@@ -83,6 +83,8 @@ def dims_of(
     integrity_dual: bool = False,
     wheel_slots: int = 0,
     wheel_block: int = 0,
+    fluid_classes: int = 0,
+    fluid_links: int = 0,
     payload_words: int | None = None,
     trace_cols: int | None = None,
     flow_cols: int | None = None,
@@ -124,6 +126,8 @@ def dims_of(
         "FF": int(flow_cols),
         "WS": int(wheel_slots),
         "WNB": wnb,
+        "FK": int(fluid_classes),
+        "FN": int(fluid_links) if fluid_classes else 0,
         "pressure": 1 if pressure else 0,
         "netobs": 1 if netobs else 0,
         "integrity": 1 if integrity else 0,
@@ -146,6 +150,8 @@ def dims_of_config(cfg) -> dict[str, int]:
         integrity_dual=cfg.integrity_dual,
         wheel_slots=cfg.wheel_slots,
         wheel_block=cfg.wheel_block,
+        fluid_classes=cfg.fluid_classes,
+        fluid_links=cfg.fluid_links,
     )
 
 
@@ -178,6 +184,14 @@ def dims_of_state(cfg, state) -> dict[str, int]:
         ),
         wheel_block=(
             int(state.wheel.block) if state.wheel is not None else 0
+        ),
+        fluid_classes=(
+            int(state.fluid.rates.shape[-1])
+            if getattr(state, "fluid", None) is not None else 0
+        ),
+        fluid_links=(
+            int(state.fluid.link_util.shape[-1])
+            if getattr(state, "fluid", None) is not None else 0
         ),
     )
 
@@ -219,6 +233,12 @@ def lane_plane_bytes(path: str, dims: dict[str, int]) -> int | None:
         path.startswith("wheel.")
         or path in ("stats.wheel_spilled", "stats.wheel_occ_hwm")
     ) and dims.get("WS", 0) == 0:
+        return None
+    # fluid planes (net/fluid.py): absent unless classes are declared
+    if (
+        path.startswith("fluid.")
+        or path in ("stats.fl_bg_bytes", "stats.fl_bg_dropped")
+    ) and dims.get("FK", 0) == 0:
         return None
     n = 1
     for tok in shape:

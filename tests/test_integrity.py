@@ -669,21 +669,45 @@ def test_driver_drill_end_to_end(tmp_path):
     The injection lands at a KNOWN round (16); a violation reported at
     any other round is this box's documented corruption striking the
     worker itself — classified and retried, never judged (the
-    classify-then-retry posture, docs/corruption.md)."""
+    classify-then-retry posture, docs/corruption.md). The clean and
+    once runs are same-seed deterministic by construction, so their
+    digests DISAGREEING is likewise the environment (the wrong-digest
+    flavor — observed with VARYING digests on unmodified HEAD during
+    PR 12's wave): it routes through tests/subproc.py's deviation
+    classification instead of hard-failing tier-1 on the equality
+    asserts below."""
+    from tests.subproc import classify_deviation, skip_deviation
+
     attempts = 0
     while True:
         attempts += 1
         clean = _drill("clean", tmp_path, f"clean{attempts}")
         once = _drill("once", tmp_path, f"once{attempts}")
         repro = _drill("repro", tmp_path, f"repro{attempts}")
+        # a survived one-shot scribble must land back ON the clean
+        # trajectory: digest disagreement between the two runs is the
+        # comparison-judged wrong-digest corruption flavor, never a
+        # sentinel verdict
+        deviated = classify_deviation([
+            (clean["digest"], clean["digest2"]),
+            (once["digest"], once["digest2"]),
+        ])
         env_hit = (
             clean["aborted"] or clean["transients"]
             or once["aborted"]
+            or deviated is not None
             or (repro["detail"] or "").find("round 16") < 0
         )
         if not env_hit:
             break
         if attempts >= 3:
+            if deviated is not None:
+                skip_deviation(
+                    "driver drill clean-vs-once digest comparison",
+                    attempts,
+                    f"clean={clean['digest']}/{clean['digest2']} "
+                    f"once={once['digest']}/{once['digest2']}",
+                )
             pytest.skip(
                 f"driver drill hit the documented corruption wave in "
                 f"{attempts}/{attempts} attempts (results: {clean}, "

@@ -25,6 +25,9 @@ Verdicts (rc 1 if any REGRESSION, else 0):
     wall-clock. An event-class share drift > 10 points is a warning
     (the mix shifting is signal, not inherently bad); OLD carrying a
     network block NEW lost is a coverage warning.
+  - fluid (PR 13 background plane): foreground-FCT drift with fluid on
+    regresses through the network gates above; losing the fluid block
+    or the background byte volume collapsing is a coverage warning
   - a metric present in OLD but missing from NEW is a regression
     (silently dropping a tracked workload is how coverage rots)
 """
@@ -48,6 +51,8 @@ def _rows(blob) -> dict[str, dict]:
                     **({"hbm": item["hbm"]} if "hbm" in item else {}),
                     **({"network": item["network"]}
                        if "network" in item else {}),
+                    **({"fluid": item["fluid"]}
+                       if "fluid" in item else {}),
                     **({"integrity": item["integrity"]}
                        if "integrity" in item else {}),
                     **({"integrity_aborted": True}
@@ -137,6 +142,42 @@ def _compare_network(
                 f"(mix shifted by {abs(n_sh - o_sh) * 100:.0f} points)")
 
 
+def _compare_fluid(add, name: str, o_fl: dict | None, n_fl: dict | None,
+                   hbm_threshold: float):
+    """Diff one metric's `fluid{}` blocks (net/fluid.py
+    bench_fluid_block shape). Foreground-FCT drift with fluid on is
+    already a REGRESSION through the network{} compare above — a
+    fluid-on row carries both blocks, so worsened foreground behavior
+    fails the diff on the flow gates. The fluid block itself guards
+    background COVERAGE: losing it, or the background byte volume
+    collapsing, means the scenario quietly stopped exercising the
+    background plane — a warning, not a hard failure (the background is
+    modeled load, not a protocol result)."""
+    if isinstance(o_fl, dict) and n_fl is None:
+        add("fluid", name, "warning",
+            "OLD carried a fluid block, NEW has none (background-plane "
+            "coverage lost)")
+        return
+    if not isinstance(n_fl, dict):
+        return
+    ob = (o_fl or {}).get("bg_bytes", 0) if isinstance(o_fl, dict) else 0
+    nb = n_fl.get("bg_bytes", 0)
+    if ob > 0:
+        rel = (nb - ob) / ob
+        if rel < -hbm_threshold:
+            add("fluid", name, "warning",
+                f"background bytes {ob} -> {nb} ({rel * 100:+.1f}%): the "
+                f"fluid plane carries materially less load (coverage "
+                f"shrank)")
+    od = (o_fl or {}).get("bg_dropped", 0) if isinstance(o_fl, dict) else 0
+    nd = n_fl.get("bg_dropped", 0)
+    if od == 0 and nd > 0:
+        add("fluid", name, "warning",
+            f"background drops appeared: 0 -> {nd} (the fluid plane "
+            f"started clipping at congestion — capacity or demand "
+            f"changed)")
+
+
 def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
     findings: list[dict] = []
 
@@ -193,6 +234,11 @@ def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
         elif isinstance(o_net, dict) and n_net is None:
             add("network", name, "warning",
                 "OLD carried a network block, NEW has none")
+        # fluid-traffic-plane block (PR 13, bench config 12): foreground
+        # FCT drift under fluid is caught by the network compare above;
+        # this guards background coverage (bytes/drops)
+        _compare_fluid(add, name, o.get("fluid"), n.get("fluid"),
+                       hbm_threshold)
         # integrity-sentinel block (PR 11, bench config 10): a
         # DETERMINISTIC violation appearing is always a regression — the
         # engine reproducibly broke its own invariant; transient-SDC

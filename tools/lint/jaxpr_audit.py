@@ -86,6 +86,24 @@ AUDIT_CONFIGS = {
         stop=200_000_000,
         kw=dict(qcap=16, integrity=True),
     ),
+    # fluid traffic plane ON (ISSUE 13): the background-flow ODE carry,
+    # the per-round forward-Euler advance, the outbox byte fold, and the
+    # latency/loss coupling traced in — pins the GATED program's compile
+    # surface (and audits the fluid.* f64 lane dtypes) while
+    # `echo`/`phold`/`tgen_netobs` above pin that the default
+    # (fluid-off) programs stay byte-unchanged.
+    "tgen_fluid": dict(
+        model="tgen_tcp",
+        hosts="tgen",  # mk_hosts(4, tgen args) below
+        stop=400_000_000,
+        kw=dict(qcap=16, sends_budget=16, fluid={
+            "link_capacity": "100 Mbit",
+            "latency_factor_max": 1.5,
+            "loss_max": 0.05,
+            "classes": [{"src_zone": 0, "dst_zone": 0,
+                         "rate": "80 Mbit", "start": 0}],
+        }),
+    ),
     # timer wheel + sort-free calendar merge ON (ISSUE 12): the wheel
     # carry lanes, merged queue∪wheel pops, spill routing, and the
     # scatter-merge fast/fallback cond traced in — pins the GATED
@@ -196,7 +214,8 @@ def run_audit(
     root: str | None = None,
     update: bool = False,
     configs: tuple[str, ...] = (
-        "echo", "phold", "tgen_netobs", "phold_integrity", "phold_wheel",
+        "echo", "phold", "tgen_netobs", "tgen_fluid", "phold_integrity",
+        "phold_wheel",
     ),
     fingerprint_file: str = FINGERPRINT_FILE,
 ):

@@ -158,6 +158,25 @@ def print_report(stats: dict, net: dict, file=sys.stdout):
             f"({(sw.get('critical_share') or 0) * 100:.1f}% of rounds)",
             file=file,
         )
+    fluid = stats.get("fluid")
+    if fluid:
+        # fluid traffic plane (net/fluid.py): the verdict's background-
+        # share sentence — how much of the modeled traffic rode the
+        # aggregate plane vs the packet-exact foreground
+        from shadow_tpu.net.fluid import background_share_sentence
+
+        fg_bytes = (net.get("flows") or {}).get("bytes")
+        print(
+            f"\n## fluid background plane ({fluid.get('classes', 0)} "
+            f"classes over {fluid.get('links', 0)} links)\n"
+            f"  {background_share_sentence(fluid, fg_bytes)}\n"
+            f"  delivered share  {fluid.get('delivered_share')}\n"
+            f"  link util max    {fluid.get('link_util_max')} "
+            f"(coupling ramps from the configured threshold; latency "
+            f"cap {fluid.get('latency_factor_max')}x, "
+            f"loss cap {fluid.get('loss_max')})",
+            file=file,
+        )
 
 
 def _check_config(tmp: str) -> dict:

@@ -41,6 +41,10 @@ HEARTBEAT_RE = re.compile(
     # cumulative; fct=<flows completed> (flow-ledger runs only)
     r"(?:ek=(?P<ek_timer>\d+)/(?P<ek_pkt>\d+) )?"
     r"(?:fct=(?P<fct_done>\d+) )?"
+    # PR 13 fluid-traffic-plane field (only emitted when the `fluid:`
+    # block declares classes): bg=<background bytes delivered>/<dropped>,
+    # cumulative
+    r"(?:bg=(?P<bg_bytes>\d+)/(?P<bg_dropped>\d+) )?"
     # PR 11 integrity-sentinel field (only emitted when the `integrity:`
     # block is enabled): iv=<transient SDC survived>/<sentinel replays>,
     # cumulative
