@@ -589,11 +589,18 @@ def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
     from shadow_tpu.config.options import ConfigOptions
     from shadow_tpu.sim import Simulation
 
+    from shadow_tpu.obs.runtime import CompileLedger
+
     cfg_dict, _, _ = baseline_config(8, small)
     rpc = cfg_dict["experimental"]["rounds_per_chunk"]
     t_build = time.monotonic()
+    # runtime observatory: the compile ledger records each leg's
+    # program compiles precisely (jax.monitoring), so the parent's
+    # runtime{} block carries measured compile wall, not an estimate
+    rt_compiles = CompileLedger()
     if leg == "ensemble":
         c = build_campaign(cfg_dict)
+        c.engine.attach_compile_ledger(rt_compiles)
         state, params = c.state, None
         run_chunk = c.engine.run_chunk
         r_count = c.num_replicas
@@ -607,6 +614,7 @@ def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
             ConfigOptions.from_dict(replica_config_dict(cfg_dict, spec)),
             world=1,
         )
+        sim.engine.attach_compile_ledger(rt_compiles)
         state, params = sim.state, sim.params
         run_chunk = sim.engine.run_chunk
         r_count = 1
@@ -653,6 +661,10 @@ def _campaign_worker(leg: str, small: bool, wall_budget_s: float) -> dict:
         "leg": leg,
         "replicas": r_count,
         "rpc": rpc,
+        # runtime observatory: measured compile walls + the sim horizon
+        # the leg reached (feeds the parent row's runtime{} block)
+        "compiles": rt_compiles.summary(),
+        "sim_ns": int(_np.asarray(jax.device_get(state.now)).max()),
         "walls": [round(w, 5) for w in walls],
         "rounds": int(_np.asarray(s.rounds).sum()),
         "replica_rounds": [int(r) for r in rounds_arr],
@@ -724,15 +736,36 @@ def _run_campaign_leg(leg: str, small: bool, wall_budget_s: float,
             f"{attempts} attempts died of the known corruption ({last})"}
 
 
+def post_compile_stats(
+    walls: list[float], rounds: int | None = None, rpc: int = 0,
+    replicas: int = 1,
+) -> tuple[float, int | None]:
+    """THE shared compile-chunk exclusion rule (runtime-observatory
+    satellite): given per-chunk walls, return (post-compile wall,
+    post-compile rounds). walls[0] carries the jit compile, and its
+    chunk always retires the full rounds_per_chunk x replicas (no
+    replica can finish before its first chunk ends), so both are
+    excluded exactly. Every bench path routes its exclusion through
+    here — `measure` (the --self PHOLD legs), `measure_campaign` (the
+    config-8 subprocess legs this rule generalizes), and the
+    runtime{} block's ex-compile rates — so sim-s/wall-s never
+    silently folds a cold compile in. When the whole run fit inside
+    the compile chunk, that chunk IS the measurement (counted)."""
+    if len(walls) < 2:
+        return max(sum(walls), 1e-9), rounds
+    w = max(sum(walls[1:]), 1e-9)
+    if rounds is None:
+        return w, None
+    return w, rounds - rpc * replicas
+
+
 def _leg_run_stats(w: dict) -> tuple[float, int]:
-    """(post-compile wall, post-compile rounds) for one worker result.
-    walls[0] carries the jit compile, and its chunk always retires the
-    full rounds_per_chunk x replicas (no replica can finish before its
-    first chunk ends), so both are excluded exactly."""
-    walls = w["walls"]
-    if len(walls) < 2:  # whole run fit in the compile chunk — count it
-        return max(sum(walls), 1e-9), w["rounds"]
-    return max(sum(walls[1:]), 1e-9), w["rounds"] - w["rpc"] * w["replicas"]
+    """(post-compile wall, post-compile rounds) for one config-8 worker
+    result, via the shared `post_compile_stats` rule."""
+    wall, rounds = post_compile_stats(
+        w["walls"], w["rounds"], w["rpc"], w["replicas"]
+    )
+    return wall, rounds
 
 
 def measure_campaign(small: bool, wall_budget_s: float = 120.0) -> dict:
@@ -819,6 +852,29 @@ def measure_campaign(small: bool, wall_budget_s: float = 120.0) -> dict:
                 "replicas": r_count,
             },
         }
+    # runtime{} block (runtime observatory): the worker's compile
+    # ledger + the leg's realtime factor, with the ex-compile rate so
+    # sim-s/wall-s never silently folds a cold compile in (the shares
+    # split needs the driver's WallLedger, which the minimal worker
+    # loop does not carry — per-phase shares live on configs 1-12)
+    comp = ens.get("compiles") or {}
+    total_wall = max(sum(ens["walls"]), 1e-9)
+    sim_s = ens.get("sim_ns", 0) / 1e9
+    cw = comp.get("compile_wall_s", 0.0)
+    row["runtime"] = {
+        "compile_wall_s": cw,
+        # the whole leg is the measured window here, so every compile
+        # the worker's ledger recorded landed inside it — the factor
+        # below folds them in, the ex-compile factor is the clean one
+        # (bench_runtime_block semantics: window == the measured span)
+        "compile_in_window_s": cw,
+        "compile_programs": comp.get("programs", 0),
+        "cache_hits": comp.get("cache_hits", 0),
+        "realtime_factor": round(sim_s / total_wall, 4),
+        "realtime_factor_ex_compile": round(
+            sim_s / max(total_wall - cw, 1e-9), 4,
+        ),
+    }
     ok_solos = [w for w in solos if "skipped" not in w]
     if ok_solos:
         # rate ratio over the measured solos (fair even when some solo
@@ -918,6 +974,21 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     t_build = time.monotonic()
     sim = Simulation(cfg, world=1)
     state, params, engine = sim.state, sim.params, sim.engine
+    # runtime observatory (obs/runtime.py): measured in like the tracer
+    # and the network observatory — host-side only, digest-identical by
+    # the same gates. The compile ledger records every program the run
+    # compiles (base + gear variants + pressure rungs), the WallLedger
+    # splits each chunk's wall into spans, and the row gains the
+    # runtime{} block tools/bench_compare.py diffs (realtime-factor
+    # drop or compile-wall growth = regression).
+    from shadow_tpu.obs.runtime import (
+        CompileLedger, WallLedger, bench_runtime_block,
+    )
+
+    rt_compiles = CompileLedger()
+    engine.attach_compile_ledger(rt_compiles)
+    wallled = WallLedger()
+    rt_compiles.wall = wallled
     tracer = RoundTracer(sim.engine_cfg.rounds_per_chunk)
     from shadow_tpu.obs.netobs import FlowCollector
 
@@ -964,6 +1035,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
             pressure=cfg.pressure if cfg.pressure.active else None,
             integrity=cfg.integrity if cfg.integrity.enabled else None,
             queue_block=sim.engine_cfg.queue_block,
+            wall=wallled,
         )
     ob_hwm_run = 0  # run-wide outbox high-water (gear runs reset the
     # device counter per chunk, so the run max is folded host-side)
@@ -979,6 +1051,7 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
             snapshot_every_chunks=cfg.faults.supervisor.snapshot_every_chunks,
             max_retries=cfg.faults.supervisor.max_retries,
             backoff_base_s=cfg.faults.supervisor.backoff_base_ms / 1000.0,
+            wall=wallled,
         )
         sup.note_state(state)
 
@@ -1039,12 +1112,17 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
 
     t0 = time.monotonic()
     build_s = t0 - t_build  # capture BEFORE t0 is reused for measurement
-    state = step(state)  # compile + first chunk (controller starts at top)
+    wallled.sync_sim(int(state.now))
+    wallled.chunk_start()
+    with wallled.span("dispatch"):
+        state = step(state)  # compile + first chunk (controller at top)
     compile_s = time.monotonic() - t0
-    tracer.drain(state.trace, wall_t0=t0, wall_t1=time.monotonic())
-    if netcol is not None:
-        netcol.drain(state.flows)
-    _sample_memory(state)
+    with wallled.span("export"):
+        tracer.drain(state.trace, wall_t0=t0, wall_t1=time.monotonic())
+        if netcol is not None:
+            netcol.drain(state.flows)
+        _sample_memory(state)
+    wallled.chunk_end(int(state.now))
     if gearctl is not None:
         # pre-warm the LOWER gear programs outside the timed window: the
         # controller reaches them only a few chunks in, and their
@@ -1062,11 +1140,17 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
     t0 = time.monotonic()
     while not bool(state.done) and not sup_aborted:
         t_c = time.monotonic()
-        state = step(state)
-        tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
-        if netcol is not None:
-            netcol.drain(state.flows)
-        _sample_memory(state)
+        wallled.chunk_start()
+        with wallled.span("dispatch"):
+            state = step(state)
+        with wallled.span("export"):
+            tracer.drain(
+                state.trace, wall_t0=t_c, wall_t1=time.monotonic()
+            )
+            if netcol is not None:
+                netcol.drain(state.flows)
+            _sample_memory(state)
+        wallled.chunk_end(int(state.now))
         if time.monotonic() - t0 >= wall_budget_s:
             break
     wall = max(time.monotonic() - t0, 1e-9)
@@ -1089,14 +1173,21 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
             # near-done snapshot and the row would report its totals over
             # the rerun's tiny wall time
             sup.note_state(state)
+        wallled.sync_sim(int(state.now))
         t0 = time.monotonic()
         while not bool(state.done) and not sup_aborted:
             t_c = time.monotonic()
-            state = step(state)
-            tracer.drain(state.trace, wall_t0=t_c, wall_t1=time.monotonic())
-            if netcol is not None:
-                netcol.drain(state.flows)
-            _sample_memory(state)
+            wallled.chunk_start()
+            with wallled.span("dispatch"):
+                state = step(state)
+            with wallled.span("export"):
+                tracer.drain(
+                    state.trace, wall_t0=t_c, wall_t1=time.monotonic()
+                )
+                if netcol is not None:
+                    netcol.drain(state.flows)
+                _sample_memory(state)
+            wallled.chunk_end(int(state.now))
         wall = max(time.monotonic() - t0, 1e-9)
         sim_adv = int(state.now) / 1e9
         ev_adv = int(jax.device_get(state.stats.events).sum())
@@ -1213,6 +1304,15 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
         },
         "first_chunk_s": round(compile_s, 1),
         "build_s": round(build_s, 1),
+        # runtime block (runtime observatory, PR 14): measured compile
+        # wall (ledger-precise, incl. mid-run pressure-rung compiles
+        # inside the measured window), per-phase shares, and the
+        # realtime factor with in-window compiles excluded — diffed by
+        # tools/bench_compare.py (rt drop / compile-wall growth =
+        # regression, lost block = coverage warning)
+        "runtime": bench_runtime_block(
+            rt_compiles, wallled, sim_adv, wall, window=(t0, t0 + wall)
+        ),
         # fluid block (fluid traffic plane, PR 13): the background
         # byte/drop accounting and hot-link utilization — diffed by
         # tools/bench_compare.py as background-coverage gates (the
@@ -1276,8 +1376,11 @@ def measure(
     )
     sim = Simulation(cfg, world=1)
     state, params, engine = sim.state, sim.params, sim.engine
+    walls: list[float] = []
+    t_c = time.monotonic()
     state = engine.run_chunk(state, params)  # compile + first chunk
     jax.block_until_ready(state)
+    walls.append(time.monotonic() - t_c)
     if bool(state.done):
         # whole sim fit in the compile chunk: rebuild (compile is cached)
         # and time a clean full run
@@ -1290,12 +1393,18 @@ def measure(
         return stop_s / max(time.monotonic() - t0, 1e-9)
     sim0 = int(state.now)
     t0 = time.monotonic()
+    t_c = t0
     while not bool(state.done):
         state = engine.run_chunk(state, params)
         jax.block_until_ready(state)
-        if time.monotonic() - t0 >= wall_budget_s:
+        now = time.monotonic()
+        walls.append(now - t_c)
+        t_c = now
+        if now - t0 >= wall_budget_s:
             break
-    wall = max(time.monotonic() - t0, 1e-9)
+    # the shared compile-exclusion rule (post_compile_stats): the same
+    # walls[0]-carries-the-compile convention every bench path uses
+    wall, _ = post_compile_stats(walls)
     sim_advanced_s = (int(state.now) - sim0) / 1e9
     return sim_advanced_s / wall
 

@@ -480,6 +480,19 @@ class ObservabilityOptions:
     # per rung at report time — skip it on huge configs where recompiles
     # hurt more than the ledger helps.
     memory_ledger: bool = True
+    # Runtime observatory (obs/runtime.py + docs/architecture.md
+    # "Runtime observatory"): wall-clock attribution. A compile ledger
+    # records lowering+compile wall per cached jitted program (base
+    # chunk, gear variants, pressure rungs) with its trigger and
+    # hit/miss counts; a WallLedger splits each chunk's wall into named
+    # spans (compile / dispatch / host-python / snapshot / replay /
+    # export) and tracks a per-chunk realtime factor (sim-s/wall-s); the
+    # hybrid driver adds the per-window bridge-stall split. Exported as
+    # a `runtime{}` sim-stats block, an `rt=` heartbeat field, and a
+    # compile track in the Chrome trace. Pure host-side observer: NO
+    # traced code changes — digests and the compiled programs are
+    # byte-identical on or off (tests/test_runtime.py is the gate).
+    runtime: bool = False
 
     @staticmethod
     def from_dict(d: dict[str, Any] | None) -> "ObservabilityOptions":
@@ -493,6 +506,7 @@ class ObservabilityOptions:
             network=bool(d.pop("network", False)),
             network_flows=int(d.pop("network_flows", 4096)),
             memory_ledger=bool(d.pop("memory_ledger", True)),
+            runtime=bool(d.pop("runtime", False)),
         )
         if o.network_flows < 0:
             raise ConfigError(

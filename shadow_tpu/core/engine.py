@@ -1122,6 +1122,23 @@ class Engine:
         # pressure plane's escalated programs (core/pressure.py). Bounded
         # by the escalation ladders (a handful of rungs per axis).
         self._resized_chunks: dict[tuple, Any] = {}
+        # runtime observatory (obs/runtime.CompileLedger): when attached,
+        # every cached chunk program is wrapped so its first (compiling)
+        # call is recorded with its trigger. HOST-SIDE only — wrapping a
+        # jitted callable cannot change the traced program.
+        self.compile_ledger = None
+
+    def attach_compile_ledger(self, ledger):
+        """Attach an `obs.runtime.CompileLedger` so cache misses in the
+        chunk-program caches record their compile walls. Safe before OR
+        after `init_state` (jit compiles lazily — a not-yet-called
+        program still records on its first call); attach before the
+        first dispatch or the base program's compile goes unrecorded."""
+        self.compile_ledger = ledger
+        if self.run_chunk is not None and ledger is not None:
+            self.run_chunk = ledger.instrument(
+                "chunk", "base", "cold_start", self.run_chunk
+            )
 
     def _jit_chunk(self, cfg: EngineConfig):
         """Build one jitted chunk program for `cfg` — shared by the
@@ -1137,7 +1154,12 @@ class Engine:
         return jax.jit(chunk, donate_argnums=0)
 
     def _build_run_chunk(self):
-        self.run_chunk = self._jit_chunk(self.cfg)
+        fn = self._jit_chunk(self.cfg)
+        if self.compile_ledger is not None:
+            fn = self.compile_ledger.instrument(
+                "chunk", "base", "cold_start", fn
+            )
+        self.run_chunk = fn
 
     def run_chunk_gear(self, state: SimState, params: EngineParams, gear_cols: int):
         """Run one chunk at a merge gear (`gear_cols` outbox columns in the
@@ -1158,6 +1180,10 @@ class Engine:
             fn = self._jit_chunk(
                 dataclasses.replace(self.cfg, gear_cols=gear_cols)
             )
+            if self.compile_ledger is not None:
+                fn = self.compile_ledger.instrument(
+                    "chunk", f"gear={gear_cols}", "gear_shift", fn
+                )
             self._gear_chunks[gear_cols] = fn
         return fn(state, params)
 
@@ -1195,6 +1221,13 @@ class Engine:
             fn = self._jit_chunk(self.resized_cfg(
                 gear_cols, queue_capacity, send_budget
             ))
+            if self.compile_ledger is not None:
+                fn = self.compile_ledger.instrument(
+                    "chunk",
+                    f"cap={queue_capacity}/box={send_budget}"
+                    f"/gear={gear_cols}",
+                    "pressure_regrow", fn,
+                )
             self._resized_chunks[key] = fn
         return fn(state, params)
 

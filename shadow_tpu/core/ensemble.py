@@ -274,6 +274,18 @@ class EnsembleEngine:
         )
         return tree_stack(states)
 
+    def attach_compile_ledger(self, ledger):
+        """Runtime-observatory hook (obs/runtime.CompileLedger — same
+        contract as core.Engine.attach_compile_ledger): wrap the vmapped
+        chunk program so its cold compile is recorded with hit counts.
+        Host-side observation only; attach after `build`, before the
+        first dispatch."""
+        if ledger is not None and self._chunk is not None:
+            self._chunk = ledger.instrument(
+                "ensemble", f"R={self.num_replicas}", "cold_start",
+                self._chunk,
+            )
+
     def run_chunk(self, state: SimState) -> SimState:
         """Advance every replica one chunk (frozen replicas — done, or
         out of rounds — keep their carries bit-exactly via the while-loop

@@ -55,6 +55,7 @@ zero-drop condition, not by anything this module computes.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
@@ -143,6 +144,7 @@ class ResilienceController:
         reshard=None,
         log=None,
         memory=None,
+        wall=None,
     ):
         self.gearctl = gearctl
         self.pressure = pressure
@@ -170,6 +172,11 @@ class ResilienceController:
         self._reshard = reshard
         self._log = log
         self.memory = memory  # obs.memory.MemoryGuard | None
+        # runtime observatory (obs/runtime.WallLedger | None): snapshot
+        # copies, restores, and replay attempts re-attribute their wall
+        # out of the driver's enclosing dispatch span. Host-side only —
+        # never consulted for any decision.
+        self.wall = wall
         self.policy = pressure.policy if pressure is not None else "drop"
         self.escalate = self.policy == "escalate"
         self.abort_on_drop = self.policy == "abort"
@@ -272,6 +279,30 @@ class ResilienceController:
         if self._log is not None:
             print(f"[pressure] {msg}", file=self._log)
 
+    # ---- wall attribution (obs/runtime.py; no-ops without a ledger) --------
+
+    def _wall_move(self, to: str, sec: float):
+        """Re-attribute `sec` of the driver's enclosing dispatch span to
+        the snapshot/replay span — observation only."""
+        if self.wall is not None:
+            self.wall.reattribute("dispatch", to, sec)
+
+    def _snap_timed(self, state):
+        from shadow_tpu.core.checkpoint import snapshot_state
+
+        t0 = time.perf_counter()
+        snap = snapshot_state(state)
+        self._wall_move("snapshot", time.perf_counter() - t0)
+        return snap
+
+    def _restore_timed(self, snap):
+        from shadow_tpu.core.checkpoint import restore_snapshot
+
+        t0 = time.perf_counter()
+        out = restore_snapshot(snap)
+        self._wall_move("replay", time.perf_counter() - t0)
+        return out
+
     # ---- migration ---------------------------------------------------------
 
     def migrate(self, state, new_cap: int, new_budget: int):
@@ -327,8 +358,6 @@ class ResilienceController:
         state's own shapes are the only truth about which program runs."""
         import jax
 
-        from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
-
         gearctl = self.gearctl
         gear = gearctl.gear if gearctl is not None else 0
         pressured = self.pressure is not None
@@ -344,7 +373,7 @@ class ResilienceController:
             or self.escalate
             or self.integrity_on
         )
-        snap = snapshot_state(state) if need_snap else None
+        snap = self._snap_timed(state) if need_snap else None
         self._last_snap = snap
         # integrity classifier state, chunk-scoped: the last violating
         # attempt's (shard, round, mask) signature and how many
@@ -362,6 +391,11 @@ class ResilienceController:
             if self.test_scribble is not None:
                 state = self.test_scribble(state, attempt_i)
             attempt_i += 1
+            t_disp = time.perf_counter()
+            comp0 = (
+                self.wall.pending_to("compile")
+                if self.wall is not None else 0.0
+            )
             try:
                 out = dispatch(state, gear, cap, budget)
                 jax.block_until_ready(out)
@@ -390,7 +424,7 @@ class ResilienceController:
                     # contract, ops/events.py).
                     self.oom_fallbacks += 1
                     self.last_error = f"{type(e).__name__}: {e}"
-                    restored = restore_snapshot(snap)
+                    restored = self._restore_timed(snap)
                     lower_cap, lower_box = cap, budget
                     if grown_cap:
                         import jax.numpy as jnp
@@ -436,10 +470,18 @@ class ResilienceController:
                     )
                     state = self.migrate(restored, lower_cap, lower_box)
                     cap, budget = lower_cap, lower_box
-                    snap = snapshot_state(state)
+                    snap = self._snap_timed(state)
                     self._last_snap = snap
                     continue
                 raise
+            if self.wall is not None and attempt_i > 1:
+                # a replay attempt's wall, minus whatever compile
+                # pipeline the regrown program just paid (that part is
+                # already bound for the compile span — moving it twice
+                # would double-count)
+                sec = time.perf_counter() - t_disp
+                sec -= self.wall.pending_to("compile") - comp0
+                self._wall_move("replay", sec)
             if self.integrity_on:
                 # integrity arbitration FIRST: a violating attempt's
                 # other counters (shed/pressure) may themselves be
@@ -498,7 +540,7 @@ class ResilienceController:
                         f"(attempt {iv_attempts}/"
                         f"{self.integrity.max_replays})"
                     )
-                    state = restore_snapshot(snap)
+                    state = self._restore_timed(snap)
                     continue
                 if iv_last_sig is not None:
                     # the replay came back clean: the violation was
@@ -521,7 +563,7 @@ class ResilienceController:
                     np.asarray(jax.device_get(out.stats.outbox_hwm)).max()
                 )
                 gear = gearctl.note_shed(seen)
-                state = restore_snapshot(snap)
+                state = self._restore_timed(snap)
                 continue
             if pressured:
                 delta = self._pressure_total(out) - press0
@@ -561,8 +603,6 @@ class ResilienceController:
         attempt's per-category deltas, restore the pre-chunk snapshot,
         migrate, and hand the loop the new shape. Raises PressureAbort
         when cornered (a dropping axis cannot grow)."""
-        from shadow_tpu.core.checkpoint import restore_snapshot, snapshot_state
-
         cats = self._pressure_categories(aborted)
         queue_side = cats["queue"] > cats0["queue"]
         box_side = (
@@ -608,8 +648,8 @@ class ResilienceController:
             f"capacity drop at (cap={cap}, outbox={budget}); replaying "
             f"chunk at (cap={new_cap}, outbox={new_budget})"
         )
-        state = self.migrate(restore_snapshot(snap), new_cap, new_budget)
-        snap = snapshot_state(state)
+        state = self.migrate(self._restore_timed(snap), new_cap, new_budget)
+        snap = self._snap_timed(state)
         self._last_snap = snap
         return state, gear, new_cap, new_budget, snap
 

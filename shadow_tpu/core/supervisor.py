@@ -111,7 +111,12 @@ class ChunkSupervisor:
         log=None,
         memory=None,
         memory_modeled_fn=None,
+        wall=None,
     ):
+        # optional obs.runtime.WallLedger: snapshot copies and retry
+        # (replay) wall re-attribute out of the driver's enclosing
+        # dispatch span. Observation only — never consulted.
+        self.wall = wall
         # optional obs.memory.MemoryMonitor: sampled at the moment a
         # dispatch FAILS, so the retry log and report() pin each failure
         # against the live HBM picture (an OOM-flavored failure with the
@@ -151,11 +156,29 @@ class ChunkSupervisor:
         if self._log is not None:
             print(f"[supervisor] {msg}", file=self._log)
 
+    def _wall_move(self, to: str, sec: float):
+        if self.wall is not None:
+            self.wall.reattribute("dispatch", to, sec)
+
+    def _wall_pending_inner(self) -> float:
+        """Seconds already claimed by inner instruments (a nested
+        pressure controller's snapshot/replay moves, a compile) in the
+        open chunk — subtracted so the supervisor's own replay move
+        cannot double-count them."""
+        if self.wall is None:
+            return 0.0
+        return sum(
+            self.wall.pending_to(n)
+            for n in ("compile", "snapshot", "replay")
+        )
+
     def _take_snapshot(self, state):
         from shadow_tpu.core.checkpoint import snapshot_state
 
+        t0 = time.perf_counter()
         self._snap = snapshot_state(state)
         self._snap_sig = state_digest_sig(self._snap)
+        self._wall_move("snapshot", time.perf_counter() - t0)
         self._chunks_since_snap = 0
         self.snapshots += 1
 
@@ -195,6 +218,8 @@ class ChunkSupervisor:
             self._take_snapshot(state)
         attempt = 0
         while True:
+            t_disp = time.perf_counter()
+            inner0 = self._wall_pending_inner()
             try:
                 out = dispatch(state)
                 # block here so an async dispatch failure surfaces inside
@@ -249,9 +274,23 @@ class ChunkSupervisor:
                     f"dispatch failed ({self.last_error}); retry "
                     f"{attempt}/{self.max_retries} in {delay:.2f}s"
                 )
+                # the backoff sleep is idle time, not replay work — it
+                # stays in the enclosing dispatch span so the replay
+                # share measures only the restore + re-dispatch cost
                 time.sleep(delay)
+                t_rec = time.perf_counter()
                 state = self._restore_checked()
+                self._wall_move("replay", time.perf_counter() - t_rec)
                 continue
+            if attempt > 0:
+                # a retried dispatch IS the replay (minus whatever inner
+                # instruments — compile, a nested controller's snapshot
+                # or replay — already claimed from this interval)
+                self._wall_move(
+                    "replay",
+                    (time.perf_counter() - t_disp)
+                    - (self._wall_pending_inner() - inner0),
+                )
             self._chunks_since_snap += 1
             if not self.pre_dispatch and (
                 self._chunks_since_snap >= self.snapshot_every

@@ -415,6 +415,18 @@ class HybridSimulation:
             from shadow_tpu.obs import RoundTracer
 
             self._tracer = RoundTracer(self.engine_cfg.trace_rounds)
+        # runtime observatory (obs/runtime.py): per-window bridge-stall
+        # split (ROADMAP item 4's before/after instrument) + the compile
+        # ledger over the bridge's jitted programs. Host-side only —
+        # must exist before the jitted ops below are built so their
+        # cold compiles are recorded.
+        self._bridge_rt = None
+        self._rt_compiles = None
+        if cfg.observability.runtime:
+            from shadow_tpu.obs.runtime import BridgeTelemetry, CompileLedger
+
+            self._bridge_rt = BridgeTelemetry()
+            self._rt_compiles = CompileLedger()
         self._pcaps = []
         self._strace_files = []
         data_dir = cfg.general.data_directory
@@ -495,6 +507,10 @@ class HybridSimulation:
                 self.engine.state_specs(),
             )
         self._prepare = jax.jit(prepare, donate_argnums=0)
+        if self._rt_compiles is not None:
+            self._prepare = self._rt_compiles.instrument(
+                "prepare", "base", "cold_start", self._prepare
+            )
 
         def _mk_guarded(ecfg):
             """The guarded round loop jitted at one engine config —
@@ -518,7 +534,15 @@ class HybridSimulation:
                     g, self.mesh,
                     (state_spec, self.engine.param_specs(), P()), state_spec,
                 )
-            return jax.jit(g, donate_argnums=0)
+            fn = jax.jit(g, donate_argnums=0)
+            if self._rt_compiles is not None:
+                gear = getattr(ecfg, "gear_cols", 0)
+                fn = self._rt_compiles.instrument(
+                    "guarded",
+                    f"gear={gear}" if gear else "base",
+                    "gear_shift" if gear else "cold_start", fn,
+                )
+            return fn
 
         self._mk_guarded = _mk_guarded
         self._guarded = _mk_guarded(self.engine_cfg)
@@ -684,7 +708,12 @@ class HybridSimulation:
             self.engine_cfg.runahead_floor, self.engine_cfg.static_min_latency, 1
         )
         windows = 0
+        bt = self._bridge_rt  # obs/runtime.BridgeTelemetry | None
         while True:
+            if bt is not None:
+                # the joint-barrier computation below is bridge work:
+                # it lands in the window's bridge residual
+                bt.window_start()
             dev_min = int(jnp.min(q_next_time(self.state.queue)))
             t_next = min(self._cpu_min_next(), dev_min)
             if t_next >= stop:
@@ -693,8 +722,11 @@ class HybridSimulation:
             try:
                 if self.engine_cfg.integrity:
                     self._bridge_guard_clock(t_next)
+                t_host = time.monotonic()
                 with self.perf.time("host_plane"):
                     self._execute_hosts(window_end)
+                if bt is not None:
+                    bt.note("cpu_plane", time.monotonic() - t_host)
                 if self.engine_cfg.integrity:
                     # judged while the window's staged sends actually
                     # EXIST (post host execution, pre injection) — at
@@ -712,16 +744,38 @@ class HybridSimulation:
             # host-bound deliveries (the CPU plane must react) or the
             # device catches up to the CPU plane's next event.
             with self.perf.time("device_inject"):
-                self.state = self._inject()
-                while self._staged:
+                if bt is None:
                     self.state = self._inject()
-                # settle the staged merge BEFORE the timer stops: jax
-                # dispatch is async, so without the block this phase timed
-                # only the enqueue and the device work leaked into
-                # whichever phase synced first — perf.report() under-
-                # reported the device plane (the reference's perf_timers
-                # wrap the actual work, host.rs:721-729)
-                jax.block_until_ready(self.state)
+                    while self._staged:
+                        self.state = self._inject()
+                    # settle the staged merge BEFORE the timer stops: jax
+                    # dispatch is async, so without the block this phase
+                    # timed only the enqueue and the device work leaked
+                    # into whichever phase synced first — perf.report()
+                    # under-reported the device plane (the reference's
+                    # perf_timers wrap the actual work, host.rs:721-729)
+                    jax.block_until_ready(self.state)
+                else:
+                    # per-syscall-batch latency: each staged merge is one
+                    # batch, blocked individually so its histogram entry
+                    # is a true round-trip latency (the instrument's cost
+                    # when on; the off path above keeps one block total)
+                    while True:
+                        n_batch = min(len(self._staged), self.staging_cap)
+                        t_b = time.monotonic()
+                        self.state = self._inject()
+                        jax.block_until_ready(self.state)
+                        if n_batch > 0:
+                            bt.note_batch(time.monotonic() - t_b, n_batch)
+                        else:
+                            # the off path's unconditional first _inject
+                            # on an empty staging list: bridge wall, but
+                            # NOT a syscall batch — an empty merge in the
+                            # histogram would dilute the round-trip
+                            # latencies ROADMAP item 4 reads
+                            bt.note("bridge", time.monotonic() - t_b)
+                        if not self._staged:
+                            break
             until = min(self._cpu_min_next(), stop)
             t_rounds = time.monotonic()
             try:
@@ -766,6 +820,8 @@ class HybridSimulation:
                     )
                 self._integrity_aborted = str(e)
                 break
+            if bt is not None:
+                bt.note("device_plane", time.monotonic() - t_rounds)
             if self._tracer is not None:
                 self._tracer.drain(
                     self.state.trace,
@@ -778,8 +834,13 @@ class HybridSimulation:
                 )
                 if self._tracer is not None:
                     self._tracer.note_memory(t_s, shard_bytes)
+            t_drain = time.monotonic()
             with self.perf.time("drain_captures"):
                 self._drain_captures()
+            if bt is not None:
+                # capture draining is bridge marshalling, like staging
+                bt.note("bridge", time.monotonic() - t_drain)
+                bt.window_end(window_end)
             windows += 1
             if self.log is not None and hb_ns and window_end >= next_hb:
                 self.log.info(
@@ -811,6 +872,12 @@ class HybridSimulation:
                         f"ek={int(np.asarray(_s.ec_timer).sum())}/"
                         f"{int(np.asarray(_s.ec_pkt).sum())} "
                     )
+                # rt= rides along only on runtime-observatory runs: the
+                # LAST window's realtime factor (sim-s/wall-s)
+                rt_f = (
+                    f"rt={bt.rt_last:.2f} "
+                    if bt is not None and bt.rt_last is not None else ""
+                )
                 print(
                     f"[heartbeat] sim_time={window_end / NS_PER_SEC:.3f}s "
                     f"wall={wall:.2f}s windows={windows} "
@@ -818,6 +885,7 @@ class HybridSimulation:
                     f"{gear_f}"
                     f"{hbm_f}"
                     f"{ek_f}"
+                    f"{rt_f}"
                     f"ratio={window_end / NS_PER_SEC / max(wall, 1e-9):.2f}x "
                     f"{simmod.resource_heartbeat()}",
                     file=log,
@@ -1130,6 +1198,20 @@ class HybridSimulation:
             snap = getattr(p, "state_at_stop", None)
             return snap if snap is not None else getattr(p.state, "value", p.state)
 
+        runtime_block: dict[str, Any] = {}
+        if self.cfg.observability.runtime:
+            # runtime observatory block (obs/runtime.py): the bridge-
+            # stall split + compile ledger, assembled by the ONE shared
+            # helper the modeled driver and bench rows use
+            from shadow_tpu.obs.runtime import assemble_runtime_report
+
+            runtime_block = {
+                "runtime": assemble_runtime_report(
+                    bridge=getattr(self, "_bridge_rt", None),
+                    compiles=getattr(self, "_rt_compiles", None),
+                    total_wall_s=wall,
+                )
+            }
         zombies = [p for p in self.procs if pstate(p) == "zombie"]
         failures = sum(
             1
@@ -1172,6 +1254,7 @@ class HybridSimulation:
             "processes_exited": len(zombies),
             "determinism_digest": f"{int(np.bitwise_xor.reduce(jax.device_get(self.state.stats.digest)[:n])):016x}",
             "perf": self.perf.report(),
+            **runtime_block,
             "model_report": self.model.report(
                 jax.device_get(self.state.model), None
             ),
@@ -1322,6 +1405,8 @@ class HybridSimulation:
                     f,
                 )
         if self._tracer is not None:
+            if self._rt_compiles is not None:
+                self._tracer.note_compiles(self._rt_compiles.events())
             self._tracer.write_artifacts(
                 data_dir, self.cfg.observability, report
             )

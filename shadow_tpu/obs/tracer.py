@@ -163,6 +163,12 @@ class RoundTracer:
         # most ONE violating round per attempt anyway (the loop aborts
         # there), so per-abort notes are complete.
         self._violations: list[dict] = []
+        # runtime-observatory compile records (obs/runtime.CompileLedger
+        # .events(): (label, t0_monotonic, duration_s)) — exported as a
+        # compile track on the wall-clock timeline. A side channel like
+        # the violations above: compiles happen host-side between (or
+        # before) chunks, never inside the ring.
+        self._compiles: list[tuple[str, float, float]] = []
 
     # ---- collection --------------------------------------------------------
 
@@ -260,6 +266,15 @@ class RoundTracer:
         if room:
             self._flows.append(np.asarray(records[:room], np.int64))
         self._flows_seen += n
+
+    def note_compiles(self, events) -> None:
+        """Adopt the runtime observatory's compile records ((label, t0,
+        duration_s) tuples against the monotonic clock) for the
+        wall-clock compile track. Replaces any prior set — the drivers
+        hand over the ledger's full event list at export time."""
+        self._compiles = [
+            (str(n), float(t0), float(d)) for n, t0, d in events
+        ]
 
     def note_violation(self, info: dict) -> None:
         """Record a deterministic integrity violation (the controller's
@@ -364,25 +379,47 @@ class RoundTracer:
                         "retransmits": int(rec[FCOL_RETRANSMITS]),
                     },
                 })
+        # wall-clock anchor: the earliest of the first chunk's start,
+        # the first memory sample, and the first compile's t0 — the base
+        # program compiles BEFORE the first chunk dispatch, and an
+        # anchor after it would put the compile track at negative ts
+        wall0 = self._wall0
+        if self._memory and wall0 is None:
+            wall0 = self._memory[0][0]
+        if self._compiles:
+            c0 = min(t0 for _n, t0, _d in self._compiles)
+            wall0 = c0 if wall0 is None else min(wall0, c0)
         for i, c in enumerate(self._chunks):
             ev.append({
                 "name": f"chunk {i}", "cat": "chunk", "ph": "X",
-                "ts": (c["t0"] - (self._wall0 or 0.0)) * 1e6,
+                "ts": (c["t0"] - (wall0 or 0.0)) * 1e6,
                 "dur": max((c["t1"] - c["t0"]) * 1e6, 1.0),
                 "pid": 2, "tid": 1,
                 "args": {"rounds": c["rounds"]},
             })
         # wall-clock HBM counter track (obs/memory.py samples): Chrome's
         # "C" events render a stacked per-shard area under the chunk track
-        if self._memory and self._wall0 is None:
-            self._wall0 = self._memory[0][0]
         for t, shards in self._memory:
             ev.append({
                 "name": "hbm_bytes", "cat": "memory", "ph": "C",
-                "ts": (t - (self._wall0 or 0.0)) * 1e6,
+                "ts": (t - (wall0 or 0.0)) * 1e6,
                 "pid": 2, "tid": 1,
                 "args": {f"shard{s}": b for s, b in enumerate(shards)},
             })
+        # runtime-observatory compile track (obs/runtime.CompileLedger):
+        # one X event per recorded program compile, under the chunk
+        # track — a cold compile inside a chunk's wall reads directly
+        # against that chunk's span
+        if self._compiles:
+            ev.append({"ph": "M", "name": "thread_name", "pid": 2,
+                       "tid": 2, "args": {"name": "compiles"}})
+            for name, t0, dur in self._compiles:
+                ev.append({
+                    "name": name, "cat": "compile", "ph": "X",
+                    "ts": (t0 - (wall0 or 0.0)) * 1e6,
+                    "dur": max(dur * 1e6, 1.0),
+                    "pid": 2, "tid": 2,
+                })
         # integrity-violation track: one instant event per recorded
         # deterministic violation, anchored to the violating round's
         # window when its row was traced (violating attempts are usually

@@ -357,6 +357,7 @@ def heartbeat_line(
     fct: int | None = None,
     bg: tuple[int, int] | None = None,
     iv: tuple[int, int] | None = None,
+    rt: float | None = None,
     rep: tuple[int, int] | None = None,
 ) -> str:
     """The `[heartbeat]` progress line, shared by the Simulation run loop
@@ -374,7 +375,11 @@ def heartbeat_line(
     (background bytes delivered, background bytes dropped) — only on
     fluid-traffic-plane runs (net/fluid.py); `iv` is
     (transient SDC survived, sentinel replays) — only on
-    integrity-sentinel runs (core/integrity.py)."""
+    integrity-sentinel runs (core/integrity.py); `rt` is the LAST
+    chunk's realtime factor (sim-s/wall-s) — only on runtime-observatory
+    runs (obs/runtime.py; unlike `ratio=`, which is the run-cumulative
+    average, `rt=` is the fresh per-chunk number the serving posture
+    tracks)."""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
     gear_f = f"gear={gear} " if gear is not None else ""
     cap_f = f"cap={cap} " if cap is not None else ""
@@ -383,6 +388,7 @@ def heartbeat_line(
     fct_f = f"fct={fct} " if fct is not None else ""
     bg_f = f"bg={bg[0]}/{bg[1]} " if bg is not None else ""
     iv_f = f"iv={iv[0]}/{iv[1]} " if iv is not None else ""
+    rt_f = f"rt={rt:.2f} " if rt is not None else ""
     rep_f = f"rep={rep[0]}/{rep[1]} " if rep is not None else ""
     return (
         f"[heartbeat] sim_time={now_ns / NS_PER_SEC:.3f}s "
@@ -399,6 +405,7 @@ def heartbeat_line(
         f"{fct_f}"
         f"{bg_f}"
         f"{iv_f}"
+        f"{rt_f}"
         f"{rep_f}"
         f"ratio={now_ns / NS_PER_SEC / max(wall, 1e-9):.2f}x "
         f"{resource_heartbeat()}"
@@ -639,6 +646,15 @@ class Simulation:
         if world > 1:
             mesh = jax.sharding.Mesh(np.array(jax.devices()[:world]), ("hosts",))
         self.engine = Engine(self.engine_cfg, self.model, mesh)
+        # runtime observatory (obs/runtime.py): the compile ledger hooks
+        # the engine's program caches BEFORE the first dispatch so the
+        # base program's cold compile is recorded. Host-side only.
+        self._rt_compiles = None
+        if cfg.observability.runtime:
+            from shadow_tpu.obs.runtime import CompileLedger
+
+            self._rt_compiles = CompileLedger()
+            self.engine.attach_compile_ledger(self._rt_compiles)
         self._build_state()
 
     # ---- build ------------------------------------------------------------
@@ -776,6 +792,24 @@ class Simulation:
             self._modeled_shard_bytes = lambda: modeled_shard_bytes(
                 self.state, self.params, self.engine_cfg.world
             )
+        # runtime observatory (obs/runtime.py): the wall-clock
+        # attribution plane. Per-chunk spans (dispatch / export /
+        # snapshot / replay / compile, residual = host_python) plus a
+        # per-chunk realtime-factor series feeding the `rt=` heartbeat
+        # field. Host-side observer only.
+        from shadow_tpu.obs.runtime import span_or_null
+
+        wallled = None
+        if cfg.observability.runtime:
+            from shadow_tpu.obs.runtime import WallLedger
+
+            wallled = WallLedger()
+            wallled.sync_sim(int(self.state.now))
+            self._wallled = wallled
+            if self._rt_compiles is not None:
+                # compiles recorded mid-chunk reattribute their seconds
+                # out of the enclosing dispatch span
+                self._rt_compiles.wall = wallled
         gearctl = None
         resilience = None
         pressure_on = cfg.pressure.active
@@ -827,6 +861,7 @@ class Simulation:
                 reshard=reshard,
                 log=log,
                 memory=memguard,
+                wall=wallled,
             )
             self._pressctl = resilience if pressure_on else None
             self._resil = resilience
@@ -876,6 +911,7 @@ class Simulation:
                     self._modeled_shard_bytes if monitor is not None
                     else None
                 ),
+                wall=wallled,
             )
             self._supervisor = sup
             sup.note_state(self.state)
@@ -943,14 +979,25 @@ class Simulation:
         try:
             while not bool(self.state.done):
                 t_chunk = time.monotonic()
+                if wallled is not None:
+                    wallled.chunk_start()
                 if capture is not None:
-                    self.state, sent = capture.step(self.state, self.params)
-                    capture.write_round(sent)
+                    with span_or_null(wallled, "dispatch"):
+                        self.state, sent = capture.step(
+                            self.state, self.params
+                        )
+                        if wallled is not None:
+                            jax.block_until_ready(self.state)
+                    with span_or_null(wallled, "export"):
+                        capture.write_round(sent)
                 elif sup is not None:
                     from shadow_tpu.core.supervisor import SupervisorAbort
 
                     try:
-                        self.state = sup.run_chunk(self.state, _chunk_step)
+                        with span_or_null(wallled, "dispatch"):
+                            self.state = sup.run_chunk(
+                                self.state, _chunk_step
+                            )
                     except IntegrityAbort as e:
                         _policy_abort(e, t_chunk, kind="integrity")
                         break
@@ -991,35 +1038,46 @@ class Simulation:
                         break
                 else:
                     try:
-                        self.state = _chunk_step(self.state)
+                        with span_or_null(wallled, "dispatch"):
+                            self.state = _chunk_step(self.state)
+                            if wallled is not None:
+                                # async dispatch: without the block the
+                                # device time would leak into whichever
+                                # span syncs first
+                                jax.block_until_ready(self.state)
                     except IntegrityAbort as e:
                         _policy_abort(e, t_chunk, kind="integrity")
                         break
                     except PressureAbort as e:
                         _policy_abort(e, t_chunk)
                         break
-                if tracer is not None:
-                    # pair the drained rounds with the true wall span of
-                    # this dispatch (block: async dispatch would pin the
-                    # span to enqueue time, not device time)
-                    jax.block_until_ready(self.state)
-                    tracer.drain(
-                        self.state.trace,
-                        wall_t0=t_chunk, wall_t1=time.monotonic(),
-                    )
-                if flowcol is not None:
-                    jax.block_until_ready(self.state)
-                    _drain_flows()
-                if monitor is not None:
-                    t_s = time.monotonic()
-                    shard_bytes = monitor.sample(
-                        modeled_bytes=self._modeled_shard_bytes(),
-                        wall_t=t_s,
-                    )
+                with span_or_null(wallled, "export"):
                     if tracer is not None:
-                        tracer.note_memory(t_s, shard_bytes)
+                        # pair the drained rounds with the true wall span
+                        # of this dispatch (block: async dispatch would
+                        # pin the span to enqueue time, not device time)
+                        jax.block_until_ready(self.state)
+                        tracer.drain(
+                            self.state.trace,
+                            wall_t0=t_chunk, wall_t1=time.monotonic(),
+                        )
+                    if flowcol is not None:
+                        jax.block_until_ready(self.state)
+                        _drain_flows()
+                    if monitor is not None:
+                        t_s = time.monotonic()
+                        shard_bytes = monitor.sample(
+                            modeled_bytes=self._modeled_shard_bytes(),
+                            wall_t=t_s,
+                        )
+                        if tracer is not None:
+                            tracer.note_memory(t_s, shard_bytes)
                 chunks += 1
                 now_ns = int(self.state.now)
+                if wallled is not None:
+                    # close the chunk (heartbeat/progress printing below
+                    # lands in the NEXT chunk's host_python residual)
+                    wallled.chunk_end(now_ns)
                 wall = time.monotonic() - t0
                 if hb_ns and now_ns >= next_hb:
                     ev = int(np.asarray(self.state.stats.events).sum())
@@ -1080,11 +1138,16 @@ class Simulation:
                         (resilience.iv_transients, resilience.iv_replays)
                         if integrity_on and resilience is not None else None
                     )
+                    # rt= rides along only on runtime-observatory runs:
+                    # the LAST chunk's realtime factor (sim-s/wall-s)
+                    rt = (
+                        wallled.rt_last if wallled is not None else None
+                    )
                     print(
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
                             fault=fault, gear=last_gear, cap=cap, hbm=hbm,
-                            ek=ek, fct=fct, bg=bg, iv=iv,
+                            ek=ek, fct=fct, bg=bg, iv=iv, rt=rt,
                         ),
                         file=log,
                     )
@@ -1336,6 +1399,19 @@ class Simulation:
                 self.engine, self.state, self.params, memmon,
                 ledger=self.cfg.observability.memory_ledger,
             )
+        if self.cfg.observability.runtime:
+            # runtime observatory block (obs/runtime.py): per-span
+            # wall attribution + realtime-factor series + the compile
+            # ledger — assembled by the ONE shared helper (the hybrid
+            # driver and bench rows use the same one, so the block's
+            # shape cannot drift between exporters)
+            from shadow_tpu.obs.runtime import assemble_runtime_report
+
+            report["runtime"] = assemble_runtime_report(
+                wall=getattr(self, "_wallled", None),
+                compiles=getattr(self, "_rt_compiles", None),
+                total_wall_s=wall,
+            )
         sup = getattr(self, "_supervisor", None)
         if sup is not None:
             report["supervisor"] = sup.report()
@@ -1455,6 +1531,12 @@ class Simulation:
         metrics) into the data dir. No-op unless `observability.trace` ran."""
         tracer = getattr(self, "_tracer", None)
         if tracer is not None:
+            compiles = getattr(self, "_rt_compiles", None)
+            if compiles is not None:
+                # runtime observatory: the compile track (one X event
+                # per recorded program compile on the wall-clock
+                # timeline, obs/runtime.CompileLedger.events)
+                tracer.note_compiles(compiles.events())
             tracer.write_artifacts(data_dir, self.cfg.observability, report)
 
 

@@ -1,0 +1,753 @@
+"""Runtime observatory (`observability.runtime`, shadow_tpu/obs/runtime.py).
+
+Gates, mirroring the ISSUE acceptance:
+  - observer exactness: digests, event counts, and drop counters are
+    bit-identical with the compile ledger attached vs not, across
+    echo/phold/tgen x flat/bucketed x K{1,4} (engine harness, in
+    process) plus a world-8 subprocess leg (tests/subproc.py, this
+    box's documented jaxlib-0.4.37 corruption posture);
+  - compile-ledger correctness: exactly one cold_start entry per jitted
+    program, cache hits counted per later call, and — against a forced
+    pressure regrow (Simulation, escalate policy, undersized capacity)
+    — each new (gear, capacity, budget) rung is exactly one recorded
+    compile carrying the pressure_regrow trigger, reconciled against
+    the engine's own program caches;
+  - WallLedger exactness: per-chunk span sums equal the chunk wall by
+    construction (host_python is the residual), reattribution moves
+    seconds without double-counting, and the realtime-factor series
+    tracks sim-s/wall-s;
+  - BridgeTelemetry: lanes sum to the window wall (bridge is the
+    residual) and the syscall-batch histogram counts every batch;
+  - heartbeat `rt=` strict round-trip through parse_shadow;
+  - bench helpers: post_compile_stats (the shared compile-chunk
+    exclusion rule) and bench_runtime_block's diffable shape;
+  - rt_report CLI smoke on a real run's artifacts (tests/subproc.py).
+
+Engine-harness legs run in-process (the stable path on this box);
+compiled-Simulation legs go through tests/subproc.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import Engine
+from shadow_tpu.obs.runtime import (
+    INJECT_HIST_EDGES_S,
+    SPAN_NAMES,
+    BridgeTelemetry,
+    CompileLedger,
+    WallLedger,
+    assemble_runtime_report,
+    bench_runtime_block,
+    span_or_null,
+)
+from tests.engine_harness import build_sim, mk_hosts
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------------------
+# WallLedger: per-chunk exactness + reattribution
+# ---------------------------------------------------------------------------
+
+
+def test_wall_ledger_chunk_spans_sum_to_chunk_wall():
+    w = WallLedger()
+    w.sync_sim(0)
+    w.chunk_start()
+    with w.span("dispatch"):
+        time.sleep(0.02)
+    with w.span("export"):
+        time.sleep(0.01)
+    time.sleep(0.01)  # uncovered -> host_python residual
+    rt = w.chunk_end(3_000_000_000)
+    assert rt is not None and rt > 0
+    assert len(w.chunks) == 1
+    c = w.chunks[0]
+    # exactness by construction: residual is folded into host_python
+    assert abs(sum(c["spans"].values()) - c["wall_s"]) < 1e-9
+    assert c["spans"]["host_python"] > 0
+    assert c["sim_ns"] == 3_000_000_000
+    # totals mirror the single chunk
+    assert abs(sum(w.totals.values()) - c["wall_s"]) < 1e-9
+    # rt = sim seconds / wall seconds
+    assert rt == pytest.approx(3.0 / c["wall_s"], rel=1e-6)
+
+
+def test_wall_ledger_reattribute_moves_without_double_count():
+    w = WallLedger()
+    w.sync_sim(0)
+    w.chunk_start()
+    with w.span("dispatch"):
+        time.sleep(0.03)
+    w.reattribute("dispatch", "compile", 0.01)
+    assert w.pending_to("compile") == pytest.approx(0.01)
+    # a move larger than the source's balance clamps, never goes negative
+    w.reattribute("dispatch", "snapshot", 10.0)
+    w.chunk_end(1_000_000_000)
+    c = w.chunks[0]
+    assert c["spans"]["compile"] == pytest.approx(0.01, abs=1e-6)
+    assert c["spans"].get("dispatch", 0.0) >= 0.0
+    assert abs(sum(c["spans"].values()) - c["wall_s"]) < 1e-9
+
+
+def test_wall_ledger_sync_sim_resets_rt_baseline():
+    w = WallLedger()
+    w.sync_sim(5_000_000_000)  # restored run: pre-restore horizon
+    w.chunk_start()
+    time.sleep(0.001)
+    rt = w.chunk_end(5_000_000_000 + 1_000_000)
+    # credited only with the post-sync delta, not the 5 s horizon
+    assert rt == pytest.approx(0.001 / w.chunks[0]["wall_s"], rel=1e-6)
+
+
+def test_wall_ledger_bounded_records():
+    w = WallLedger(max_chunks=2)
+    for i in range(5):
+        w.chunk_start()
+        w.chunk_end(i * 1_000_000)
+    assert len(w.chunks) == 2
+    assert w.chunks_total == 5 and w.chunks_dropped == 3
+    s = w.summary()
+    assert s["chunks"] == 5 and s["chunks_recorded"] == 2
+
+
+def test_span_or_null_without_ledger():
+    with span_or_null(None, "dispatch"):
+        pass  # must be a usable nullcontext
+    w = WallLedger()
+    w.chunk_start()
+    with span_or_null(w, "dispatch"):
+        pass
+    w.chunk_end(0)
+    assert w.chunks_total == 1
+
+
+# ---------------------------------------------------------------------------
+# CompileLedger: cold-call recording + cache hits + window filter
+# ---------------------------------------------------------------------------
+
+
+def test_compile_ledger_records_cold_call_then_hits():
+    led = CompileLedger()
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        time.sleep(0.005)
+        return x * 2
+
+    wrapped = led.instrument("chunk", "base", "cold_start", fn)
+    assert wrapped(3) == 6
+    assert wrapped(4) == 8
+    assert wrapped(5) == 10
+    assert calls == [3, 4, 5]  # arguments/results pass through untouched
+    assert len(led.entries) == 1
+    e = led.entries[0]
+    assert (e["kind"], e["label"], e["trigger"]) == (
+        "chunk", "base", "cold_start"
+    )
+    assert e["cold_s"] >= 0.005
+    assert e["hits"] == 2 and led.cache_hits == 2
+    s = led.summary()
+    assert s["programs"] == 1 and s["by_trigger"] == {"cold_start": 1}
+    assert s["cold_wall_s"] > 0
+
+
+def test_compile_ledger_window_filter_and_wall_reattribution():
+    wall = WallLedger()
+    led = CompileLedger(wall=wall)
+    t_before = time.monotonic()
+    wrapped = led.instrument("chunk", "rung", "pressure_regrow",
+                             lambda: time.sleep(0.002))
+    wall.chunk_start()
+    with wall.span("dispatch"):
+        wrapped()
+    wall.chunk_end(1_000_000)
+    e = led.entries[0]
+    # the cold call started inside [t_before, now) and outside a
+    # disjoint window
+    assert led.compiles_in(t_before, time.monotonic()) == pytest.approx(
+        led.pipeline_s(e)
+    )
+    assert led.compiles_in(t_before - 100, t_before - 50) == 0.0
+    ev = led.events()
+    assert len(ev) == 1 and ev[0][0] == "chunk:rung (pressure_regrow)"
+    assert ev[0][2] > 0
+
+
+# ---------------------------------------------------------------------------
+# BridgeTelemetry: lane exactness + syscall-batch histogram
+# ---------------------------------------------------------------------------
+
+
+def test_bridge_telemetry_window_lanes_sum_to_wall():
+    bt = BridgeTelemetry()
+    bt.sync_sim(0)
+    bt.window_start()
+    bt.note("cpu_plane", 0.002)
+    bt.note("device_plane", 0.003)
+    time.sleep(0.01)
+    rt = bt.window_end(2_000_000_000)
+    assert rt is not None and rt > 0
+    w = bt.windows[0]
+    lanes = w["cpu_plane"] + w["device_plane"] + w["bridge"]
+    assert lanes == pytest.approx(w["wall_s"], abs=1e-9)
+    assert w["bridge"] > 0  # the residual landed in the bridge lane
+
+
+def test_bridge_telemetry_batch_histogram_counts_every_batch():
+    bt = BridgeTelemetry()
+    bt.window_start()
+    lat = [5e-5, 2e-4, 2e-3, 0.05, 10.0]  # first + overflow buckets
+    for i, sec in enumerate(lat):
+        bt.note_batch(sec, entries=i + 1)
+    bt.window_end(0)
+    s = bt.summary()
+    b = s["syscall_batches"]
+    assert b["batches"] == len(lat)
+    assert b["entries"] == sum(range(1, len(lat) + 1))
+    assert sum(b["hist_counts"]) == len(lat)
+    assert len(b["hist_counts"]) == len(INJECT_HIST_EDGES_S) + 1
+    assert b["hist_counts"][0] == 1          # 5e-5 <= 1e-4
+    assert b["hist_counts"][-1] == 1         # 10 s -> +inf bucket
+    assert b["wall_s"] == pytest.approx(sum(lat), abs=1e-3)
+    assert set(s["shares"]) == set(BridgeTelemetry.LANES)
+    assert sum(s["shares"].values()) == pytest.approx(1.0, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# report assembly + bench helpers
+# ---------------------------------------------------------------------------
+
+
+def test_assemble_runtime_report_shapes():
+    wall = WallLedger()
+    wall.chunk_start()
+    time.sleep(0.001)
+    wall.chunk_end(1_000_000_000)
+    led = CompileLedger()
+    led.instrument("chunk", "base", "cold_start", lambda: None)()
+    rep = assemble_runtime_report(
+        wall=wall, compiles=led, total_wall_s=wall.chunks[0]["wall_s"]
+    )
+    assert set(rep["spans_s"]) == set(SPAN_NAMES)
+    assert rep["chunks"] == 1
+    assert 0.9 <= rep["attributed_share"] <= 1.01
+    assert rep["realtime_factor"]["series"]
+    assert rep["compiles"]["programs"] == 1
+    # bridge-only assembly (the hybrid driver's shape) still carries a
+    # realtime factor, derived from the windows
+    bt = BridgeTelemetry()
+    bt.window_start()
+    time.sleep(0.001)
+    bt.window_end(500_000_000)
+    rep2 = assemble_runtime_report(bridge=bt)
+    assert rep2["bridge"]["windows"] == 1
+    assert rep2["realtime_factor"]["last"] > 0
+
+
+def test_post_compile_stats_shared_exclusion_rule():
+    from bench import post_compile_stats
+
+    # normal shape: walls[0] carries the compile, its chunk's rounds are
+    # excluded with it
+    wall, rounds = post_compile_stats([5.0, 1.0, 1.0], 300, rpc=64,
+                                      replicas=1)
+    assert wall == pytest.approx(2.0) and rounds == 300 - 64
+    # replicas scale the excluded rounds
+    wall, rounds = post_compile_stats([5.0, 1.0], 300, rpc=32, replicas=4)
+    assert wall == pytest.approx(1.0) and rounds == 300 - 32 * 4
+    # whole run fit inside the compile chunk: that chunk IS the
+    # measurement
+    wall, rounds = post_compile_stats([5.0], 100, rpc=64, replicas=1)
+    assert wall == pytest.approx(5.0) and rounds == 100
+    # rounds-free variant (bench --self measure path)
+    wall, rounds = post_compile_stats([5.0, 2.0])
+    assert wall == pytest.approx(2.0) and rounds is None
+
+
+def test_bench_runtime_block_shape():
+    led = CompileLedger()
+    t0 = time.monotonic()
+    led.instrument("chunk", "base", "cold_start",
+                   lambda: time.sleep(0.002))()
+    t1 = time.monotonic()
+    blk = bench_runtime_block(led, None, sim_adv_s=10.0, wall_s=2.0,
+                              window=(t0, t1))
+    assert blk["realtime_factor"] == pytest.approx(5.0)
+    assert blk["compile_programs"] == 1
+    assert blk["compile_in_window_s"] >= 0
+    # excluding the in-window compile can only raise the factor
+    assert blk["realtime_factor_ex_compile"] >= blk["realtime_factor"]
+
+
+def test_bench_compare_runtime_block():
+    """The runtime{} diff gate (unit-gated like the hbm/network/fluid
+    gates): realtime-factor drop or compile-wall growth beyond
+    tolerance = regression, lost block = coverage warning, sub-second
+    compile-wall noise never regresses."""
+    sys.path.insert(0, _REPO)
+    from tools.bench_compare import _rows, compare
+
+    def row(rt, cw):
+        return {"metric": "m", "value": 10.0, "runtime": {
+            "realtime_factor": rt, "compile_wall_s": cw,
+            "realtime_factor_ex_compile": rt, "compile_programs": 3,
+        }}
+
+    old = _rows([row(4.0, 10.0)])
+    # regression: rt -50%, compile wall +50% (and > 1 s absolute)
+    findings = compare(old, _rows([row(2.0, 15.0)]), 0.10, 0.10)
+    det = " | ".join(f["detail"] for f in findings
+                     if f["kind"] == "runtime")
+    kinds = {(f["kind"], f["severity"]) for f in findings}
+    assert ("runtime", "regression") in kinds
+    assert "realtime factor" in det and "compile wall" in det
+    # improvement is reported, not a regression
+    findings = compare(old, _rows([row(8.0, 10.0)]), 0.10, 0.10)
+    assert any(f["kind"] == "runtime" and f["severity"] == "improvement"
+               for f in findings)
+    assert not any(f["kind"] == "runtime" and f["severity"] == "regression"
+                   for f in findings)
+    # sub-second compile growth never regresses even at a big ratio
+    old_small = _rows([row(4.0, 0.2)])
+    findings = compare(old_small, _rows([row(4.0, 0.9)]), 0.10, 0.10)
+    assert not any(f["kind"] == "runtime" for f in findings)
+    # identical blocks: silent
+    assert not [f for f in compare(old, _rows([row(4.0, 10.0)]),
+                                   0.1, 0.1) if f["kind"] == "runtime"]
+    # losing the block entirely is a coverage warning
+    findings = compare(old, _rows([{"metric": "m", "value": 10.0}]),
+                       0.1, 0.1)
+    assert any(f["kind"] == "runtime" and f["severity"] == "warning"
+               for f in findings)
+    # sim-stats-shaped realtime_factor dicts compare through `overall`
+    dict_rt = {"metric": "m", "value": 10.0, "runtime": {
+        "realtime_factor": {"overall": 2.0, "p50": 2.1},
+        "compile_wall_s": 10.0,
+    }}
+    findings = compare(old, _rows([dict_rt]), 0.10, 0.10)
+    assert any(f["kind"] == "runtime" and f["severity"] == "regression"
+               and "realtime factor" in f["detail"] for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat rt= strict round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_rt_strict_roundtrip(tmp_path):
+    from shadow_tpu.sim import heartbeat_line
+    from tools.parse_shadow import parse_heartbeats
+
+    lines = [
+        heartbeat_line(2_000_000_000, 3.0, 99, 80, 40, 4096, 7, rt=4.42),
+        heartbeat_line(2_000_000_000, 3.0, 99, 80, 40, 4096, 7,
+                       gear=4, cap=32, hbm=12345, iv=(0, 0), rt=0.07),
+        heartbeat_line(2_000_000_000, 3.0, 99, 80, 40, 4096, 7),
+    ]
+    p = tmp_path / "hb.log"
+    p.write_text("\n".join(lines) + "\n")
+    parsed = parse_heartbeats(str(p), strict=True)
+    assert parsed[0]["rt"] == pytest.approx(4.42)
+    assert parsed[1]["rt"] == pytest.approx(0.07)
+    assert parsed[1]["cap"] == 32 and parsed[1]["hbm"] == 12345
+    assert "rt" not in parsed[2]
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def test_observability_runtime_knob_parses():
+    from shadow_tpu.config.options import ObservabilityOptions
+
+    assert not ObservabilityOptions.from_dict({}).runtime  # default off
+    assert ObservabilityOptions.from_dict({"runtime": True}).runtime
+
+
+def test_example_runtime_yaml_parses():
+    from shadow_tpu.config.options import load_config
+
+    cfg = load_config(os.path.join(_REPO, "examples", "runtime.yaml"))
+    assert cfg.observability.runtime
+    assert cfg.observability.trace
+    assert cfg.pressure.active and cfg.pressure.policy == "escalate"
+
+
+# ---------------------------------------------------------------------------
+# observer exactness matrix (engine harness, world=1)
+# ---------------------------------------------------------------------------
+
+RING = 64
+
+_CASES = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 5)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(5, {"flow_segs": 8, "flows": 2, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             2_000_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+
+def _run(model, hosts, stop, *, k=1, qb=0, ledger=None, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, queue_block=qb, microstep_events=k,
+        **kw
+    )
+    eng = Engine(cfg, m, None)
+    if ledger is not None:
+        eng.attach_compile_ledger(ledger)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return state, chunks
+
+
+def _matrix_params():
+    """The world-1 acceptance matrix (netobs posture): the mixed-axis
+    combos — (flat, k4) and (bucketed, k1), which add no code path the
+    aligned pairs miss for a purely host-side wrapper — carry the `slow`
+    mark so the FULL cross product runs under `pytest -m ''` while
+    tier-1 runs the aligned half plus the world-8 leg."""
+    out = []
+    for case in sorted(_CASES):
+        for k in (1, 4):
+            for qb in (0, 8):
+                aligned = (k == 1) == (qb == 0)
+                marks = () if aligned else (pytest.mark.slow,)
+                out.append(pytest.param(
+                    case, k, qb,
+                    id=f"{case}-{'flat' if qb == 0 else 'bucketed'}-k{k}",
+                    marks=marks,
+                ))
+    return out
+
+
+@pytest.mark.parametrize("case,k,qb", _matrix_params())
+def test_runtime_observer_is_bit_identical(case, k, qb):
+    """The ISSUE acceptance gate, world=1: the compile ledger attached
+    vs not across the model x layout x K matrix — digests, event counts,
+    and drop counters bit-identical (the observatory wraps jitted
+    callables host-side; the traced program cannot change), and the
+    ledger records exactly the one base program with every later chunk
+    a cache hit."""
+    model, hosts, stop, kw = _CASES[case]
+    s_off, _ = _run(model, hosts, stop, k=k, qb=qb, **kw)
+    led = CompileLedger()
+    s_on, chunks = _run(model, hosts, stop, k=k, qb=qb, ledger=led, **kw)
+    off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+
+    np.testing.assert_array_equal(np.asarray(off.digest),
+                                  np.asarray(on.digest))
+    np.testing.assert_array_equal(np.asarray(off.events),
+                                  np.asarray(on.events))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(s_off.queue.dropped)),
+        np.asarray(jax.device_get(s_on.queue.dropped)),
+    )
+    assert len(led.entries) == 1  # one jitted base program
+    e = led.entries[0]
+    assert e["trigger"] == "cold_start"
+    assert e["hits"] == chunks - 1  # every later chunk hit the cache
+    assert e["cold_s"] > 0
+
+
+def test_compile_ledger_gear_variant_is_one_entry():
+    """A gear-shifted chunk compiles once per gear width: exactly one
+    gear_shift entry on first use, cache hits after."""
+    model, hosts, stop, kw = _CASES["phold"]
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=1, **kw)
+    eng = Engine(cfg, m, None)
+    led = CompileLedger()
+    eng.attach_compile_ledger(led)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    state = eng.run_chunk(state, params)
+    gear = max(1, cfg.sends_per_host_round // 2)
+    state = eng.run_chunk_gear(state, params, gear)
+    state = eng.run_chunk_gear(state, params, gear)
+    trig = {(e["trigger"], e["label"]): e["hits"] for e in led.entries}
+    assert trig[("cold_start", "base")] == 0
+    assert trig[("gear_shift", f"gear={gear}")] == 1
+    assert len(led.entries) == 2
+
+
+# world=8 leg (subprocess-isolated: compiled multi-device runs are where
+# this box's documented corruption bites — tests/subproc.py)
+_W8_SCRIPT = """
+import json
+import numpy as np
+import jax
+from shadow_tpu.core import Engine
+from shadow_tpu.obs.runtime import CompileLedger
+from tests.engine_harness import build_sim, mk_hosts
+
+hosts = mk_hosts(8, {"mean_delay": "20 ms", "population": 3})
+
+def run(ledger):
+    cfg, m, params, mstate, events = build_sim(
+        "phold", hosts, 300_000_000, world=8, loss=0.1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    eng = Engine(cfg, m, mesh)
+    if ledger is not None:
+        eng.attach_compile_ledger(ledger)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    chunks = 0
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+        chunks += 1
+        assert chunks < 500
+    return state, chunks
+
+s_off, _ = run(None)
+led = CompileLedger()
+s_on, chunks = run(led)
+off, on = jax.device_get(s_off.stats), jax.device_get(s_on.stats)
+print(json.dumps({
+    "digest_equal": bool(
+        (np.asarray(off.digest) == np.asarray(on.digest)).all()),
+    "events_equal": bool(
+        (np.asarray(off.events) == np.asarray(on.events)).all()),
+    "dropped_equal": bool((
+        np.asarray(jax.device_get(s_off.queue.dropped))
+        == np.asarray(jax.device_get(s_on.queue.dropped))).all()),
+    "programs": len(led.entries),
+    "hits": led.entries[0]["hits"],
+    "chunks": chunks,
+}))
+"""
+
+
+def test_runtime_observer_world8_subprocess():
+    from tests.subproc import run_isolated_json
+
+    out = run_isolated_json(_W8_SCRIPT, timeout=600)
+    assert out["digest_equal"] and out["events_equal"]
+    assert out["dropped_equal"]
+    assert out["programs"] == 1
+    assert out["hits"] == out["chunks"] - 1
+
+
+# ---------------------------------------------------------------------------
+# Simulation leg: forced pressure regrow — new rung == one recorded
+# compile — plus the runtime{} block, rt= heartbeat, and compile track
+# ---------------------------------------------------------------------------
+
+_SIM_WORKER = '''
+import io, json, os, sys
+import numpy as np
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.sim import Simulation
+
+rt_on = sys.argv[1] == "on"
+tmp = sys.argv[2]
+cfg = ConfigOptions.from_dict({
+    "general": {"stop_time": "3 s", "seed": 1,
+                "heartbeat_interval": "1 s",
+                "data_directory": tmp},
+    "network": {"graph": {"type": "1_gbit_switch"}},
+    "experimental": {"event_queue_capacity": 8,
+                     "rounds_per_chunk": 8},
+    "observability": {"trace": True, "runtime": rt_on},
+    "pressure": {"policy": "escalate", "max_capacity": 64},
+    "hosts": {"n": {"count": 16, "network_node_id": 0,
+              "processes": [{"model": "phold",
+                             "model_args": {"population": 6,
+                                            "mean_delay": "100 ms"}}]}},
+})
+log = io.StringIO()
+sim = Simulation(cfg, world=1)
+r = sim.run(progress=False, log=log)
+sim.write_outputs(report=r)
+hb = [l for l in log.getvalue().splitlines() if "[heartbeat]" in l]
+out = {
+    "digest": r["determinism_digest"],
+    "events": r["events_processed"],
+    "drops": [r["queue_overflow_dropped"],
+              r["packets_budget_dropped"], r["packets_lost"]],
+    "regrows": r.get("pressure_regrows", 0),
+    "heartbeat": hb[0] if hb else "",
+    "has_runtime": "runtime" in r,
+    "resized_cached": len(sim.engine._resized_chunks),
+    "gear_cached": len(sim.engine._gear_chunks),
+}
+if rt_on:
+    rt = r["runtime"]
+    out["rt_block"] = {
+        "chunks": rt.get("chunks"),
+        "attributed_share": rt.get("attributed_share"),
+        "series_len": len((rt.get("realtime_factor") or {})
+                          .get("series") or []),
+        "spans": sorted((rt.get("spans_s") or {}).keys()),
+    }
+    out["compiles"] = rt["compiles"]
+    trace = json.load(open(os.path.join(tmp, "trace.json")))
+    out["compile_track"] = len([e for e in trace["traceEvents"]
+                                if e.get("cat") == "compile"])
+print(json.dumps(out))
+'''
+
+
+def test_simulation_runtime_on_off_and_pressure_regrow_ledger(tmp_path):
+    """Full-driver leg: observability.runtime on vs off on a scenario
+    whose undersized queue forces REAL pressure regrows — digests/
+    events/drops bit-identical, and the compile ledger records exactly
+    the programs the (gear, capacity, budget) cache compiled: one
+    cold_start plus one pressure_regrow entry per cached rung."""
+    from tests.subproc import run_isolated_json
+
+    on = run_isolated_json(
+        _SIM_WORKER, "on", str(tmp_path / "rt_on"), timeout=600
+    )
+    off = run_isolated_json(
+        _SIM_WORKER, "off", str(tmp_path / "rt_off"), timeout=600
+    )
+    assert on["digest"] == off["digest"]
+    assert on["events"] == off["events"]
+    assert on["drops"] == off["drops"]
+    assert not off["has_runtime"]
+
+    # the scenario really regrew (otherwise the ledger gate is vacuous)
+    assert on["regrows"] > 0 and on["resized_cached"] > 0
+
+    comp = on["compiles"]
+    expect = 1 + on["gear_cached"] + on["resized_cached"]
+    assert comp["programs"] == expect
+    assert comp["by_trigger"]["cold_start"] == 1
+    # each new rung = exactly one recorded compile
+    assert comp["by_trigger"]["pressure_regrow"] == on["resized_cached"]
+    assert comp["compile_wall_s"] > 0
+    assert comp["cache_hits"] > 0
+
+    # attribution plane: block present, spans cover the wall
+    blk = on["rt_block"]
+    assert blk["chunks"] > 0 and blk["series_len"] > 0
+    assert blk["attributed_share"] is not None
+    assert 0.85 <= blk["attributed_share"] <= 1.01
+    assert blk["spans"] == sorted(SPAN_NAMES)
+
+    # Chrome-trace compile track: one X event per recorded program
+    assert on["compile_track"] == comp["programs"]
+
+    # live rt= heartbeat, strict-parsed through the format gate
+    from tools.parse_shadow import HEARTBEAT_RE
+
+    assert "rt=" in on["heartbeat"]
+    assert "rt=" not in off["heartbeat"]
+    m = HEARTBEAT_RE.search(on["heartbeat"])
+    assert m and float(m.group("rt")) >= 0
+
+    # rt_report CLI smoke on the run's real artifacts (report mode
+    # imports no JAX — safe in a plain subprocess)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "rt_report.py"),
+         str(tmp_path / "rt_on")],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "runtime observatory report" in proc.stdout
+    assert "compile ledger" in proc.stdout
+    assert "verdict" in proc.stdout
+    proc_j = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "rt_report.py"),
+         str(tmp_path / "rt_on"), "--json"],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+    )
+    assert proc_j.returncode == 0, proc_j.stderr
+    blob = json.loads(proc_j.stdout)
+    assert blob["compiles"]["programs"] == comp["programs"]
+
+
+# ---------------------------------------------------------------------------
+# hybrid leg: bridge split + rt= in the windows-form heartbeat
+# ---------------------------------------------------------------------------
+
+_HYBRID_WORKER = '''
+import io, json, sys
+from shadow_tpu.config.options import ConfigOptions
+from shadow_tpu.cosim import HybridSimulation
+
+rt_on = sys.argv[1] == "on"
+cfg = ConfigOptions.from_dict({
+    "general": {"stop_time": "2 s", "seed": 7,
+                "heartbeat_interval": "500 ms"},
+    "network": {"graph": {"type": "1_gbit_switch"}},
+    "observability": {"runtime": rt_on},
+    "hosts": {
+        "server": {"network_node_id": 0,
+                   "processes": [{"path": "udp_echo_server",
+                                  "args": ["port=9000"]}]},
+        "client": {"network_node_id": 0,
+                   "processes": [{"path": "udp_ping",
+                                  "args": ["server=server", "port=9000",
+                                           "count=3"],
+                                  "expected_final_state": {"exited": 0}}]},
+    },
+})
+log = io.StringIO()
+sim = HybridSimulation(cfg)
+r = sim.run(log=log)
+hb = [l for l in log.getvalue().splitlines() if "[heartbeat]" in l]
+print(json.dumps({
+    "digest": r["determinism_digest"],
+    "delivered": r["packets_delivered"],
+    "failures": r["process_failures"],
+    "heartbeat": hb[0] if hb else "",
+    "runtime": r.get("runtime"),
+}))
+'''
+
+
+def test_hybrid_bridge_split_on_off():
+    """The cosim driver's observatory leg: per-window bridge-stall split
+    present and internally consistent with the observatory on, digest
+    identical to the off run."""
+    from tests.subproc import run_isolated_json
+
+    on = run_isolated_json(_HYBRID_WORKER, "on", timeout=420)
+    off = run_isolated_json(_HYBRID_WORKER, "off", timeout=420)
+    assert on["failures"] == 0 and off["failures"] == 0
+    assert on["digest"] == off["digest"]
+    assert on["delivered"] == off["delivered"]
+    rt = on["runtime"]
+    assert rt is not None and off["runtime"] is None
+    br = rt["bridge"]
+    assert br["windows"] > 0
+    assert set(br["spans_s"]) == {"cpu_plane", "device_plane", "bridge"}
+    b = br["syscall_batches"]
+    assert b["batches"] > 0
+    assert sum(b["hist_counts"]) == b["batches"]
+    # shares sum to ~1 and the compile ledger saw the bridge's programs
+    assert sum(br["shares"].values()) == pytest.approx(1.0, abs=1e-2)
+    assert rt["compiles"]["programs"] >= 2  # prepare + guarded
+    assert rt["realtime_factor"]["last"] > 0
+    if on["heartbeat"]:  # windows-form heartbeat carries rt=
+        assert "rt=" in on["heartbeat"]
+        from tools.parse_shadow import HEARTBEAT_RE
+
+        assert HEARTBEAT_RE.search(on["heartbeat"])
+        assert "rt=" not in off["heartbeat"]

@@ -28,6 +28,11 @@ Verdicts (rc 1 if any REGRESSION, else 0):
   - fluid (PR 13 background plane): foreground-FCT drift with fluid on
     regresses through the network gates above; losing the fluid block
     or the background byte volume collapsing is a coverage warning
+  - runtime (PR 14 observatory block): the realtime factor dropping
+    more than --threshold, or the compile wall growing more than
+    --threshold AND more than 1 s absolute (compiles are noisy at the
+    sub-second scale), is a regression; OLD carrying a runtime block
+    NEW lost is a coverage warning
   - a metric present in OLD but missing from NEW is a regression
     (silently dropping a tracked workload is how coverage rots)
 """
@@ -53,6 +58,8 @@ def _rows(blob) -> dict[str, dict]:
                        if "network" in item else {}),
                     **({"fluid": item["fluid"]}
                        if "fluid" in item else {}),
+                    **({"runtime": item["runtime"]}
+                       if "runtime" in item else {}),
                     **({"integrity": item["integrity"]}
                        if "integrity" in item else {}),
                     **({"integrity_aborted": True}
@@ -178,6 +185,67 @@ def _compare_fluid(add, name: str, o_fl: dict | None, n_fl: dict | None,
             f"changed)")
 
 
+# compile-wall growth below this many absolute seconds never regresses:
+# sub-second compile walls are dominated by run-to-run XLA noise
+COMPILE_WALL_FLOOR_S = 1.0
+
+
+def _rt_scalar(v):
+    """A comparable realtime-factor number from either shape: the bench
+    runtime{} block carries a scalar, sim-stats a {overall, p50, ...}
+    dict."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v
+    if isinstance(v, dict):
+        return v.get("overall")
+    return None
+
+
+def _compare_runtime(add, name: str, o_rt, n_rt, threshold: float):
+    """Diff one metric's `runtime{}` blocks (obs/runtime.py
+    bench_runtime_block shape): a realtime-factor drop or compile-wall
+    growth beyond tolerance is a regression, a lost block a coverage
+    warning."""
+    if isinstance(o_rt, dict) and n_rt is None:
+        add("runtime", name, "warning",
+            "OLD carried a runtime block, NEW has none "
+            "(wall-attribution coverage lost)")
+        return
+    if not isinstance(n_rt, dict):
+        return
+    o_rt = o_rt if isinstance(o_rt, dict) else {}
+    # prefer the compile-excluded factor when BOTH rows carry it — the
+    # gated number must not move with cold-compile noise (the whole
+    # point of the block); compile-wall growth has its own gate below
+    oex = _rt_scalar(o_rt.get("realtime_factor_ex_compile"))
+    nex = _rt_scalar(n_rt.get("realtime_factor_ex_compile"))
+    if isinstance(oex, (int, float)) and isinstance(nex, (int, float)):
+        ov, nv = oex, nex
+    else:
+        ov = _rt_scalar(o_rt.get("realtime_factor"))
+        nv = _rt_scalar(n_rt.get("realtime_factor"))
+    if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+            and ov > 0:
+        rel = (nv - ov) / ov
+        if rel < -threshold:
+            add("runtime", name, "regression",
+                f"realtime factor {ov} -> {nv} ({rel * 100:+.1f}%, "
+                f"threshold -{threshold * 100:.0f}%)")
+        elif rel > threshold:
+            add("runtime", name, "improvement",
+                f"realtime factor {ov} -> {nv} ({rel * 100:+.1f}%)")
+    ow, nw = o_rt.get("compile_wall_s"), n_rt.get("compile_wall_s")
+    if isinstance(ow, (int, float)) and isinstance(nw, (int, float)) \
+            and ow > 0:
+        rel = (nw - ow) / ow
+        if rel > threshold and (nw - ow) > COMPILE_WALL_FLOOR_S:
+            add("runtime", name, "regression",
+                f"compile wall {ow} -> {nw} s ({rel * 100:+.1f}%, "
+                f"threshold +{threshold * 100:.0f}% and "
+                f">{COMPILE_WALL_FLOOR_S} s absolute) — ROADMAP item "
+                f"6's compile-cache budget grew")
+
+
 def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
     findings: list[dict] = []
 
@@ -239,6 +307,10 @@ def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
         # this guards background coverage (bytes/drops)
         _compare_fluid(add, name, o.get("fluid"), n.get("fluid"),
                        hbm_threshold)
+        # runtime-observatory block (PR 14): realtime-factor drop or
+        # compile-wall growth beyond tolerance regresses
+        _compare_runtime(add, name, o.get("runtime"), n.get("runtime"),
+                         threshold)
         # integrity-sentinel block (PR 11, bench config 10): a
         # DETERMINISTIC violation appearing is always a regression — the
         # engine reproducibly broke its own invariant; transient-SDC

@@ -37,6 +37,14 @@
 # round's invariants held", not just "the final digest matched").
 # Same corruption-signature SKIP posture as the soak stage.
 #
+# Optional stage: TIER1_RT=1 runs the runtime-observatory
+# reconciliation check (tools/rt_report.py --check: digests identical
+# with the observatory on/off, the WallLedger's attributed wall
+# matching the driver's total within tolerance, the compile ledger
+# recording exactly the programs the (gear, capacity, budget) cache
+# compiled, and the cosim bridge split present). Subprocess-isolated
+# with the same corruption-signature SKIP posture as the hbm stage.
+#
 # Optional third stage: TIER1_CAMPAIGN=1 runs the ensemble-plane smoke
 # (tools/campaign.py --smoke: an A/A control campaign that must hold +
 # a forced-divergence A/B campaign whose bisection must agree with the
@@ -98,6 +106,14 @@ if [ -n "${TIER1_NET:-}" ]; then
   net_rc=$?
   echo "NET_RC=$net_rc"
   [ "$rc" -eq 0 ] && rc=$net_rc
+fi
+if [ -n "${TIER1_RT:-}" ]; then
+  echo "== runtime-observatory reconciliation check (TIER1_RT) =="
+  timeout -k 10 "${TIER1_RT_TIMEOUT:-630}" \
+    env JAX_PLATFORMS=cpu python tools/rt_report.py --check
+  rt_rc=$?
+  echo "RT_RC=$rt_rc"
+  [ "$rc" -eq 0 ] && rc=$rt_rc
 fi
 if [ -n "${TIER1_INTEGRITY:-}" ]; then
   echo "== integrity-sentinel soak (TIER1_INTEGRITY) =="

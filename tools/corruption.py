@@ -93,3 +93,59 @@ def counters_scribbled(values, lo, hi) -> bool:
     not simulation output (tools/net_report.py's scribble gate and
     bench.py's solo-leg poison gate both judge this way)."""
     return any(v < lo or v > hi for v in values)
+
+
+def run_check_isolated(
+    cmd,
+    *,
+    skip_what: str,
+    cwd=None,
+    attempts: int = 3,
+    timeout: int = 600,
+    retry_rcs: dict | None = None,
+) -> int:
+    """The `--check` subprocess scaffold every observatory analyzer
+    shares (hbm_report / net_report / rt_report): run the worker `cmd`
+    up to `attempts` times with JAX pinned to CPU, stream its output
+    through, and apply the classify-then-retry posture — a known
+    corruption signature WITHOUT a verdict in the output retries, and
+    when every attempt dies of it the check SKIPs rc 0 (environment,
+    never a false FAIL). `retry_rcs` maps extra worker return codes to
+    retry reasons (net_report's poisoned-device self-classification).
+    `skip_what` names the verdict the SKIP line disclaims. Any attempt
+    that produces a real result returns its rc verbatim."""
+    import os
+    import subprocess
+    import sys
+
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=cwd,
+            )
+        except subprocess.TimeoutExpired:
+            # the hang flavor of the documented corruption: same
+            # retry/SKIP posture as an aborting worker
+            print(f"attempt {attempt + 1}: check worker timed out "
+                  f"({timeout}s); retrying", file=sys.stderr)
+            continue
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        if retry_rcs and proc.returncode in retry_rcs:
+            print(f"attempt {attempt + 1}: "
+                  f"{retry_rcs[proc.returncode]}; retrying",
+                  file=sys.stderr)
+            continue
+        flavor = classify(proc.returncode)
+        if flavor is not None and (
+            "ok" not in proc.stdout and "FAILED" not in proc.stderr
+        ):
+            print(f"attempt {attempt + 1}: known corruption signature "
+                  f"({flavor}, rc={proc.returncode}); retrying",
+                  file=sys.stderr)
+            continue
+        return proc.returncode
+    print(f"SKIP: every attempt died of the known jaxlib corruption "
+          f"signature (environment, not {skip_what})")
+    return 0

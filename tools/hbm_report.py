@@ -46,16 +46,15 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# this box's documented jaxlib-0.4.37 corruption signatures (CHANGES.md
-# env notes): ONE taxonomy + classify() in tools/corruption.py —
-# stdlib-only, so a plain report run still imports no test infra or JAX
-from tools.corruption import classify as classify_corruption  # noqa: E402
+# this box's documented jaxlib-0.4.37 corruption signatures live in ONE
+# place (tools/corruption.py: taxonomy + the shared --check subprocess
+# scaffold), imported lazily in the --check branch so a plain report
+# run stays stdlib-only
 
 DEFAULT_HBM_GIB = 15.75  # one v5e chip
 
@@ -298,39 +297,20 @@ def main(argv=None) -> int:
         return run_check(cfg_dict, args.tol)
 
     if args.check:
-        # soak.py posture: the compiled leg runs in a fresh subprocess;
-        # the documented corruption signature (with no verdict printed)
-        # classifies as SKIP rc 0 instead of a false FAIL
+        # soak.py posture via the ONE shared scaffold
+        # (tools/corruption.run_check_isolated): the compiled leg runs
+        # in a fresh subprocess; the documented corruption signature
+        # (with no verdict printed) classifies as SKIP rc 0 instead of
+        # a false FAIL
+        from tools.corruption import run_check_isolated
+
         cmd = [sys.executable, os.path.abspath(__file__), "--check-worker",
                "--tol", str(args.tol)]
         if args.config:
             cmd.append(args.config)
-        for attempt in range(3):
-            try:
-                proc = subprocess.run(
-                    cmd, capture_output=True, text=True, timeout=600,
-                    env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO,
-                )
-            except subprocess.TimeoutExpired:
-                # the hang flavor of the documented corruption: same
-                # retry/SKIP posture as an aborting worker
-                print(f"attempt {attempt + 1}: check worker timed out "
-                      f"(600s); retrying", file=sys.stderr)
-                continue
-            sys.stdout.write(proc.stdout)
-            sys.stderr.write(proc.stderr)
-            flavor = classify_corruption(proc.returncode)
-            if flavor is not None and (
-                "ok" not in proc.stdout and "FAILED" not in proc.stderr
-            ):
-                print(f"attempt {attempt + 1}: known corruption signature "
-                      f"({flavor}, rc={proc.returncode}); retrying",
-                      file=sys.stderr)
-                continue
-            return proc.returncode
-        print("SKIP: every attempt died of the known jaxlib corruption "
-              "signature (environment, not a memory-model verdict)")
-        return 0
+        return run_check_isolated(
+            cmd, skip_what="a memory-model verdict", cwd=_REPO,
+        )
 
     report = analyze(
         cfg_dict, replicas=args.replicas, ledger=not args.no_ledger

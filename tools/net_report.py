@@ -29,16 +29,15 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# this box's documented jaxlib-0.4.37 corruption signatures (CHANGES.md
-# env notes): ONE taxonomy + classify() in tools/corruption.py —
-# stdlib-only, so a plain report run still imports no test infra or JAX
-from tools.corruption import classify as classify_corruption  # noqa: E402
+# this box's documented jaxlib-0.4.37 corruption signatures live in ONE
+# place (tools/corruption.py: taxonomy + the shared --check subprocess
+# scaffold), imported lazily in the --check branch so a plain report
+# run stays stdlib-only
 
 
 def load_network_block(path: str) -> tuple[dict, dict]:
@@ -347,41 +346,20 @@ def main(argv=None) -> int:
             return run_check(tmp)
 
     if args.check:
-        # hbm_report posture: the compiled leg runs in a fresh
-        # subprocess; the documented corruption signature (no verdict
-        # printed) classifies as SKIP rc 0 instead of a false FAIL
-        cmd = [sys.executable, os.path.abspath(__file__), "--check-worker"]
-        for attempt in range(3):
-            try:
-                proc = subprocess.run(
-                    cmd, capture_output=True, text=True, timeout=600,
-                    env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO,
-                )
-            except subprocess.TimeoutExpired:
-                print(f"attempt {attempt + 1}: check worker timed out "
-                      f"(600s); retrying", file=sys.stderr)
-                continue
-            sys.stdout.write(proc.stdout)
-            sys.stderr.write(proc.stderr)
-            if proc.returncode == 3:
-                # the worker's scribble gate classified its own device
-                # state as poisoned (silent-corruption flavor): retry
-                # like an aborting worker, never report it as a verdict
-                print(f"attempt {attempt + 1}: worker self-classified "
-                      f"poisoned device state; retrying", file=sys.stderr)
-                continue
-            flavor = classify_corruption(proc.returncode)
-            if flavor is not None and (
-                "ok" not in proc.stdout and "FAILED" not in proc.stderr
-            ):
-                print(f"attempt {attempt + 1}: known corruption signature "
-                      f"({flavor}, rc={proc.returncode}); retrying",
-                      file=sys.stderr)
-                continue
-            return proc.returncode
-        print("SKIP: every attempt died of the known jaxlib corruption "
-              "signature (environment, not an observatory verdict)")
-        return 0
+        # hbm_report posture via the ONE shared scaffold
+        # (tools/corruption.run_check_isolated): the compiled leg runs
+        # in a fresh subprocess; the documented corruption signature
+        # (no verdict printed) classifies as SKIP rc 0 instead of a
+        # false FAIL. rc 3 = the worker's scribble gate classified its
+        # own device state as poisoned (silent-corruption flavor):
+        # retried like an aborting worker, never reported as a verdict.
+        from tools.corruption import run_check_isolated
+
+        return run_check_isolated(
+            [sys.executable, os.path.abspath(__file__), "--check-worker"],
+            skip_what="an observatory verdict", cwd=_REPO,
+            retry_rcs={3: "worker self-classified poisoned device state"},
+        )
 
     if not args.path:
         p.error("a data dir / sim-stats.json path is required "

@@ -49,6 +49,11 @@ HEARTBEAT_RE = re.compile(
     # block is enabled): iv=<transient SDC survived>/<sentinel replays>,
     # cumulative
     r"(?:iv=(?P<iv_transient>\d+)/(?P<iv_replays>\d+) )?"
+    # PR 14 runtime-observatory field (only emitted when
+    # observability.runtime is on): rt=<realtime factor> — the LAST
+    # chunk's (or cosim window's) sim-s per wall-s, fresh per-chunk
+    # rather than the run-cumulative ratio= at the line's end
+    r"(?:rt=(?P<rt>[\d.]+) )?"
     # PR 6 ensemble-campaign field (only emitted by tools/campaign.py):
     # rep=<replicas done>/<total replicas>
     r"(?:rep=(?P<rep_done>\d+)/(?P<rep_total>\d+) )?"

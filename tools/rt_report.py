@@ -1,0 +1,384 @@
+#!/usr/bin/env python3
+"""Runtime-observatory analyzer: wall-clock attribution verdict, compile
+ledger, bridge-stall split, and realtime-factor trend from a run's
+exported `runtime{}` block (shadow_tpu/obs/runtime.py).
+
+Answers the questions the next perf PRs are judged against, as sentences
+with numbers: *where does the wall clock go* (compile / dispatch /
+host-python / snapshot / replay / export shares), *what would a
+persistent or async compile cache save* (ROADMAP item 6 — the compile
+ledger's total, split by trigger), *is the bridge the bottleneck*
+(ROADMAP item 4 — the cosim per-window bridge share), and *is the
+realtime factor trending up or down* (Rain's serving-level metric).
+Reads the artifact, not the simulation, so report mode runs anywhere.
+
+Usage:
+  python tools/rt_report.py DATA_DIR_OR_SIM_STATS [--json]
+  python tools/rt_report.py --check            # reconciliation gate (CI)
+
+--check runs small sims in a worker subprocess and asserts the full
+observer contract:
+  - digests/events bit-identical with `observability.runtime` on vs off
+    (modeled pressure-escalate run AND a hybrid cosim window run);
+  - attribution reconciles: the WallLedger's attributed wall matches the
+    driver's total wall within tolerance;
+  - the compile ledger records exactly the programs the engine's
+    (gear, capacity, budget) cache compiled, with pressure regrows
+    carrying the pressure_regrow trigger;
+  - the cosim run carries the bridge split (windows > 0, lanes sum to
+    the window wall) and a populated syscall-batch histogram;
+  - the live `rt=` heartbeat strict-parses through parse_shadow.
+Exit codes: 0 ok (or environment-classified SKIP on this box's
+documented jaxlib corruption signature — hbm_report/net_report posture),
+2 violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# this box's documented jaxlib-0.4.37 corruption signatures (CHANGES.md
+# env notes): ONE taxonomy + the shared --check subprocess scaffold in
+# tools/corruption.py — stdlib-only, so a plain report run still
+# imports no test infra or JAX
+from tools.corruption import run_check_isolated  # noqa: E402
+
+# rt-trend classification band: first-half vs second-half mean within
+# +-10% reads as flat
+TREND_BAND = 0.10
+
+
+def load_runtime_block(path: str) -> tuple[dict, dict]:
+    """(sim_stats, runtime block) from a data dir or sim-stats.json."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "sim-stats.json")
+    with open(path) as f:
+        stats = json.load(f)
+    rt = stats.get("runtime")
+    if rt is None:
+        raise SystemExit(
+            f"rt_report: {path} carries no runtime{{}} block — run with "
+            f"`observability.runtime: true`"
+        )
+    return stats, rt
+
+
+def rt_trend(series: list[float]) -> tuple[str, float | None]:
+    """('improving'|'degrading'|'flat'|'n/a', second/first ratio) over
+    the chunk realtime-factor series."""
+    if len(series) < 4:
+        return "n/a", None
+    half = len(series) // 2
+    first = sum(series[:half]) / half
+    second = sum(series[half:]) / (len(series) - half)
+    if first <= 0:
+        return "n/a", None
+    ratio = second / first
+    if ratio > 1 + TREND_BAND:
+        return "improving", ratio
+    if ratio < 1 - TREND_BAND:
+        return "degrading", ratio
+    return "flat", ratio
+
+
+def print_report(stats: dict, rt: dict, file=sys.stdout):
+    print("# runtime observatory report", file=file)
+    spans = rt.get("spans_s") or {}
+    shares = rt.get("shares") or {}
+    if spans:
+        print(f"\n## wall attribution ({rt.get('chunks', 0)} chunks, "
+              f"{rt.get('attributed_wall_s', 0)} s attributed"
+              + (f" of {rt['total_wall_s']} s total"
+                 if rt.get("total_wall_s") else "") + ")",
+              file=file)
+        for name, sec in sorted(spans.items(), key=lambda kv: -kv[1]):
+            share = shares.get(name, 0.0)
+            print(f"  {name:<12} {sec:>10.3f} s  ({share * 100:5.1f}%)",
+                  file=file)
+        top = max(shares.items(), key=lambda kv: kv[1]) if shares else None
+        if top:
+            # the attribution verdict, stated as a sentence with a
+            # number — the BASELINE-r6-style decomposition, mechanical
+            print(f"  verdict: {top[0]} dominates the attributed wall "
+                  f"({top[1] * 100:.1f}%)", file=file)
+    comp = rt.get("compiles")
+    if comp:
+        print(
+            f"\n## compile ledger ({comp.get('programs', 0)} programs, "
+            f"{comp.get('cache_hits', 0)} cache hits)\n"
+            f"  compile wall  {comp.get('compile_wall_s', 0)} s "
+            f"(backend {comp.get('backend_compile_s', 0)} s, "
+            f"lower {comp.get('lower_s', 0)} s)\n"
+            f"  by trigger    {comp.get('by_trigger', {})}",
+            file=file,
+        )
+        total = rt.get("total_wall_s") or rt.get("attributed_wall_s")
+        cw = comp.get("compile_wall_s", 0)
+        if total:
+            share = cw / max(total, 1e-9)
+            verdict = (
+                "a persistent/async compile cache is the next lever "
+                "(ROADMAP item 6)"
+                if share > 0.25 else
+                "compiles are not the bottleneck at this shape"
+            )
+            print(f"  compile share of total wall: {share * 100:.1f}% — "
+                  f"{verdict}", file=file)
+        for e in sorted(comp.get("entries", []),
+                        key=lambda e: -(e.get("compile_s", 0)))[:5]:
+            print(f"    {e['kind']}:{e['label']:<24} "
+                  f"[{e['trigger']}] compile={e['compile_s']} s "
+                  f"hits={e['hits']}", file=file)
+    br = rt.get("bridge")
+    if br:
+        sh = br.get("shares") or {}
+        bshare = br.get("bridge_share", 0.0)
+        verdict = (
+            "bridge-bound — the COREC lock-free ring rebuild "
+            "(ROADMAP item 4) has its target"
+            if bshare >= max(sh.get("cpu_plane", 0),
+                             sh.get("device_plane", 0)) else
+            "not bridge-bound at this shape"
+        )
+        batches = br.get("syscall_batches", {})
+        print(
+            f"\n## bridge split ({br.get('windows', 0)} windows)\n"
+            f"  cpu_plane     {sh.get('cpu_plane', 0) * 100:5.1f}%\n"
+            f"  device_plane  {sh.get('device_plane', 0) * 100:5.1f}%\n"
+            f"  bridge        {bshare * 100:5.1f}%  — {verdict}\n"
+            f"  syscall batches: {batches.get('batches', 0)} "
+            f"({batches.get('entries', 0)} staged sends, "
+            f"{batches.get('wall_s', 0)} s)",
+            file=file,
+        )
+        edges = batches.get("hist_edges_s") or []
+        counts = batches.get("hist_counts") or []
+        if counts and sum(counts):
+            print("  batch-latency histogram:", file=file)
+            lo = 0.0
+            for i, c in enumerate(counts):
+                hi = edges[i] if i < len(edges) else float("inf")
+                if c:
+                    print(f"    ({lo * 1e3:g}, {hi * 1e3:g}] ms: {c}",
+                          file=file)
+                lo = hi
+    rf = rt.get("realtime_factor")
+    if rf:
+        trend, ratio = rt_trend(rf.get("series") or [])
+        print(
+            f"\n## realtime factor (sim-s / wall-s)\n"
+            f"  overall {rf.get('overall')}  p50 {rf.get('p50')}  "
+            f"last {rf.get('last')}  "
+            f"min {rf.get('min')}  max {rf.get('max')}\n"
+            f"  trend: {trend}"
+            + (f" (second-half/first-half = {ratio:.2f})"
+               if ratio is not None else ""),
+            file=file,
+        )
+
+
+# ---------------------------------------------------------------------------
+# --check: the reconciliation gate
+# ---------------------------------------------------------------------------
+
+
+def _modeled_config(tmp: str, runtime: bool) -> dict:
+    """Small pressure-escalate PHOLD: undersized capacity forces real
+    regrows, so the compile-ledger exactness check sees the pressure
+    cache actually compile rungs (bench config 9 in miniature)."""
+    return {
+        "general": {"stop_time": "3 s", "seed": 1, "data_directory": tmp,
+                    "heartbeat_interval": "1 s"},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "experimental": {"event_queue_capacity": 8,
+                         "rounds_per_chunk": 8},
+        "observability": {"trace": True, "runtime": runtime},
+        "pressure": {"policy": "escalate", "max_capacity": 64},
+        "hosts": {"n": {"count": 16, "network_node_id": 0,
+                  "processes": [{"model": "phold",
+                                 "model_args": {"population": 6,
+                                                "mean_delay": "100 ms"}}]}},
+    }
+
+
+def _hybrid_config(runtime: bool) -> dict:
+    return {
+        "general": {"stop_time": "2 s", "seed": 7,
+                    "heartbeat_interval": "500 ms"},
+        "network": {"graph": {"type": "1_gbit_switch"}},
+        "observability": {"runtime": runtime},
+        "hosts": {
+            "server": {"network_node_id": 0,
+                       "processes": [{"path": "udp_echo_server",
+                                      "args": ["port=9000"]}]},
+            "client": {"network_node_id": 0,
+                       "processes": [{"path": "udp_ping",
+                                      "args": ["server=server",
+                                               "port=9000", "count=3"]}]},
+        },
+    }
+
+
+def run_check(tmp_dir: str) -> int:
+    """The reconciliation gate (see module docstring). rc 0 ok, 2 bad."""
+    import io
+
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.cosim import HybridSimulation
+    from shadow_tpu.sim import Simulation
+    from tools.parse_shadow import parse_heartbeats
+
+    failures: list[str] = []
+
+    def ck(ok: bool, msg: str):
+        if not ok:
+            failures.append(msg)
+
+    # ---- modeled leg: exactness + attribution + compile-ledger exactness
+    log_on = io.StringIO()
+    sim_on = Simulation(ConfigOptions.from_dict(
+        _modeled_config(os.path.join(tmp_dir, "on"), True)), world=1)
+    rep_on = sim_on.run(progress=False, log=log_on)
+    sim_off = Simulation(ConfigOptions.from_dict(
+        _modeled_config(os.path.join(tmp_dir, "off"), False)), world=1)
+    rep_off = sim_off.run(progress=False, log=io.StringIO())
+
+    ck(rep_on["determinism_digest"] == rep_off["determinism_digest"],
+       f"digest changed with observatory on: "
+       f"{rep_off['determinism_digest']} -> {rep_on['determinism_digest']}")
+    ck(rep_on["events_processed"] == rep_off["events_processed"],
+       "event count changed with observatory on")
+    rt = rep_on.get("runtime")
+    ck(rt is not None, "no runtime block in gated sim-stats")
+    rt = rt or {}
+
+    # attribution reconciles: per-chunk span sums equal chunk walls by
+    # construction; the cross-check is their TOTAL against the driver's
+    # wall (pre/post-loop setup is the only legitimate gap)
+    share = rt.get("attributed_share")
+    ck(share is not None and 0.85 <= share <= 1.01,
+       f"attributed wall does not reconcile with the driver's total: "
+       f"share={share}")
+    ck(rt.get("chunks", 0) > 0, "no chunks attributed")
+    rf = rt.get("realtime_factor") or {}
+    ck(bool(rf.get("series")), "no realtime-factor series")
+
+    # compile ledger == exactly the programs the engine's cache compiled
+    eng = sim_on.engine
+    expect = 1 + len(eng._gear_chunks) + len(eng._resized_chunks)
+    comp = rt.get("compiles") or {}
+    ck(comp.get("programs") == expect,
+       f"compile ledger records {comp.get('programs')} programs, the "
+       f"engine cache compiled {expect}")
+    regrows = rep_on.get("pressure_regrows", 0)
+    ck(regrows > 0, "check scenario produced no pressure regrows")
+    by_trigger = comp.get("by_trigger") or {}
+    ck(by_trigger.get("cold_start") == 1,
+       f"expected exactly one cold_start entry, got {by_trigger}")
+    ck(by_trigger.get("pressure_regrow") == len(eng._resized_chunks),
+       f"pressure_regrow entries {by_trigger.get('pressure_regrow')} != "
+       f"cached rungs {len(eng._resized_chunks)}")
+    ck(comp.get("compile_wall_s", 0) > 0, "zero compile wall recorded")
+
+    # live rt= heartbeat strict round-trip
+    hb_path = os.path.join(tmp_dir, "hb.log")
+    with open(hb_path, "w") as f:
+        f.write(log_on.getvalue())
+    hbs = parse_heartbeats(hb_path, strict=True)
+    ck(any("rt" in h for h in hbs),
+       f"no heartbeat carried a parseable rt= field ({len(hbs)} lines)")
+
+    # ---- hybrid leg: bridge split present + exactness
+    h_on = HybridSimulation(ConfigOptions.from_dict(_hybrid_config(True)))
+    hrep_on = h_on.run(log=io.StringIO())
+    h_off = HybridSimulation(ConfigOptions.from_dict(_hybrid_config(False)))
+    hrep_off = h_off.run(log=io.StringIO())
+    ck(hrep_on["determinism_digest"] == hrep_off["determinism_digest"],
+       "hybrid digest changed with observatory on")
+    ck(hrep_on["packets_delivered"] == hrep_off["packets_delivered"],
+       "hybrid delivery count changed with observatory on")
+    hrt = hrep_on.get("runtime") or {}
+    br = hrt.get("bridge")
+    ck(br is not None, "hybrid runtime block carries no bridge split")
+    br = br or {}
+    ck(br.get("windows", 0) > 0, "bridge split recorded zero windows")
+    spans = br.get("spans_s") or {}
+    ck(all(k in spans for k in ("cpu_plane", "device_plane", "bridge")),
+       f"bridge split lanes incomplete: {sorted(spans)}")
+    batches = br.get("syscall_batches") or {}
+    ck(batches.get("batches", 0) > 0, "no syscall batches recorded")
+    ck(sum(batches.get("hist_counts") or []) == batches.get("batches"),
+       "syscall-batch histogram does not sum to the batch count")
+
+    print(
+        f"attributed share {share}, {comp.get('programs')} programs "
+        f"({by_trigger}), regrows {regrows}, hybrid windows "
+        f"{br.get('windows')}, bridge share {br.get('bridge_share')}"
+    )
+    if failures:
+        for f_ in failures:
+            print(f"CHECK FAILED: {f_}", file=sys.stderr)
+        return 2
+    print("rt_report --check ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("path", nargs="?",
+                   help="data dir or sim-stats.json with a runtime block")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--check", action="store_true",
+                   help="attribution/ledger reconciliation gate (CI "
+                   "stage); runs the compiled legs in a worker subprocess "
+                   "and classifies the known corruption signature as SKIP")
+    p.add_argument("--check-worker", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: the isolated leg
+    args = p.parse_args(argv)
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # this box's sitecustomize registers an axon TPU plugin and
+        # overrides the env var; pin the backend back (soak.py idiom)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.check_worker:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            return run_check(tmp)
+
+    if args.check:
+        # the shared hbm_report/net_report posture (ONE scaffold,
+        # tools/corruption.run_check_isolated): the compiled legs run
+        # in a fresh subprocess; the documented corruption signature
+        # (no verdict printed) classifies as SKIP rc 0, not a false
+        # FAIL
+        return run_check_isolated(
+            [sys.executable, os.path.abspath(__file__), "--check-worker"],
+            skip_what="an observatory verdict", cwd=_REPO,
+        )
+
+    if not args.path:
+        p.error("a data dir / sim-stats.json path is required "
+                "(or --check)")
+    stats, rt = load_runtime_block(args.path)
+    if args.json:
+        print(json.dumps(rt, indent=2))
+    else:
+        print_report(stats, rt)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
