@@ -1292,6 +1292,24 @@ def measure_config(n: int, small: bool, wall_budget_s: float = 120.0) -> dict:
                 }}
                 if sim.engine_cfg.wheel_active else {}
             ),
+            # hierarchical-exchange tier counters (PR 17): the two-tier
+            # byte split — intra is on-shard compaction staging, inter is
+            # the wire tier ici_bytes above carries; tools/bench_compare.py
+            # gates the inter tier against regressing toward the flat
+            # alltoall cost
+            **(
+                {"exchange": {
+                    "kind": "hierarchical",
+                    "block": sim.engine_cfg.hier_block_size,
+                    "ici_intra_bytes": int(
+                        _np.asarray(s.ici_intra).sum()
+                    ),
+                    "ici_inter_bytes": int(
+                        _np.asarray(s.ici_inter).sum()
+                    ),
+                }}
+                if sim.engine_cfg.hier_active else {}
+            ),
             # gear histogram (adaptive-exchange runs): accepted chunks per
             # gear from the controller, rounds per gear from the trace
             # ring — the low-occupancy acceptance evidence

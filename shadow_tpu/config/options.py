@@ -156,7 +156,15 @@ class ExperimentalOptions:
     # the right default on a real mesh (it burns ICI linearly in the shard
     # count), and the 8-device dryrun gates that the flipped default stays
     # digest-identical to gather with zero sheds. Set "gather" explicitly
-    # to keep the replicated exchange.
+    # to keep the replicated exchange. "hierarchical" (explicit opt-in,
+    # never auto-resolved) runs the exchange in two tiers: an intra-shard
+    # (dst-shard, t, order) compaction first densifies each shard's sends
+    # into per-destination prefixes, then the inter-shard alltoall moves
+    # only the compacted prefixes plus an i32 fill-counter word — digests,
+    # events, and every drop counter bit-identical to alltoall by
+    # construction, with the two tiers charged separately in stats
+    # (ici_intra / ici_inter; ici_bytes carries only the wire tier). See
+    # docs/architecture.md "Hierarchical exchange".
     exchange: str = "auto"
     a2a_block: int = 0  # entries per (src, dst-shard) block; 0 = auto
     # static cap on post-sort merge gather rows (0 = unbounded): bounds the
@@ -250,7 +258,14 @@ class ExperimentalOptions:
           flat per-round up to ~512k — so big sims take short chunks.
 
         Explicit non-zero settings always win; shedding stays loud
-        (queue_overflow_dropped / pkts_budget_dropped in stats)."""
+        (queue_overflow_dropped / pkts_budget_dropped in stats).
+
+        Above 524k hosts the engine additionally clamps the EFFECTIVE
+        rounds-per-chunk to the microstep valve
+        (EngineConfig.effective_rounds_per_chunk) so a config that pins
+        rpc high for mid-size runs cannot re-trip the superlinear
+        while-loop cost at the 1M-lane class; the clamp never fires at
+        or below 524k hosts, so explicit settings still win there."""
         if num_hosts <= 1 << 17:  # <=131k: roomy shapes, long chunks
             auto = (64, 8, 64)
         elif num_hosts <= 1 << 19:  # <=524k: flat per-round regime edge
@@ -310,10 +325,10 @@ class ExperimentalOptions:
                 f"experimental.a2a_block must be >= 0 (0 = auto), "
                 f"got {e.a2a_block}"
             )
-        if e.exchange not in ("auto", "gather", "alltoall"):
+        if e.exchange not in ("auto", "gather", "alltoall", "hierarchical"):
             raise ConfigError(
-                f"experimental.exchange must be auto|gather|alltoall, "
-                f"got {e.exchange!r}"
+                f"experimental.exchange must be auto|gather|alltoall|"
+                f"hierarchical, got {e.exchange!r}"
             )
         if "cpu_delay" in d:
             e.cpu_delay = parse_time_ns(d.pop("cpu_delay"), TimeUnit.MS)
@@ -413,10 +428,16 @@ class ExperimentalOptions:
             )
         if e.timer_wheel and e.microstep_events > 1:
             raise ConfigError(
-                "experimental.timer_wheel requires microstep_events=1 "
-                "this round (the K-way fold needs merged-batch clear/"
-                "reserve accounting to stay exact with a wheel) — drop "
-                "one of the two knobs"
+                f"unsupported knob pair: experimental.timer_wheel"
+                f"={e.timer_wheel} x experimental.microstep_events"
+                f"={e.microstep_events} — the wheel's pop path merges ONE "
+                f"wheel candidate against the queue head per microstep, "
+                f"and the K-way fold would need a merged 2K-candidate "
+                f"batch with split clear/reserve accounting to stay "
+                f"exact. ROADMAP item 1 tracks that follow-up. Until it "
+                f"lands, drop one knob: run the wheel with "
+                f"microstep_events=1 (the measured CPU winner) or keep "
+                f"the wheel off (docs/usage.md 'Timer wheel')"
             )
         if d:
             raise ConfigError(f"unknown experimental options: {sorted(d)}")

@@ -119,6 +119,8 @@ from shadow_tpu.obs.tracer import (
     COL_EC_PKT,
     COL_EC_TIMER,
     COL_FLOWS,
+    COL_XW_INTER,
+    COL_XW_INTRA,
 )
 from shadow_tpu.obs.netobs import FlowLedger, make_flow_ledger
 from shadow_tpu.net.fluid import (
@@ -296,6 +298,19 @@ class Stats(NamedTuple):
     # inputs, so a per-shard lane would multiply the total at export.
     fl_bg_bytes: Any = None  # i64[] | None
     fl_bg_dropped: Any = None  # i64[] | None
+    # Hierarchical-exchange tier accounting (None unless cfg.hier_active —
+    # the flat-exchange program carries neither and stays byte-identical).
+    # `ici_intra` charges the INTRA-shard compaction tier (the local
+    # (dshard, t, order) sort's staging bytes: the gear-sliced outbox rows
+    # repacked into per-destination-shard prefixes — HBM traffic, not
+    # wire); `ici_inter` charges the INTER-shard tier (the alltoall blocks
+    # plus the i32 fill-counter word per peer — the actual ICI wire).
+    # `stats.ici_bytes` keeps its meaning ("exchange-collective bytes")
+    # and carries only the inter tier on hierarchical runs, so the
+    # counter == model x rounds dryrun assertion stays uniform across
+    # exchange kinds.
+    ici_intra: Any = None  # i64[world] | None
+    ici_inter: Any = None  # i64[world] | None
 
 
 class SimState(NamedTuple):
@@ -605,9 +620,10 @@ class EngineConfig:
                 f"num_hosts={self.num_hosts} must divide evenly over "
                 f"world={self.world} mesh devices"
             )
-        if self.exchange not in ("gather", "alltoall"):
+        if self.exchange not in ("gather", "alltoall", "hierarchical"):
             raise ValueError(
-                f"exchange must be gather|alltoall, got {self.exchange!r}"
+                f"exchange must be gather|alltoall|hierarchical, got "
+                f"{self.exchange!r}"
             )
         if self.a2a_block < 0:
             raise ValueError(
@@ -699,11 +715,16 @@ class EngineConfig:
                 )
         if self.wheel_slots and self.microstep_events > 1:
             raise ValueError(
-                "timer wheel + K-way microsteps (microstep_events > 1) is "
-                "not supported yet: the K-way fold would need a merged "
-                "2K-candidate batch with split clear/reserve accounting to "
-                "stay exact — run the wheel with microstep_events=1 (the "
-                "measured CPU winner) or keep the wheel off"
+                "unsupported knob pair: experimental.timer_wheel (wheel_"
+                f"slots={self.wheel_slots}) x experimental.microstep_events="
+                f"{self.microstep_events} — the wheel's pop path merges ONE "
+                "wheel candidate against the queue head per microstep, and "
+                "the K-way fold would need a merged 2K-candidate batch with "
+                "split clear/reserve accounting to stay exact. ROADMAP item "
+                "1 tracks that follow-up. Until it lands, drop one knob: "
+                "run the wheel with microstep_events=1 (the measured CPU "
+                "winner) or keep the wheel off (docs/usage.md 'Timer "
+                "wheel')."
             )
 
     @property
@@ -790,6 +811,52 @@ class EngineConfig:
         gear-abort chunk condition, and the sliced exchange are traced in
         only then — the full-width program stays byte-identical)."""
         return 0 < self.gear_cols < self.sends_per_host_round
+
+    @property
+    def hier_active(self) -> bool:
+        """True iff the two-tier hierarchical exchange is traced into the
+        round body (the tier counters ici_intra/ici_inter exist only then;
+        a world-1 'hierarchical' config degenerates to the local gather
+        path like every other exchange kind and carries neither)."""
+        return self.exchange == "hierarchical" and self.world > 1
+
+    @property
+    def hier_block_size(self) -> int:
+        """Inter-shard block width of the hierarchical exchange (rows per
+        destination shard per round). Same shape law as `a2a_block_size`
+        but derived from the GEAR-SLICED row count: the intra-shard
+        compaction tier sorts only hosts_per_shard x effective_gear_cols
+        rows, so the blocks the wire carries shrink with the merge gear
+        instead of staying sized to the full [H, B] outbox — that delta is
+        the hierarchical path's wire-byte win (`stats.ici_inter` vs the
+        flat alltoall model). An explicit `a2a_block` wins here too, so
+        one knob pins both exchange kinds' block math in A/B runs."""
+        if self.a2a_block:
+            return self.a2a_block
+        rows_g = self.hosts_per_shard * self.effective_gear_cols
+        return min(rows_g, max(64, 2 * rows_g // max(self.world, 1)))
+
+    @property
+    def effective_rounds_per_chunk(self) -> int:
+        """The chunk loop's iteration bound actually traced into
+        `_run_chunk`/`_run_guarded_chunk`.
+
+        Below ~524k hosts this is `rounds_per_chunk` unchanged. Above it,
+        the bound is clamped to the microstep valve (2 x queue_capacity
+        when unset): the XLA while-loop pathology documented in
+        `config/options.resolve_shapes` (BASELINE.md r3 — per-CALL cost of
+        the jitted loop grows superlinearly with the trip bound at >= 1M
+        lanes; rpc=64 took 13.5 s where rpc=8 took 0.36 s for the same 30
+        rounds) makes a large constant bound poison EVERY dispatch at that
+        scale, while results are invariant to it (the drivers loop chunks
+        until `state.done`, so a smaller bound only means more host
+        round-trips). The valve reproduces `resolve_shapes`' measured
+        auto-tier rpc exactly (tier-3 qcap 4 -> 8, tier-2 qcap 16 -> 32);
+        the host-count gate keeps explicitly-tuned small-H configs (e.g.
+        bench_config's rpc=512 at 10k hosts) untouched."""
+        if self.num_hosts <= 1 << 19:
+            return self.rounds_per_chunk
+        return min(self.rounds_per_chunk, max(self.effective_microstep_limit, 1))
 
 
 # --------------------------------------------------------------------------
@@ -879,6 +946,10 @@ def _init_stats(cfg: EngineConfig) -> Stats:
         fl_bg_dropped=(
             jnp.zeros((), jnp.int64) if cfg.fluid_active else None
         ),
+        # hierarchical-exchange tier counters: absent unless the two-tier
+        # exchange is traced in — distinct buffers (donation rule above)
+        ici_intra=zw() if cfg.hier_active else None,
+        ici_inter=zw() if cfg.hier_active else None,
     )
 
 
@@ -1345,6 +1416,8 @@ class Engine:
                 wheel_occ_hwm=sh if self.cfg.wheel_active else None,
                 fl_bg_bytes=rep if self.cfg.fluid_active else None,
                 fl_bg_dropped=rep if self.cfg.fluid_active else None,
+                ici_intra=sh if self.cfg.hier_active else None,
+                ici_inter=sh if self.cfg.hier_active else None,
             ),
             trace=(
                 TraceRing(rows=sh, cursor=sh) if self.cfg.trace_rounds
@@ -1567,7 +1640,10 @@ def _run_chunk(cfg: EngineConfig, model, axis, state: SimState, params: EnginePa
 
     def cond(carry):
         st, i = carry
-        ok = (~st.done) & (i < cfg.rounds_per_chunk)
+        # effective_rounds_per_chunk, not rounds_per_chunk: at million-host
+        # scale the valve-clamped bound sidesteps the XLA while-loop
+        # pathology (the property's docstring has the numbers)
+        ok = (~st.done) & (i < cfg.effective_rounds_per_chunk)
         if shed0 is not None:
             ok = ok & (st.stats.gear_shed[0] <= shed0)
         if press0 is not None:
@@ -1618,7 +1694,7 @@ def _run_guarded_chunk(
             probe = lax.pmax(probe.astype(jnp.int32), axis) > 0
         ok = (
             (~stc.done)
-            & (i < cfg.rounds_per_chunk)
+            & (i < cfg.effective_rounds_per_chunk)
             & (gmin < until)
             & (~probe)
         )
@@ -1952,6 +2028,11 @@ def _trace_round(
             vals[COL_FLOWS] = delta(lambda s: s.fl_done)
         if bind_shard is not None:
             vals[COL_BIND_SHARD] = bind_shard
+    if cfg.hier_active:
+        # hierarchical-exchange tier columns (flat-exchange traced runs
+        # keep zeros here — positional like the netobs columns)
+        vals[COL_XW_INTRA] = delta(lambda s: s.ici_intra)
+        vals[COL_XW_INTER] = delta(lambda s: s.ici_inter)
     row = jnp.stack([jnp.asarray(v, jnp.int64) for v in vals])
     # the cursor is a registered i64 lane (core/lanes.py); the slice index
     # stays i64 rather than narrowing the lane value (shadowlint R2)
@@ -3016,6 +3097,12 @@ def exchange_ici_bytes_per_round(cfg: EngineConfig, kind: str | None = None) -> 
               ops/merge._pack_words) — O(global sends / world) once blocks
               are sized to traffic instead of O(world-replicated) like the
               gather.
+    hierarchical: the INTER tier of `exchange_tier_bytes_per_round` —
+              (W-1) gear-aware blocks of `hier_block_size` packed rows
+              plus the 4-byte i32 fill counter per peer. The intra tier
+              (local compaction staging) is charged to `stats.ici_intra`
+              only, never here: `ici_bytes` stays "bytes the exchange
+              COLLECTIVE moves" across all three kinds.
 
     The engine charges exactly these numbers into `stats.ici_bytes` every
     round (the collectives run unconditionally, empty rounds included), so
@@ -3032,8 +3119,36 @@ def exchange_ici_bytes_per_round(cfg: EngineConfig, kind: str | None = None) -> 
     row_bytes = 4 + 8 + 8 + 4 + 4 * EVENT_PAYLOAD_WORDS
     if kind == "gather":
         return (cfg.world - 1) * (rows_local * row_bytes + 4)
+    if kind == "hierarchical":
+        return exchange_tier_bytes_per_round(cfg)[1]
     packed_words = 1 + (2 + 2 + 1 + EVENT_PAYLOAD_WORDS)  # dst + packed event
     return (cfg.world - 1) * cfg.a2a_block_size * packed_words * 4
+
+
+def exchange_tier_bytes_per_round(cfg: EngineConfig) -> tuple[int, int]:
+    """(intra, inter) bytes the hierarchical exchange charges per round,
+    per shard — the two-tier cost model as checkable numbers.
+
+    intra: the compaction tier's staging traffic — every gear-sliced local
+           outbox row (hosts_per_shard x effective_gear_cols) repacked
+           once into the [world, k] block layout at packed width (1 dst
+           word + the packed event, ops/merge._pack_words). HBM bytes,
+           not wire: charged to `stats.ici_intra` so the weak-scaling
+           bench can hold local-compaction work against wire savings.
+    inter: the wire tier — (W-1) blocks of `hier_block_size` packed rows
+           plus the 4-byte i32 fill counter per peer (the lane-diet wire
+           element). Charged to `stats.ici_inter` AND `stats.ici_bytes`.
+
+    Both tiers shrink with the merge gear (the flat alltoall's blocks are
+    gear-invariant) — that delta is the hierarchical path's win, and
+    `tests/test_hier.py` pins counter == model x rounds for both lanes."""
+    if cfg.world <= 1:
+        return 0, 0
+    packed_words = 1 + (2 + 2 + 1 + EVENT_PAYLOAD_WORDS)
+    rows_g = cfg.hosts_per_shard * cfg.effective_gear_cols
+    intra = rows_g * packed_words * 4
+    inter = (cfg.world - 1) * (cfg.hier_block_size * packed_words + 1) * 4
+    return intra, inter
 
 
 def _gear_sliced_outbox(cfg, axis, ob: Outbox, sent_round):
@@ -3073,6 +3188,8 @@ def _gear_sliced_outbox(cfg, axis, ob: Outbox, sent_round):
 def _exchange(cfg, axis, st: SimState):
     if axis and cfg.exchange == "alltoall":
         return _exchange_alltoall(cfg, axis, st)
+    if axis and cfg.exchange == "hierarchical":
+        return _exchange_hierarchical(cfg, axis, st)
     ob_full = st.outbox
     ob, gear_shed = _gear_sliced_outbox(cfg, axis, ob_full, st.sent_round)
     if axis:
@@ -3254,59 +3371,9 @@ def _exchange_alltoall(cfg, axis, st: SimState):
     h_local = st.queue.t.shape[0]
     world = cfg.world
     k = cfg.a2a_block_size
-    n_loc = ob.t.shape[0] * ob.t.shape[1]
     my = lax.axis_index(axis).astype(jnp.int32)
 
-    dst_f = ob.dst.reshape(-1)
-    t_f = ob.t.reshape(-1)
-    order_f = ob.order.reshape(-1)
-    kind_f = ob.kind.reshape(-1)
-    payload_f = ob.payload.reshape(-1, ob.payload.shape[-1])
-    valid = t_f != TIME_MAX
-    dshard = jnp.where(valid, dst_f // h_local, world).astype(jnp.int32)
-
-    # sort rows by (dst shard, t, order) plus one token per shard group —
-    # the same token trick the merge uses for segment extraction
-    iota = jnp.arange(n_loc, dtype=jnp.int32)
-    q_keys = jnp.arange(world + 1, dtype=jnp.int32)
-    all_sh = jnp.concatenate([dshard, q_keys])
-    all_t = jnp.concatenate([t_f, jnp.full((world + 1,), -1, t_f.dtype)])
-    all_o = jnp.concatenate(
-        [order_f, jnp.full((world + 1,), -1, order_f.dtype)]
-    )
-    all_idx = jnp.concatenate(
-        [iota + 1, jnp.zeros((world + 1,), jnp.int32)]
-    )
-    s_sh, _, _, s_tag = lax.sort((all_sh, all_t, all_o, all_idx), num_keys=3)
-    m = n_loc + world + 1
-    is_tok = s_tag == 0
-    key2 = jnp.where(is_tok, s_sh, jnp.int32(world + 1))
-    pos = jnp.arange(m, dtype=jnp.int32)
-    _, tok_pos = lax.sort((key2, pos), num_keys=1, is_stable=True)
-    first = tok_pos[: world + 1]
-    seg_len = first[1:] - first[:-1] - 1  # i32[world]
-
-    # pack rows (dst word + event words) and permute into sorted order
-    words = jnp.concatenate(
-        [
-            dst_f[:, None].astype(jnp.int32),
-            _pack_words_rows(t_f, order_f, kind_f, payload_f),
-        ],
-        axis=1,
-    )
-    s_idx = s_tag - 1
-    w_sorted = words[s_idx]  # [M, W+1]; token rows harmless (never taken)
-
-    # block j carries group j's first k rows (urgency order); later rows shed
-    rr = jnp.arange(k, dtype=jnp.int32)
-    in_seg = rr[None, :] < jnp.minimum(seg_len, k)[:, None]  # [world, k]
-    src_pos = jnp.where(in_seg, first[:world, None] + 1 + rr[None, :], 0)
-    blocks = w_sorted[src_pos]  # [world, k, W+1]
-    inval = _invalid_row(ob.payload.shape[-1])
-    blocks = jnp.where(in_seg[:, :, None], blocks, inval[None, None, :])
-    shed = jnp.sum(
-        jnp.maximum(seg_len - k, 0), dtype=jnp.int64
-    )
+    blocks, _seg_len, shed = _dshard_pack_blocks(ob, h_local, world, k)
 
     recv = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
     flat_rows = recv.reshape(world * k, -1)
@@ -3328,6 +3395,150 @@ def _exchange_alltoall(cfg, axis, st: SimState):
     )
     if gear_shed is not None:
         stats = stats._replace(gear_shed=stats.gear_shed + gear_shed[None])
+    if isinstance(st.queue, BucketQueue):
+        stats = stats._replace(
+            bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]
+        )
+    return st._replace(
+        queue=queue,
+        outbox=_fresh_outbox(ob_full),
+        sent_round=jnp.zeros_like(st.sent_round),
+        stats=stats,
+    )
+
+
+def _dshard_pack_blocks(ob: Outbox, h_local: int, world: int, k: int):
+    """Sort the local outbox by (dst shard, t, order) and pack each
+    destination group's first `k` rows into fixed wire blocks.
+
+    The shared front half of the flat alltoall AND the hierarchical
+    exchange's intra-shard compaction tier — one definition of "compacted
+    per-destination prefix" (ops/merge.dshard_segments does the grouping),
+    so the two exchange kinds select bit-identical row sets for any given
+    block width. Block j carries group j's first k rows in urgency order;
+    later rows shed (counted, never silent).
+
+    Returns (blocks i32[world, k, 1 + packed], seg_len i32[world],
+    shed i64[]) — `seg_len` is the per-destination valid-row count before
+    truncation (the hierarchical path's fill-counter source), `shed` the
+    local count of rows beyond `k`."""
+    from shadow_tpu.ops.merge import dshard_segments
+
+    dst_f = ob.dst.reshape(-1)
+    t_f = ob.t.reshape(-1)
+    order_f = ob.order.reshape(-1)
+    kind_f = ob.kind.reshape(-1)
+    payload_f = ob.payload.reshape(-1, ob.payload.shape[-1])
+    valid = t_f != TIME_MAX
+    dshard = jnp.where(valid, dst_f // h_local, world).astype(jnp.int32)
+
+    s_tag, first, seg_len = dshard_segments(dshard, t_f, order_f, world)
+
+    # pack rows (dst word + event words) and permute into sorted order
+    words = jnp.concatenate(
+        [
+            dst_f[:, None].astype(jnp.int32),
+            _pack_words_rows(t_f, order_f, kind_f, payload_f),
+        ],
+        axis=1,
+    )
+    s_idx = s_tag - 1
+    w_sorted = words[s_idx]  # [M, W+1]; token rows harmless (never taken)
+
+    # block j carries group j's first k rows (urgency order); later rows shed
+    rr = jnp.arange(k, dtype=jnp.int32)
+    in_seg = rr[None, :] < jnp.minimum(seg_len, k)[:, None]  # [world, k]
+    src_pos = jnp.where(in_seg, first[:world, None] + 1 + rr[None, :], 0)
+    blocks = w_sorted[src_pos]  # [world, k, W+1]
+    inval = _invalid_row(ob.payload.shape[-1])
+    blocks = jnp.where(in_seg[:, :, None], blocks, inval[None, None, :])
+    shed = jnp.sum(jnp.maximum(seg_len - k, 0), dtype=jnp.int64)
+    return blocks, seg_len, shed
+
+
+def _exchange_hierarchical(cfg, axis, st: SimState):
+    """Two-tier exchange (ROADMAP item 2 — the million-host climb): an
+    INTRA-shard compaction tier, then an INTER-shard alltoall that moves
+    only the compacted prefixes.
+
+    Tier 1 (intra-shard, no wire): the gear-sliced [H_local, gear] outbox
+    is sorted by (dst shard, t, order) — `ops/merge.dshard_segments`, the
+    exact machinery the flat alltoall uses — compacting this shard's sends
+    into dense per-destination-shard prefixes in urgency order. Charged to
+    `stats.ici_intra` (staging-buffer traffic; obs/memory.py prices the
+    buffers themselves).
+
+    Tier 2 (inter-shard, the ICI wire): two collectives — the i32
+    fill-counter vector `sent_counts` (the lane-diet wire element: bounded
+    by `hier_block_size`, so i32 is provably lossless — core/lanes.py
+    LANE_MIN_WIDTH_BITS and shadowlint R7 pin the bound) and the
+    [world, k] packed blocks with k = `hier_block_size`. Charged to
+    `stats.ici_inter` AND `stats.ici_bytes`.
+
+    Where the wire shrinks vs the flat alltoall: `hier_block_size` derives
+    from the GEAR-SLICED row count (hosts_per_shard x effective_gear_cols)
+    where `a2a_block_size` is fixed at the full [H, B] row count — geared
+    runs move proportionally smaller blocks. Gears off, the two block
+    sizes coincide and the wire rows are identical.
+
+    Exactness: local sort, urgency-order block selection, and merge input
+    are identical to the flat alltoall whenever nothing sheds. Receive
+    validity is derived from the counts AND the invalid-row time marker —
+    identical truth sets by construction, so a counts-vs-payload drift
+    surfaces as dropped rows the digest gate catches rather than phantom
+    inserts. Block overflow on a geared run counts into `gear_shed`
+    (psum'd): the chunk aborts and replays one gear up, and the TOP gear's
+    k equals the flat k, so the ladder always has an exact escape; at full
+    width overflow counts into `a2a_shed` exactly like the flat path.
+    Digests, events, and every drop counter are therefore bit-identical to
+    `alltoall` (tests/test_hier.py is the gate)."""
+    ob_full = st.outbox
+    ob, gear_shed = _gear_sliced_outbox(cfg, axis, ob_full, st.sent_round)
+    h_local = st.queue.t.shape[0]
+    world = cfg.world
+    k = cfg.hier_block_size
+    my = lax.axis_index(axis).astype(jnp.int32)
+
+    with jax.named_scope("shadow_hier_intra"):
+        blocks, seg_len, shed = _dshard_pack_blocks(ob, h_local, world, k)
+    sent_counts = jnp.minimum(seg_len, k).astype(jnp.int32)
+
+    with jax.named_scope("shadow_hier_inter"):
+        recv_counts = lax.all_to_all(
+            sent_counts, axis, split_axis=0, concat_axis=0
+        )
+        recv = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+    flat_rows = recv.reshape(world * k, -1)
+    r_dst = flat_rows[:, 0]
+    r_t, r_order, r_kind, r_payload = _unpack_words_rows(
+        flat_rows[:, 1:], ob.payload.shape[-1]
+    )
+    local = r_dst - my * h_local
+    rr = jnp.arange(world * k, dtype=jnp.int32)
+    by_count = (rr % k) < recv_counts[rr // k]
+    r_valid = by_count & (r_t != TIME_MAX) & (local >= 0) & (local < h_local)
+    flat = (local, r_t, r_order, r_kind, r_payload, r_valid)
+
+    has_sends = lax.psum(jnp.sum(ob.count), axis) > 0
+    with jax.named_scope("shadow_merge"):
+        queue = _merge_into_queue(cfg, st.queue, flat, has_sends)
+
+    intra_b, inter_b = exchange_tier_bytes_per_round(cfg)
+    stats = st.stats._replace(
+        ici_bytes=st.stats.ici_bytes + jnp.int64(inter_b)[None],
+        ici_intra=st.stats.ici_intra + jnp.int64(intra_b)[None],
+        ici_inter=st.stats.ici_inter + jnp.int64(inter_b)[None],
+    )
+    if cfg.gear_active:
+        # geared block overflow rides the gear-abort path, not a2a_shed:
+        # the driver replays one gear up, whose wider k re-derives the
+        # block — the exact-escape contract the docstring argues
+        shed_g = lax.psum(shed, axis)
+        stats = stats._replace(
+            gear_shed=stats.gear_shed + (gear_shed + shed_g)[None]
+        )
+    else:
+        stats = stats._replace(a2a_shed=stats.a2a_shed + shed[None])
     if isinstance(st.queue, BucketQueue):
         stats = stats._replace(
             bq_rebuilds=stats.bq_rebuilds + has_sends.astype(jnp.int64)[None]

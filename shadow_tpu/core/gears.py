@@ -20,6 +20,17 @@ and can never shed, so the replay loop terminates, and accepted chunks are
 bit-identical to the full-width engine on every workload — digests, event
 counts, and drop counters included (tests/test_gears.py is the gate).
 
+The hierarchical exchange composes with gears through the SAME abort
+contract: the gear width rescales the inter-shard block size too
+(`EngineConfig.hier_block_size` derives from rows_g = hosts_per_shard x
+effective_gear_cols), so a narrow gear also thins the alltoall blocks —
+and a block overflow under a gear is psum'd into `stats.gear_shed`
+exactly like a sort-width shed, tripping the same abort-and-replay one
+gear up. At the top gear the hierarchical block size equals the flat
+alltoall's, so the ladder's termination argument carries over unchanged
+(core/engine.py `_exchange_hierarchical`; tests/test_hier.py gates the
+geared matrix).
+
 The controller is deliberately simple and deterministic:
   - upshift immediately (on a shed, or when the observed high-water
     reaches the current gear's width — headroom of one lane column);

@@ -58,12 +58,15 @@ TIME_LANES = frozenset({
     "resume",
     "lat_ns",
     "jitter_ns",
+    # bucketed-queue / timer-wheel block-minimum TIME cache (bt): a min
+    # over t entries is itself a time — same 64-bit obligation
+    "bt",
 })
 
 # Event-ordering lanes: int64 packed (locality, src-host, seq) keys
 # (ops/events.py pack_order). The packing uses the full 63 bits; any
 # narrowing collides order keys and breaks determinism.
-ORDER_LANES = frozenset({"order", "seq"})
+ORDER_LANES = frozenset({"order", "seq", "bo"})
 
 # Monotone counter lanes: int64. A long campaign overflows i32 counters
 # (events at 10k hosts pass 2^31 in under an hour of sim time), and the
@@ -109,7 +112,83 @@ NARROW_LANES = {
     "kind": "int32",
     "payload": "int32",
     "sent_round": "int32",
+    # exchange-wire fill accounting (core/engine.py alltoall/hierarchical
+    # paths): per-destination-shard valid-row counts and the hierarchical
+    # exchange's fill-counter wire vectors, all bounded by block/slot
+    # counts (LANE_MIN_WIDTH_BITS states each bound) — i32 on the wire is
+    # the lane diet, and riding them at i64 would silently double the
+    # counter tier's ICI bytes
+    "seg_len": "int32",
+    "sent_counts": "int32",
+    "recv_counts": "int32",
+    # staging/queue fill counters bounded by slot counts: the outbox
+    # append cursor (<= H_local x sends_per_host_round) and the bucketed
+    # queue's per-block occupancy (<= queue_block)
+    "count": "int32",
+    "bfill": "int32",
 }
+
+# ---------------------------------------------------------------------------
+# Lane diet (ISSUE 17): minimum EXACT width in bits per lane — the
+# smallest width at which the lane's full value range provably
+# round-trips, independent of the width it is registered at. Two uses:
+#
+#   * shadowlint rule R7 (tools/lint/schema.py check_lane_diet) asserts
+#     every EXCHANGE_WIRE_LANES member has an entry here, that no lane is
+#     registered NARROWER than its minimum, and that wire lanes whose
+#     minimum is <= 32 are actually registered at 32 (the diet is real —
+#     a bounded counter riding the wire at i64 is a silent 2x on
+#     `stats.ici_inter`), while wire lanes whose minimum is 64 must be
+#     time/order/digest lanes (the only species with a genuine 64-bit
+#     range).
+#   * the bounds below are the PROOF OBLIGATIONS: each entry names the
+#     capacity/slot count that caps the lane. Growing one of those caps
+#     past 2^31 must come back here first.
+#
+# Bounds (all static config values, enforced at EngineConfig build time):
+#   dst          host id < num_hosts; ops/events.check_order_limits caps
+#                num_hosts far below 2^31 (the packed order key budget)
+#   kind         model event-kind enum (single-digit cardinality)
+#   payload      i32 words by the EVENT_PAYLOAD_WORDS contract
+#   sent_round   <= sends_per_host_round (per-round budget)
+#   count        <= hosts_per_shard x sends_per_host_round (outbox slots)
+#   bfill        <= queue_block (per-block slot count)
+#   seg_len      <= hosts_per_shard x sends_per_host_round (local rows)
+#   sent_counts  <= hier_block_size (minimum of seg_len and the block)
+#   recv_counts  <= hier_block_size (a peer's sent_counts)
+#   t, bt        int64 ns — i32 ns wraps at ~2.1 sim-seconds (TIME_LANES)
+#   order, bo    full 63-bit packed (locality, src, seq) key (ORDER_LANES)
+#   digest(2)    64-bit FNV state by definition (DIGEST_LANES)
+# ---------------------------------------------------------------------------
+
+LANE_MIN_WIDTH_BITS: dict[str, int] = {
+    "dst": 32,
+    "kind": 32,
+    "payload": 32,
+    "sent_round": 32,
+    "count": 32,
+    "bfill": 32,
+    "seg_len": 32,
+    "sent_counts": 32,
+    "recv_counts": 32,
+    "t": 64,
+    "bt": 64,
+    "order": 64,
+    "bo": 64,
+    "digest": 64,
+    "digest2": 64,
+}
+
+#: lanes that cross an exchange collective in SOME exchange kind: the
+#: gather path all_gathers the (sliced) outbox lanes wholesale; the
+#: alltoall and hierarchical paths pack (dst, t, order, kind, payload)
+#: into wire blocks; the hierarchical counter tier moves
+#: sent_counts/recv_counts. R7's wire-width table is derived from this
+#: set x LANE_MIN_WIDTH_BITS (docs/architecture.md reproduces it).
+EXCHANGE_WIRE_LANES = frozenset({
+    "dst", "t", "order", "kind", "payload", "count",
+    "sent_counts", "recv_counts",
+})
 
 #: terminal lane name -> required dtype string
 LANE_WIDTHS: dict[str, str] = {
@@ -161,6 +240,10 @@ _STATS_I64 = (
     "ob_dropped", "a2a_shed", "microsteps", "bq_rebuilds",
     "popk_deferred", "ici_bytes", "q_occ_hwm", "outbox_hwm",
     "gear_shed", "rounds",
+    # hierarchical-exchange tier counters (present only when
+    # experimental.exchange: hierarchical on a multi-device mesh): byte
+    # accumulators like ici_bytes, i64 for the same no-wrap reason
+    "ici_intra", "ici_inter",
 )
 
 STATE_LANES: dict[str, str] = {
@@ -302,6 +385,7 @@ _STATS_PER_SHARD = (
     "ici_bytes", "outbox_hwm", "gear_shed", "pressure",
     "ec_timer", "ec_pkt", "ec_app", "fl_done", "fl_bytes", "fl_rtx",
     "win_bound", "integrity", "iv_mask", "iv_round",
+    "ici_intra", "ici_inter",
 )
 
 STATE_LANE_SHAPES: dict[str, tuple] = {

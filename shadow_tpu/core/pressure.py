@@ -38,6 +38,16 @@ chunk boundaries the always-on `stats.q_occ_hwm` / `stats.outbox_hwm`
 high-waters trigger a grow BEFORE anything drops, so steady pressure
 costs one migration, not a replayed chunk.
 
+The hierarchical exchange rides both axes for free: an escalated outbox
+width B' flows through `Engine.resized_cfg`'s dataclasses.replace, so the
+auto inter-shard block size (`EngineConfig.hier_block_size`, derived from
+hosts_per_shard x effective_gear_cols) re-derives at the regrown shape —
+a wider outbox also widens the alltoall blocks, and the grown program
+stays shed-free for the same traffic that grew it. An EXPLICIT a2a_block
+is pinned across regrows (explicit settings always win); if a regrow
+outgrows it, the block overflow stays loud via the usual gear_shed /
+a2a_shed accounting rather than silently resizing the wire format.
+
 Graceful degradation when escalation itself fails: a grown program's
 compile/dispatch dying of RESOURCE_EXHAUSTED / XlaRuntimeError marks
 that rung (and everything above it) unusable and falls back one rung;

@@ -85,6 +85,7 @@ def dims_of(
     wheel_block: int = 0,
     fluid_classes: int = 0,
     fluid_links: int = 0,
+    hier: bool = False,
     payload_words: int | None = None,
     trace_cols: int | None = None,
     flow_cols: int | None = None,
@@ -130,6 +131,9 @@ def dims_of(
         "FN": int(fluid_links) if fluid_classes else 0,
         "pressure": 1 if pressure else 0,
         "netobs": 1 if netobs else 0,
+        # hierarchical exchange (core/engine.py _exchange_hierarchical):
+        # gates the two-tier byte counters (stats.ici_intra/ici_inter)
+        "hier": 1 if hier else 0,
         "integrity": 1 if integrity else 0,
         "integrity_dual": 1 if integrity_dual else 0,
     }
@@ -152,6 +156,7 @@ def dims_of_config(cfg) -> dict[str, int]:
         wheel_block=cfg.wheel_block,
         fluid_classes=cfg.fluid_classes,
         fluid_links=cfg.fluid_links,
+        hier=cfg.hier_active,
     )
 
 
@@ -193,6 +198,7 @@ def dims_of_state(cfg, state) -> dict[str, int]:
             int(state.fluid.link_util.shape[-1])
             if getattr(state, "fluid", None) is not None else 0
         ),
+        hier=state.stats.ici_intra is not None,
     )
 
 
@@ -239,6 +245,11 @@ def lane_plane_bytes(path: str, dims: dict[str, int]) -> int | None:
         path.startswith("fluid.")
         or path in ("stats.fl_bg_bytes", "stats.fl_bg_dropped")
     ) and dims.get("FK", 0) == 0:
+        return None
+    # hierarchical-exchange tier counters: absent off the hierarchical path
+    if path in ("stats.ici_intra", "stats.ici_inter") and not dims.get(
+        "hier"
+    ):
         return None
     n = 1
     for tok in shape:
@@ -398,6 +409,7 @@ def state_bytes_at(cfg, capacity: int, send_budget: int) -> int:
         integrity_dual=cfg.integrity_dual,
         wheel_slots=cfg.wheel_slots,
         wheel_block=cfg.wheel_block,
+        hier=cfg.hier_active,
     )
     return sum(component_totals(registered_component_bytes(dims)).values())
 

@@ -63,6 +63,11 @@ TRACE_FIELDS = (
     "ec_app",         # app-class events executed this round (delta)
     "flows",          # flows completed this round (delta, this shard)
     "bind_shard",     # shard whose local min bound the barrier this round
+    # hierarchical exchange tiers (core/engine.py _exchange_hierarchical;
+    # zero unless experimental.exchange: hierarchical on a multi-device
+    # mesh) — the xw= heartbeat pair, per round
+    "xw_intra",       # intra-shard compaction staging bytes (delta)
+    "xw_inter",       # inter-shard wire bytes (delta, this shard)
 )
 TRACE_COLS = len(TRACE_FIELDS)
 (
@@ -89,6 +94,8 @@ TRACE_COLS = len(TRACE_FIELDS)
     COL_EC_APP,
     COL_FLOWS,
     COL_BIND_SHARD,
+    COL_XW_INTRA,
+    COL_XW_INTER,
 ) = range(TRACE_COLS)
 
 

@@ -133,6 +133,43 @@ def gear_shed_count(sent_round, gear_cols: int):
     return jnp.sum(jnp.maximum(sent_round.astype(jnp.int64) - gear_cols, 0))
 
 
+def dshard_segments(dshard, t, order, world: int):
+    """Group local outbox rows by destination shard via ONE `lax.sort`.
+
+    Sorts rows by (dst shard, t, order) with one sentinel token per shard
+    group riding along at (shard, -1, -1) — the same token trick the
+    merge's per-host segment extraction uses — then recovers each group's
+    start with a second tiny stable sort over the token positions. Invalid
+    rows must arrive with `dshard == world` so they sort past every real
+    group.
+
+    Returns (s_tag i32[M], first i32[world + 1], seg_len i32[world]):
+    `s_tag` is the sorted permutation tag (0 = token, else source row
+    index + 1), `first[j]` the sorted position of group j's token, and
+    `seg_len[j]` the count of valid rows destined for shard j — those rows
+    sit immediately after the token in (t, order) urgency order. Shared by
+    the flat alltoall exchange and the hierarchical exchange's intra-shard
+    compaction tier, so the two paths cannot drift on what "compacted
+    per-destination prefix" means (the bit-identity contract between
+    them)."""
+    n_loc = dshard.shape[0]
+    iota = jnp.arange(n_loc, dtype=jnp.int32)
+    q_keys = jnp.arange(world + 1, dtype=jnp.int32)
+    all_sh = jnp.concatenate([dshard, q_keys])
+    all_t = jnp.concatenate([t, jnp.full((world + 1,), -1, t.dtype)])
+    all_o = jnp.concatenate([order, jnp.full((world + 1,), -1, order.dtype)])
+    all_idx = jnp.concatenate([iota + 1, jnp.zeros((world + 1,), jnp.int32)])
+    s_sh, _, _, s_tag = lax.sort((all_sh, all_t, all_o, all_idx), num_keys=3)
+    m = n_loc + world + 1
+    is_tok = s_tag == 0
+    key2 = jnp.where(is_tok, s_sh, jnp.int32(world + 1))
+    pos = jnp.arange(m, dtype=jnp.int32)
+    _, tok_pos = lax.sort((key2, pos), num_keys=1, is_stable=True)
+    first = tok_pos[: world + 1]
+    seg_len = first[1:] - first[:-1] - 1  # i32[world]
+    return s_tag, first, seg_len
+
+
 def _pack_words(t, order, kind, payload):
     """[N] i64 ×2, [N] i32, [N, P] i32 -> [N, 4 + 1 + P] i32 row matrix."""
     t2 = lax.bitcast_convert_type(t, jnp.int32)  # [N, 2]

@@ -349,6 +349,7 @@ def heartbeat_line(
     ici_bytes: int,
     q_hwm: int,
     *,
+    xw: tuple[int, int] | None = None,
     fault: tuple[int, int] | None = None,
     gear: int | None = None,
     cap: int | None = None,
@@ -379,7 +380,11 @@ def heartbeat_line(
     chunk's realtime factor (sim-s/wall-s) — only on runtime-observatory
     runs (obs/runtime.py; unlike `ratio=`, which is the run-cumulative
     average, `rt=` is the fresh per-chunk number the serving posture
-    tracks)."""
+    tracks); `xw` is (intra-shard compaction bytes, inter-shard wire
+    bytes), cumulative — only on hierarchical-exchange runs
+    (core/engine.py _exchange_hierarchical; it rides right after q_hwm=,
+    before faults=, matching HEARTBEAT_RE's position anchor)."""
+    xw_f = f"xw={xw[0]}/{xw[1]} " if xw is not None else ""
     fault_f = f"faults={fault[0]}/{fault[1]} " if fault is not None else ""
     gear_f = f"gear={gear} " if gear is not None else ""
     cap_f = f"cap={cap} " if cap is not None else ""
@@ -397,6 +402,7 @@ def heartbeat_line(
         f"msteps/round={microsteps / max(rounds, 1):.1f} "
         f"ev/mstep={events / max(microsteps, 1):.2f} "
         f"ici_bytes={ici_bytes} q_hwm={q_hwm} "
+        f"{xw_f}"
         f"{fault_f}"
         f"{gear_f}"
         f"{cap_f}"
@@ -1092,6 +1098,14 @@ class Simulation:
                     # faults= rides along only when the fault plane is
                     # active, gear= only on adaptive runs (old-format
                     # lines stay byte-identical; parse_shadow reads both)
+                    # xw= rides along only on hierarchical-exchange runs:
+                    # cumulative (intra compaction, inter wire) tier bytes
+                    xw = None
+                    if self.engine_cfg.hier_active:
+                        xw = (
+                            int(np.asarray(self.state.stats.ici_intra).sum()),
+                            int(np.asarray(self.state.stats.ici_inter).sum()),
+                        )
                     fault = None
                     if self.engine_cfg.faults_active:
                         fd = int(np.asarray(self.state.stats.faults_dropped).sum())
@@ -1146,8 +1160,8 @@ class Simulation:
                     print(
                         heartbeat_line(
                             now_ns, wall, ev, msteps, rounds, ici, qhwm,
-                            fault=fault, gear=last_gear, cap=cap, hbm=hbm,
-                            ek=ek, fct=fct, bg=bg, iv=iv, rt=rt,
+                            xw=xw, fault=fault, gear=last_gear, cap=cap,
+                            hbm=hbm, ek=ek, fct=fct, bg=bg, iv=iv, rt=rt,
                         ),
                         file=log,
                     )
@@ -1289,6 +1303,20 @@ class Simulation:
                 self._model_hosts(),
             ),
         }
+        if self.engine_cfg.hier_active:
+            # hierarchical-exchange block (core/engine.py
+            # _exchange_hierarchical): the two-tier byte split. intra is
+            # compaction staging traffic (stays on-shard, HBM-side);
+            # inter is what actually crossed the ICI — the same number
+            # ici_bytes above carries, broken out so trend tooling
+            # (bench rows, tools/bench_compare.py) can guard the
+            # inter-shard tier against regressing toward the flat cost.
+            report["exchange"] = {
+                "kind": "hierarchical",
+                "block": self.engine_cfg.hier_block_size,
+                "ici_intra_bytes": int(np.asarray(s.ici_intra).sum()),
+                "ici_inter_bytes": int(np.asarray(s.ici_inter).sum()),
+            }
         if self.engine_cfg.wheel_active:
             # timer-wheel block (ops/wheel.py): occupancy high-water +
             # spill count — the slot-sizing signal (tools/bench_wheel.py

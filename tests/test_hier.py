@@ -1,0 +1,364 @@
+"""Hierarchical two-level exchange + lane diet (ISSUE 17, PR 17).
+
+Two contracts pinned here:
+
+* Exactness matrix — `exchange: hierarchical` (intra-shard (dst, t,
+  order) compaction, then an inter-shard alltoall of compacted block
+  prefixes) produces digests, per-host event counts, and EVERY drop
+  counter bit-identical to the established engine, across echo/phold/
+  tgen, flat and bucketed queue layouts, K in {1, 4}, gears on and off,
+  and world in {1, 8}. The world-8 runs compare against the world-1
+  full-width reference (the strongest form: digest invariance across
+  MESH SHAPES, which the earlier exchange PRs already pinned for gather
+  and alltoall — so hier == world-1 == alltoall transitively), plus one
+  direct same-mesh hier-vs-alltoall leg including shed totals.
+
+* Two-tier accounting — `stats.ici_intra` (local compaction staging,
+  HBM) and `stats.ici_inter` (the wire) must each equal
+  `exchange_tier_bytes_per_round(cfg)` x exchanges x world EXACTLY, and
+  `stats.ici_bytes` must carry ONLY the inter tier: the hierarchy's
+  claimed wire win is a model, and the counters are the model made
+  observable.
+
+* Lane diet — every exchange-wire lane's registered width round-trips
+  its documented maximum occupancy losslessly (the proof obligation
+  behind riding the wire at i32), while the 64-bit species (time/order/
+  digest) genuinely cannot fit 32 bits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu.core import Engine
+from shadow_tpu.core.engine import (
+    exchange_ici_bytes_per_round,
+    exchange_tier_bytes_per_round,
+)
+from shadow_tpu.core.gears import (
+    GearController,
+    resolve_gear_ladder,
+    run_adaptive_chunk,
+)
+from tests.engine_harness import build_sim, mk_hosts
+
+# the test_gears workload trio — but every case at 8 hosts so the SAME
+# population runs on the 1- and 8-shard meshes (num_hosts must divide
+# evenly over world; 1 host/shard is also the harshest compaction shape)
+_CASES = {
+    "phold": ("phold", mk_hosts(8, {"mean_delay": "20 ms", "population": 3}),
+              300_000_000, dict(loss=0.1)),
+    "echo": ("udp_echo",
+             [dict(host_id=0, name="server", start_time=0,
+                   model_args={"role": "server"})]
+             + [dict(host_id=i, name=f"c{i}", start_time=0,
+                     model_args={"role": "client", "peer": "server",
+                                 "interval": "4 ms", "size_bytes": 2000})
+                for i in range(1, 8)],
+             200_000_000, dict(bw_bits=2_000_000, loss=0.05)),
+    "tgen": ("tgen_tcp",
+             mk_hosts(8, {"flow_segs": 8, "flows": 1, "cwnd_cap": 8,
+                          "rto_min": "100 ms"}),
+             1_500_000_000,
+             dict(loss=0.05, latency=10_000_000, sends_budget=16)),
+}
+
+
+def _build(model, hosts, stop, world=1, **kw):
+    cfg, m, params, mstate, events = build_sim(
+        model, hosts, stop, world=world, **kw
+    )
+    mesh = None
+    if world > 1:
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:world]), ("hosts",)
+        )
+    eng = Engine(cfg, m, mesh)
+    state, params = eng.init_state(params, mstate, events, seed=1)
+    return cfg, eng, state, params
+
+
+def _run_full(model, hosts, stop, world=1, **kw):
+    cfg, eng, state, params = _build(model, hosts, stop, world, **kw)
+    while not bool(state.done):
+        state = eng.run_chunk(state, params)
+    return cfg, state
+
+
+# world-1 full-width reference runs, one per (case, qb, k) — every matrix
+# leg below diffs against the same reference, so compute each once
+_REF: dict[tuple, object] = {}
+
+
+def _reference(case, qb, k):
+    key = (case, qb, k)
+    if key not in _REF:
+        model, hosts, stop, kw = _CASES[case]
+        _, state = _run_full(model, hosts, stop, queue_block=qb,
+                             microstep_events=k, **kw)
+        _REF[key] = state
+    return _REF[key]
+
+
+def _assert_identical(ref, hier):
+    f = jax.device_get(ref.stats)
+    g = jax.device_get(hier.stats)
+    np.testing.assert_array_equal(np.asarray(f.digest), np.asarray(g.digest))
+    np.testing.assert_array_equal(np.asarray(f.events), np.asarray(g.events))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(ref.queue.dropped)),
+        np.asarray(jax.device_get(hier.queue.dropped)),
+    )
+    for field in ("pkts_sent", "pkts_lost", "pkts_codel_dropped",
+                  "pkts_budget_dropped", "pkts_delivered", "q_occ_hwm"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(f, field)), np.asarray(getattr(g, field)),
+            err_msg=field,
+        )
+    # per-SHARD counters ([world]-shaped) compare by total across meshes
+    assert (int(np.asarray(g.a2a_shed).sum())
+            == int(np.asarray(f.a2a_shed).sum()))
+
+
+def _assert_two_tier_model(cfg, state):
+    """counter == model x exchanges x world, for BOTH tiers; ici_bytes
+    carries only inter. One exchange per retired round plus the final
+    probe round that discovers `done`."""
+    s = jax.device_get(state.stats)
+    exchanges = int(np.asarray(s.rounds)) + int(bool(state.done))
+    intra_m, inter_m = exchange_tier_bytes_per_round(cfg)
+    meas_intra = int(np.asarray(s.ici_intra).sum())
+    meas_inter = int(np.asarray(s.ici_inter).sum())
+    assert meas_intra == intra_m * exchanges * cfg.world
+    assert meas_inter == inter_m * exchanges * cfg.world
+    assert meas_inter == int(np.asarray(s.ici_bytes).sum())
+
+
+def _matrix_params():
+    """The acceptance matrix (test_runtime posture): the mixed-axis
+    combos — (flat, k4) and (bucketed, k1) — carry the `slow` mark so
+    the FULL cross product runs under `pytest -m ''` while tier-1 runs
+    the aligned half (which still covers every axis value; the exchange
+    sits upstream of the queue layout and the microstep fold, so the
+    cross terms add composition coverage, not new exchange paths)."""
+    out = []
+    for case in sorted(_CASES):
+        for k in (1, 4):
+            for qb in (0, 8):
+                aligned = (k == 1) == (qb == 0)
+                out.append(pytest.param(
+                    case, k, qb,
+                    id=f"{case}-k{k}-{'flat' if qb == 0 else 'bucketed'}",
+                    marks=() if aligned else (pytest.mark.slow,),
+                ))
+    return out
+
+
+@pytest.mark.parametrize("case,k,qb", _matrix_params())
+def test_hier_bit_identical_across_mesh(case, k, qb):
+    """The acceptance gate: a world-8 hierarchical run is bit-identical
+    to the world-1 full-width reference — digests, events, every drop
+    counter — and its two tier counters reconcile exactly against
+    `exchange_tier_bytes_per_round`."""
+    model, hosts, stop, kw = _CASES[case]
+    ref = _reference(case, qb, k)
+    cfg, hier = _run_full(model, hosts, stop, world=8,
+                          exchange="hierarchical", queue_block=qb,
+                          microstep_events=k, **kw)
+    _assert_identical(ref, hier)
+    _assert_two_tier_model(cfg, hier)
+
+
+def test_hier_vs_alltoall_same_mesh():
+    """Direct same-mesh comparison (no transitivity): hierarchical and
+    flat alltoall on the SAME 8-shard mesh agree on digests, events,
+    drops, and shed totals."""
+    model, hosts, stop, kw = _CASES["phold"]
+    _, flat = _run_full(model, hosts, stop, world=8,
+                        exchange="alltoall", **kw)
+    cfg, hier = _run_full(model, hosts, stop, world=8,
+                          exchange="hierarchical", **kw)
+    _assert_identical(flat, hier)
+    _assert_two_tier_model(cfg, hier)
+    # the flat run carries no tier lanes (they exist only when traced)
+    assert jax.device_get(flat.stats).ici_intra is None
+
+
+@pytest.mark.parametrize("case", sorted(_CASES), ids=sorted(_CASES))
+def test_hier_gears_bit_identical_with_forced_replay(case):
+    """Gears ON: a gear ladder started at the BOTTOM rung (forcing real
+    shed -> abort -> replay cycles through the hierarchical path, whose
+    block size re-derives per gear) still finishes bit-identical to the
+    world-1 full-width reference."""
+    model, hosts, stop, kw = _CASES[case]
+    ref = _reference(case, 0, 1)
+    cfg, eng, state, params = _build(model, hosts, stop, world=8,
+                                     exchange="hierarchical", **kw)
+    ladder = resolve_gear_ladder("auto", cfg.sends_per_host_round)
+    ctl = GearController(ladder)
+    ctl.gear = ladder[0]
+    while not bool(state.done):
+        state, _, _ = run_adaptive_chunk(
+            ctl, state, lambda st, g: eng.run_chunk_gear(st, params, g)
+        )
+    _assert_identical(ref, state)
+    assert ctl.replays > 0
+    # accepted chunks never shed (the aborted attempts were discarded)
+    assert int(np.asarray(jax.device_get(state.stats).gear_shed).max()) == 0
+
+
+@pytest.mark.parametrize("qb", [0, 8], ids=["flat", "bucketed"])
+def test_hier_world1_degenerates_to_local_path(qb):
+    """world=1 `hierarchical` is the same local gather-merge program as
+    every other exchange kind: identical results, no tier lanes carried
+    (hier_active is False), zero modeled bytes."""
+    model, hosts, stop, kw = _CASES["phold"]
+    cfg, hier = _run_full(model, hosts, stop, world=1,
+                          exchange="hierarchical", queue_block=qb, **kw)
+    ref = _reference("phold", qb, 1)
+    _assert_identical(ref, hier)
+    assert not cfg.hier_active
+    assert jax.device_get(hier.stats).ici_intra is None
+    assert exchange_tier_bytes_per_round(cfg) == (0, 0)
+
+
+# ------------------------------------------------------------- cost model
+
+
+def test_two_tier_model_gear_behavior():
+    """The wire win is the GEAR-driven block shrink: at full width the
+    hierarchical inter tier costs the flat alltoall's bytes plus one
+    4-byte fill counter per peer (same auto block law), and every gear
+    downshift shrinks both tiers below that — strictly below the
+    gear-invariant flat wire once a gear is held."""
+    model, hosts, stop, kw = _CASES["phold"]
+    cfg, _, _, _ = _build(model, hosts, stop, world=8,
+                          exchange="hierarchical", **kw)
+    flat = exchange_ici_bytes_per_round(cfg, "alltoall")
+    intra_full, inter_full = exchange_tier_bytes_per_round(cfg)
+    assert inter_full == flat + (cfg.world - 1) * 4
+    assert exchange_ici_bytes_per_round(cfg) == inter_full
+    prev_inter = 0
+    for g in resolve_gear_ladder("auto", cfg.sends_per_host_round)[:-1]:
+        gcfg = dataclasses.replace(cfg, gear_cols=g)
+        intra_g, inter_g = exchange_tier_bytes_per_round(gcfg)
+        assert inter_g < flat, (g, inter_g, flat)
+        assert intra_g < intra_full
+        # wider gear, wider blocks — cost is monotone in the gear, and
+        # every rung below the top undercuts the flat wire
+        assert inter_g >= prev_inter
+        prev_inter = inter_g
+
+
+def test_effective_rounds_per_chunk_valve():
+    """The rpc valve (satellite 1): untouched at <= 2^19 hosts, clamped
+    to the microstep valve above — where the measured while-loop
+    pathology (BASELINE.md r3) makes a big constant bound poison every
+    dispatch."""
+    from shadow_tpu.core import EngineConfig
+
+    small = EngineConfig(num_hosts=1 << 19, stop_time=1,
+                         rounds_per_chunk=64, queue_capacity=16)
+    assert small.effective_rounds_per_chunk == 64
+    big = EngineConfig(num_hosts=(1 << 19) + 1, stop_time=1,
+                       rounds_per_chunk=64, queue_capacity=16)
+    assert big.effective_rounds_per_chunk == 32  # 2 x queue_capacity
+    pinned = EngineConfig(num_hosts=(1 << 19) + 1, stop_time=1,
+                          rounds_per_chunk=64, queue_capacity=16,
+                          microstep_limit=8)
+    assert pinned.effective_rounds_per_chunk == 8
+    tiny_rpc = EngineConfig(num_hosts=(1 << 19) + 1, stop_time=1,
+                            rounds_per_chunk=4, queue_capacity=16)
+    assert tiny_rpc.effective_rounds_per_chunk == 4  # clamp never raises
+
+
+# -------------------------------------------------------------- lane diet
+
+
+def test_lane_diet_roundtrip_at_max_occupancy():
+    """The proof obligations behind the i32 wire diet, executed: each
+    narrowed exchange-wire lane's documented MAXIMUM occupancy (from a
+    deliberately large config) round-trips through its registered dtype
+    losslessly, while the 64-bit wire species (time/order) genuinely
+    exceed an i32 — so the diet is as narrow as exactness allows."""
+    from shadow_tpu.core import EngineConfig
+    from shadow_tpu.core.lanes import (
+        BITS,
+        EXCHANGE_WIRE_LANES,
+        LANE_MIN_WIDTH_BITS,
+        LANE_WIDTHS,
+        ORDER_LANES,
+        TIME_LANES,
+    )
+
+    cfg = EngineConfig(
+        num_hosts=1 << 16, stop_time=3_600 * 10**9, world=8,
+        sends_per_host_round=64, queue_capacity=256, queue_block=64,
+        exchange="hierarchical",
+    )
+    rows = cfg.hosts_per_shard * cfg.sends_per_host_round
+    bounds = {
+        "dst": cfg.num_hosts - 1,
+        "kind": 15,
+        "payload": 2**31 - 1,  # i32 words by the payload contract
+        "sent_round": cfg.sends_per_host_round,
+        "count": rows,
+        "bfill": cfg.queue_block,
+        "seg_len": rows,
+        "sent_counts": cfg.hier_block_size,
+        "recv_counts": cfg.hier_block_size,
+    }
+    for lane, bound in bounds.items():
+        dtype = np.dtype(LANE_WIDTHS[lane])
+        # registered width respects the stated minimum...
+        assert BITS[LANE_WIDTHS[lane]] >= LANE_MIN_WIDTH_BITS[lane], lane
+        # ...and the max occupancy round-trips losslessly through it
+        assert bound <= np.iinfo(dtype).max, lane
+        assert int(np.asarray(bound, dtype=dtype)) == bound, lane
+    # the 64-bit species genuinely need their width: one sim-hour of
+    # nanoseconds and the packed 63-bit order key both overflow an i32
+    i32max = np.iinfo(np.int32).max
+    assert cfg.stop_time > i32max
+    assert (1 << 62) > i32max  # order: (locality, src, seq) packed key
+    for lane in EXCHANGE_WIRE_LANES:
+        if LANE_MIN_WIDTH_BITS[lane] == 64:
+            assert lane in TIME_LANES | ORDER_LANES, lane
+
+
+def test_scale_example_parses():
+    """examples/scale.yaml (the bench_scale config shape) parses and
+    carries the documented knob pairing: hierarchical exchange + gears."""
+    import os
+
+    from shadow_tpu.config import load_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = load_config(os.path.join(repo, "examples", "scale.yaml"))
+    assert cfg.experimental.exchange == "hierarchical"
+    assert cfg.experimental.merge_gears == "auto"
+
+
+def test_lane_diet_table_consistency():
+    """Structural half of shadowlint R7, pinned as a test too: every
+    exchange-wire lane has a minimum-width entry, wire lanes whose
+    minimum fits 32 bits actually RIDE at 32 (the diet is real, not
+    aspirational), and nothing is registered narrower than exact."""
+    from shadow_tpu.core.lanes import (
+        BITS,
+        EXCHANGE_WIRE_LANES,
+        LANE_MIN_WIDTH_BITS,
+        LANE_WIDTHS,
+    )
+
+    for lane in EXCHANGE_WIRE_LANES:
+        assert lane in LANE_MIN_WIDTH_BITS, lane
+        width = BITS[LANE_WIDTHS[lane]]
+        assert width >= LANE_MIN_WIDTH_BITS[lane], lane
+        if LANE_MIN_WIDTH_BITS[lane] <= 32:
+            assert width == 32, (lane, "wire lane riding wider than exact")
+    for lane, floor in LANE_MIN_WIDTH_BITS.items():
+        assert BITS[LANE_WIDTHS[lane]] >= floor, lane

@@ -33,6 +33,14 @@ Verdicts (rc 1 if any REGRESSION, else 0):
     --threshold AND more than 1 s absolute (compiles are noisy at the
     sub-second scale), is a regression; OLD carrying a runtime block
     NEW lost is a coverage warning
+  - exchange (PR 17 hierarchical two-tier block): the inter-shard
+    wire bytes per round growing more than --hbm-threshold relative
+    is a regression — that tier is the actual chip-to-chip traffic
+    the hierarchy exists to shrink, so growth means the two-tier
+    climb is regressing toward the flat alltoall cost. Losing the
+    block is a coverage warning, and a NEW row whose measured wire
+    exceeds its own recorded flat-model cost warns that gears never
+    settled below the top width.
   - a metric present in OLD but missing from NEW is a regression
     (silently dropping a tracked workload is how coverage rots)
 """
@@ -183,6 +191,71 @@ def _compare_fluid(add, name: str, o_fl: dict | None, n_fl: dict | None,
             f"background drops appeared: 0 -> {nd} (the fluid plane "
             f"started clipping at congestion — capacity or demand "
             f"changed)")
+
+
+def _exchange_block(row: dict) -> dict | None:
+    """One row's hierarchical-exchange block: bench rows carry it under
+    counters.exchange, sim-stats reports at the top level."""
+    ex = (row.get("counters") or {}).get("exchange")
+    if not isinstance(ex, dict):
+        ex = row.get("exchange")
+    return ex if isinstance(ex, dict) else None
+
+
+def _compare_exchange(add, name: str, o: dict, n: dict,
+                      hbm_threshold: float):
+    """Diff one metric's hierarchical-exchange tier counters (PR 17):
+    the inter-shard tier is the wire — its per-round bytes growing past
+    tolerance is a REGRESSION (the two-tier climb regressing back toward
+    the flat alltoall cost), and a row that loses the block loses the
+    weak-scaling guard (coverage warning). The intra tier is on-shard
+    staging; it rides the HBM gates, not this one."""
+    o_ex, n_ex = _exchange_block(o), _exchange_block(n)
+    if isinstance(o_ex, dict) and n_ex is None:
+        add("exchange", name, "warning",
+            "OLD carried a hierarchical-exchange block, NEW has none "
+            "(two-tier wire coverage lost)")
+        return
+    if not isinstance(n_ex, dict):
+        return
+    o_r = (o.get("counters") or {}).get("rounds") or o.get("rounds") or 0
+    n_r = (n.get("counters") or {}).get("rounds") or n.get("rounds") or 0
+    ob = (o_ex or {}).get("ici_inter_bytes") if isinstance(o_ex, dict) else None
+    nb = n_ex.get("ici_inter_bytes")
+    if isinstance(ob, (int, float)) and isinstance(nb, (int, float)) \
+            and ob > 0 and o_r and n_r:
+        # normalize per round: legs run different horizons
+        opr, npr = ob / o_r, nb / n_r
+        rel = (npr - opr) / opr
+        if rel > hbm_threshold:
+            add("exchange", name, "regression",
+                f"inter-shard wire bytes/round {opr:.0f} -> {npr:.0f} "
+                f"({rel * 100:+.1f}%, threshold "
+                f"+{hbm_threshold * 100:.0f}%) — the two-tier exchange "
+                f"is regressing toward the flat alltoall cost")
+        elif rel < -hbm_threshold:
+            add("exchange", name, "improvement",
+                f"inter-shard wire bytes/round {opr:.0f} -> {npr:.0f} "
+                f"({rel * 100:+.1f}%)")
+    # the in-row flat comparison: a NEW row whose wire tier exceeds its
+    # own recorded flat-model cost lost the point of the hierarchy
+    flat = n_ex.get("flat_alltoall_bytes_per_round")
+    if isinstance(flat, (int, float)) and flat > 0 and n_r \
+            and isinstance(nb, (int, float)):
+        npr = nb / n_r
+        # world factor: flat is per shard per round; the counter sums
+        # shards — recover the factor from the model fields when present
+        model_inter = n_ex.get("model_inter_bytes_per_round")
+        if isinstance(model_inter, (int, float)) and model_inter > 0:
+            world = max(round(npr / model_inter), 1) if npr > 0 else 1
+            # npr/model_inter only equals world when the run never left
+            # the top gear; bound it by the byte ratio instead
+            if npr > flat * world * 1.05:
+                add("exchange", name, "warning",
+                    f"measured wire bytes/round {npr:.0f} exceed the "
+                    f"recorded flat-alltoall model x{world} "
+                    f"({flat * world:.0f}) — gears never settled below "
+                    f"the top width on this leg")
 
 
 # compile-wall growth below this many absolute seconds never regresses:
@@ -375,6 +448,9 @@ def compare(old: dict, new: dict, threshold: float, hbm_threshold: float):
             add("wheel", name, "warning",
                 "OLD carried a wheel block, NEW has none (wheel "
                 "coverage lost)")
+        # hierarchical-exchange block (PR 17): the inter-shard tier IS
+        # the wire — growth past tolerance is a regression.
+        _compare_exchange(add, name, o, n, hbm_threshold)
     for name in sorted(set(new) - set(old)):
         add("coverage", name, "info", "new metric (no baseline)")
     return findings

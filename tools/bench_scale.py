@@ -1,0 +1,346 @@
+"""Weak-scaling sweep: PHOLD hosts-per-device climb on 1 vs 8 devices.
+
+The million-host check this repo keeps cashing in pieces (ROADMAP item 1)
+is a WEAK-scaling claim: hold hosts/device fixed, grow the mesh, and the
+per-round cost must track per-shard work — not the global host count.
+This driver sweeps hosts/device in {10k, 40k, 100k} x world in {1, 8
+virtual CPU devices} and emits BENCH-schema rows (counters{} + network{}
++ hbm{} blocks, tools/bench_compare.py-diffable) so the climb is guarded
+by the same trend tooling as the headline configs:
+
+  - world-8 legs run `experimental.exchange: hierarchical` with
+    `merge_gears: auto` — the two-tier exchange whose inter-shard wire
+    bytes shrink with the merge gear (counters.exchange carries the
+    ici_intra/ici_inter split; the flat-model comparison rides in
+    `flat_alltoall_bytes`);
+  - shapes are AUTO-tiered (config/options.resolve_shapes), so the
+    100k x 8 = 800k-host leg crosses the >524k boundary where the engine
+    clamps the effective rounds-per-chunk to the microstep valve
+    (EngineConfig.effective_rounds_per_chunk — the documented rpc=64
+    while-loop pathology fix); counters.rounds_per_chunk_configured /
+    counters.rounds_per_chunk_effective record the clamp firing.
+
+Each leg runs in a worker subprocess (virtual-device XLA flags are
+per-process; the documented jaxlib heap corruption gets the usual
+classify-then-SKIP posture, tools/corruption.py). A leg that sheds is
+reported, not hidden: drop counters ride in every row.
+
+Usage:
+  python tools/bench_scale.py [--smoke] [-o OUT.json]
+    --smoke   10k-hosts/device legs only, 1 sim-s — the TIER1_SCALE=1
+              stage of tools/check_tier1.sh (exit 0 = both legs ran,
+              rows parsed, and the world-8 row's two-tier counters
+              reconciled against the cost model)
+  python tools/bench_scale.py --worker HPD WORLD STOP   (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+from tools.corruption import classify  # noqa: E402
+
+SHAPES = (10_000, 40_000, 100_000)  # hosts per device
+WORLDS = (1, 8)
+STOP_S = {10_000: 4, 40_000: 2, 100_000: 1}  # sim horizon per shape
+# generous per-leg walls: the big legs are compile-dominated on CPU
+TIMEOUT_S = {10_000: 600, 40_000: 900, 100_000: 1500}
+
+
+def leg_config(hosts: int, world: int, stop_s: int) -> dict:
+    """One leg's ConfigOptions dict: bench.py's PHOLD point, shapes
+    auto-tiered, observability measured-in (trace + network + memory,
+    the same riders the BASELINE configs carry), hierarchical exchange +
+    auto gears on the multi-device legs."""
+    from bench import PHOLD_GML
+
+    # short chunks so the gear controller gets enough accepted-chunk
+    # observations to settle below the top gear inside the sweep horizon
+    # (DOWN_LAG hysteresis) — the geared block shrink is the hierarchical
+    # wire win the rows exist to track. At the >524k-host legs the engine
+    # clamps the EFFECTIVE bound below this (the rpc valve; both numbers
+    # ride in counters so the clamp firing is visible in the row). The
+    # send budget is pinned with the deliberate safety margin real
+    # configs carry (PHOLD's observed per-round high-water here is ~4):
+    # the flat alltoall ships blocks sized to the BUDGET, while the
+    # hierarchical path's blocks shrink to the gear the controller
+    # settles on — the auto ladder {1, 3, 6, 12} gives it a rung with
+    # headroom over the observed traffic.
+    experimental: dict = {
+        "merge_gears": "auto",
+        "rounds_per_chunk": 16,
+        "sends_per_host_round": 12,
+    }
+    if world > 1:
+        experimental["exchange"] = "hierarchical"
+    return {
+        "general": {"stop_time": f"{stop_s} s", "seed": 1},
+        "network": {"graph": {"type": "gml", "inline": PHOLD_GML}},
+        "experimental": experimental,
+        "observability": {"trace": True, "network": True, "memory": True},
+        "hosts": {
+            "node": {
+                "count": hosts,
+                "network_node_id": 0,
+                "processes": [
+                    {
+                        "model": "phold",
+                        "model_args": {
+                            "population": 2,
+                            "mean_delay": "200 ms",
+                            "size_bytes": 64,
+                        },
+                    }
+                ],
+            }
+        },
+    }
+
+
+def run_leg(hosts_per_device: int, world: int, stop_s: int) -> dict:
+    """Worker body: build, run to stop_time, emit one BENCH-schema row."""
+    if world > 1:
+        from __graft_entry__ import _force_virtual_cpu_mesh
+
+        _force_virtual_cpu_mesh(world)
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from shadow_tpu.config.options import ConfigOptions
+    from shadow_tpu.sim import Simulation
+
+    num_hosts = hosts_per_device * world
+    cfg = ConfigOptions.from_dict(
+        leg_config(num_hosts, world, stop_s)
+    )
+    t_build = time.monotonic()
+    sim = Simulation(cfg, world=world)
+    build_s = time.monotonic() - t_build
+    report = sim.run(progress=False)
+    s = jax.device_get(sim.state.stats)
+    ecfg = sim.engine_cfg
+    wall = report.get("wall_seconds") or 1e-9
+    row = {
+        # leg shape baked into the metric name so bench_compare's
+        # {metric: row} index keeps every leg distinct across rounds
+        "metric": (
+            f"phold_weak_scale_{hosts_per_device // 1000}k_x{world}"
+            f"_sim_seconds_per_wall_second"
+        ),
+        "value": round(report["sim_wall_ratio"] or 0.0, 3),
+        "unit": "sim_s/wall_s",
+        "hosts_per_device": hosts_per_device,
+        "world": world,
+        "sim_seconds": report["simulated_seconds"],
+        "events": report["events_processed"],
+        "microsteps_per_round": round(
+            report["microsteps"] / max(report["rounds"], 1), 2
+        ),
+        "build_s": round(build_s, 1),
+        "wall_s": round(wall, 1),
+        "counters": {
+            "rounds": report["rounds"],
+            "ici_bytes": report["ici_bytes"],
+            "bq_rebuilds": report["bucket_cache_rebuilds"],
+            "popk_deferred": report["popk_deferred"],
+            "queue_occupancy_hwm": report["queue_occupancy_hwm"],
+            "outbox_send_hwm": report["outbox_send_hwm"],
+            # the rpc valve evidence: configured vs traced chunk bound —
+            # they diverge exactly on the >524k-host legs
+            "rounds_per_chunk_configured": ecfg.rounds_per_chunk,
+            "rounds_per_chunk_effective": ecfg.effective_rounds_per_chunk,
+            # shed accounting stays loud in the scaling rows (auto
+            # shapes trade headroom for HBM at the big tiers)
+            "queue_overflow_dropped": report["queue_overflow_dropped"],
+            "packets_budget_dropped": report["packets_budget_dropped"],
+            "outbox_overflow_dropped": report["outbox_overflow_dropped"],
+            "alltoall_shed_dropped": report["alltoall_shed_dropped"],
+            **(
+                {"gears": report["gears"]} if "gears" in report else {}
+            ),
+            **(
+                {"exchange": report["exchange"]}
+                if "exchange" in report else {}
+            ),
+        },
+        "determinism_digest": report["determinism_digest"],
+    }
+    if ecfg.hier_active:
+        # the flat-alltoall comparison the inter tier is guarded against
+        # (same shapes, full-width blocks) + the cost-model cross-check
+        from shadow_tpu.core.engine import (
+            exchange_ici_bytes_per_round, exchange_tier_bytes_per_round,
+        )
+
+        intra_m, inter_m = exchange_tier_bytes_per_round(ecfg)
+        row["counters"]["exchange"]["flat_alltoall_bytes_per_round"] = (
+            exchange_ici_bytes_per_round(ecfg, "alltoall")
+        )
+        row["counters"]["exchange"]["model_intra_bytes_per_round"] = intra_m
+        row["counters"]["exchange"]["model_inter_bytes_per_round"] = inter_m
+        assert row["counters"]["exchange"]["ici_inter_bytes"] == (
+            report["ici_bytes"]
+        ), "ici_bytes must carry exactly the inter tier"
+    # network{} block: compacted from the SAME shared assembly sim-stats
+    # used (bench._bench_network -> obs/netobs.bench_network_block)
+    from bench import _bench_network
+
+    row["network"] = _bench_network(
+        sim, sim.state, s, getattr(sim, "_flowcol", None)
+    )
+    # hbm{} block: live per-shard sampling from the run's own monitor +
+    # the static model subset the BASELINE rows carry
+    from shadow_tpu.obs.memory import static_model
+
+    memmon = getattr(sim, "_memmon", None)
+    if memmon is not None:
+        sm = static_model(ecfg, sim.state, sim.params)
+        row["hbm"] = {
+            **memmon.report(),
+            "model": {
+                k: v for k, v in sm.items()
+                if k in ("components", "state_bytes", "params_bytes",
+                         "total_bytes", "per_host_bytes")
+            },
+        }
+    return row
+
+
+def sweep(
+    shapes=SHAPES, worlds=WORLDS, *, smoke: bool = False
+) -> tuple[list[dict], int]:
+    """Run every leg in a worker subprocess; returns (rows, rc)."""
+    legs = [(h, w) for h in shapes for w in worlds]
+    rows: list[dict] = []
+    rc = 0
+    for hpd, world in legs:
+        stop_s = 1 if smoke else STOP_S[hpd]
+        timeout = 300 if smoke else TIMEOUT_S[hpd]
+        note = (
+            f"weak-scaling leg {hpd} hosts/device x world {world}"
+            + (" (hierarchical exchange + auto gears)" if world > 1 else "")
+        )
+        print(f"== {note} ==", file=sys.stderr)
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--worker", str(hpd), str(world), str(stop_s),
+        ]
+        timed_out = False
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+            )
+            out_rc, stdout, stderr = out.returncode, out.stdout, out.stderr
+        except subprocess.TimeoutExpired as e:
+            timed_out = True
+            out_rc = None
+            stdout = (e.stdout or b"").decode(errors="replace") if isinstance(
+                e.stdout, bytes
+            ) else (e.stdout or "")
+            stderr = ""
+        parsed = None
+        for line in reversed((stdout or "").splitlines()):
+            if line.startswith("BENCH_SCALE "):
+                parsed = json.loads(line[len("BENCH_SCALE "):])
+                break
+        flavor = classify(out_rc, timed_out=timed_out, output=stdout)
+        entry = {
+            "hosts_per_device": hpd,
+            "world": world,
+            "note": note,
+            "rc": out_rc,
+            "parsed": parsed,
+        }
+        if parsed is None:
+            if flavor is not None:
+                # the documented corruption signatures are SKIPs, not
+                # failures (docs/corruption.md posture) — but never when
+                # a verdict line was produced
+                entry["skipped"] = flavor
+                print(f"  SKIP ({flavor})", file=sys.stderr)
+            else:
+                entry["tail"] = (stderr or stdout or "")[-2000:]
+                rc = rc or 1
+                print(f"  FAIL rc={out_rc}", file=sys.stderr)
+        else:
+            print(
+                f"  ok: {parsed['value']} sim_s/wall_s, "
+                f"{parsed['events']} events", file=sys.stderr,
+            )
+        rows.append(entry)
+    return rows, rc
+
+
+def check_rows(rows: list[dict]) -> int:
+    """The --smoke gate: beyond "legs ran", assert the scaling row
+    contracts — the world-8 hierarchical counters reconcile against the
+    two-tier cost model, and the rpc valve columns are present."""
+    rc = 0
+    for entry in rows:
+        row = entry.get("parsed")
+        if row is None:
+            continue
+        c = row["counters"]
+        if not (
+            c["rounds_per_chunk_effective"] <= c["rounds_per_chunk_configured"]
+        ):
+            print(
+                f"FAIL: effective rpc {c['rounds_per_chunk_effective']} > "
+                f"configured {c['rounds_per_chunk_configured']}",
+                file=sys.stderr,
+            )
+            rc = 1
+        if "hbm" not in row or "network" not in row:
+            print("FAIL: row missing hbm{}/network{} block", file=sys.stderr)
+            rc = 1
+        if entry["world"] > 1:
+            ex = c.get("exchange")
+            if not ex:
+                print("FAIL: world>1 row missing exchange{}", file=sys.stderr)
+                rc = 1
+                continue
+            if ex["ici_inter_bytes"] != c["ici_bytes"]:
+                print(
+                    f"FAIL: inter tier {ex['ici_inter_bytes']} != wire "
+                    f"counter {c['ici_bytes']}", file=sys.stderr,
+                )
+                rc = 1
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker", nargs=3, metavar=("HPD", "WORLD", "STOP"))
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("-o", "--output")
+    args = p.parse_args(argv)
+    if args.worker:
+        hpd, world, stop_s = (int(x) for x in args.worker)
+        row = run_leg(hpd, world, stop_s)
+        print("BENCH_SCALE " + json.dumps(row))
+        return 0
+    shapes = (10_000,) if args.smoke else SHAPES
+    rows, rc = sweep(shapes, WORLDS, smoke=args.smoke)
+    rc = rc or check_rows(rows)
+    text = json.dumps(rows, indent=1)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

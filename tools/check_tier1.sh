@@ -45,6 +45,15 @@
 # compiled, and the cosim bridge split present). Subprocess-isolated
 # with the same corruption-signature SKIP posture as the hbm stage.
 #
+# Optional stage: TIER1_SCALE=1 runs the weak-scaling smoke
+# (tools/bench_scale.py --smoke: the 10k-hosts/device legs on 1 and 8
+# virtual devices — the world-8 leg runs the hierarchical exchange with
+# auto gears, and the gate asserts the BENCH-schema rows parsed with
+# their hbm{}/network{} blocks, the rpc-valve columns, and the two-tier
+# byte counters reconciling against the wire counter). Worker
+# subprocesses with the same corruption-signature SKIP posture as the
+# soak stage.
+#
 # Optional third stage: TIER1_CAMPAIGN=1 runs the ensemble-plane smoke
 # (tools/campaign.py --smoke: an A/A control campaign that must hold +
 # a forced-divergence A/B campaign whose bisection must agree with the
@@ -122,6 +131,14 @@ if [ -n "${TIER1_INTEGRITY:-}" ]; then
   integrity_rc=$?
   echo "INTEGRITY_RC=$integrity_rc"
   [ "$rc" -eq 0 ] && rc=$integrity_rc
+fi
+if [ -n "${TIER1_SCALE:-}" ]; then
+  echo "== weak-scaling smoke (TIER1_SCALE) =="
+  timeout -k 10 "${TIER1_SCALE_TIMEOUT:-630}" \
+    env JAX_PLATFORMS=cpu python tools/bench_scale.py --smoke -o /dev/null
+  scale_rc=$?
+  echo "SCALE_RC=$scale_rc"
+  [ "$rc" -eq 0 ] && rc=$scale_rc
 fi
 if [ -n "${TIER1_CAMPAIGN:-}" ]; then
   echo "== campaign smoke (TIER1_CAMPAIGN) =="

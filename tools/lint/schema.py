@@ -483,6 +483,84 @@ def check_wheel_registry(project: Project) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# R7: lane diet (exchange-wire widths)
+# --------------------------------------------------------------------------
+
+
+def check_lane_diet(project: Project) -> list[Finding]:
+    """The lane-diet contract (core/lanes.py LANE_MIN_WIDTH_BITS +
+    EXCHANGE_WIRE_LANES): every lane that crosses an exchange collective
+    carries a proven minimum exact width, and the registered width honors
+    it in BOTH directions.
+
+    Checks (all against core/lanes.py, the single source):
+      1. every EXCHANGE_WIRE_LANES member has a LANE_MIN_WIDTH_BITS entry
+         (a wire lane without a stated bound cannot be dieted OR defended);
+      2. every LANE_MIN_WIDTH_BITS key is a registered lane in LANE_WIDTHS
+         (the table must not name phantom lanes) and its registered width
+         is >= the minimum (a lane registered NARROWER than its provable
+         minimum truncates);
+      3. wire lanes whose minimum is <= 32 must be REGISTERED at 32 — the
+         diet is real: a bounded counter riding the wire at i64 silently
+         doubles the inter-tier byte charge (`stats.ici_inter`);
+      4. wire lanes whose minimum is 64 must be time/order/digest lanes —
+         the only species with a genuine 64-bit range; anything else
+         claiming 64 on the wire needs its bound re-derived here first."""
+    out: list[Finding] = []
+    lanes = project.lanes
+    lanes_path = "shadow_tpu/core/lanes.py"
+    min_bits = getattr(lanes, "LANE_MIN_WIDTH_BITS", None)
+    wire = getattr(lanes, "EXCHANGE_WIRE_LANES", None)
+    if min_bits is None or wire is None:
+        return [Finding(
+            "R7", lanes_path, 1,
+            "LANE_MIN_WIDTH_BITS / EXCHANGE_WIRE_LANES registry missing",
+        )]
+    wide_ok = lanes.TIME_LANES | lanes.ORDER_LANES | lanes.DIGEST_LANES
+    for name in sorted(wire):
+        if name not in min_bits:
+            out.append(Finding(
+                "R7", lanes_path, 1,
+                f"exchange-wire lane `{name}` has no LANE_MIN_WIDTH_BITS "
+                f"entry — state the capacity/slot bound that caps it (or "
+                f"64 with the species that justifies it)",
+            ))
+    for name, mb in sorted(min_bits.items()):
+        reg = lanes.lane_width_bits(name)
+        if reg is None:
+            out.append(Finding(
+                "R7", lanes_path, 1,
+                f"LANE_MIN_WIDTH_BITS names `{name}`, which is not a "
+                f"registered lane in LANE_WIDTHS",
+            ))
+            continue
+        if reg < mb:
+            out.append(Finding(
+                "R7", lanes_path, 1,
+                f"lane `{name}` is registered at {reg} bits but its "
+                f"minimum exact width is {mb} — the registered width "
+                f"truncates the lane's proven range",
+            ))
+        if name in wire:
+            if mb <= 32 and reg != 32:
+                out.append(Finding(
+                    "R7", lanes_path, 1,
+                    f"exchange-wire lane `{name}` is provably exact at "
+                    f"{mb} bits but registered at {reg} — ride the wire "
+                    f"at i32 (the lane diet) or re-derive the bound in "
+                    f"LANE_MIN_WIDTH_BITS",
+                ))
+            if mb >= 64 and name not in wide_ok:
+                out.append(Finding(
+                    "R7", lanes_path, 1,
+                    f"exchange-wire lane `{name}` claims a 64-bit minimum "
+                    f"but is not a time/order/digest lane — only those "
+                    f"species carry a genuine 64-bit range",
+                ))
+    return out
+
+
 def run_schema_rules(
     root: str | None = None, project: Project | None = None
 ) -> list[Finding]:
@@ -493,4 +571,5 @@ def run_schema_rules(
     findings += check_trace_columns(project)
     findings += check_heartbeat_compat(project)
     findings += check_wheel_registry(project)
+    findings += check_lane_diet(project)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.msg))

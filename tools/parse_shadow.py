@@ -25,6 +25,11 @@ HEARTBEAT_RE = re.compile(
     # PR 3 observability fields; optional so pre-PR-3 logs still parse
     r"(?:ici_bytes=(?P<ici_bytes>\d+) )?"
     r"(?:q_hwm=(?P<q_hwm>\d+) )?"
+    # PR 17 hierarchical-exchange field (only emitted on
+    # experimental.exchange: hierarchical multi-device runs):
+    # xw=<intra>/<inter>, cumulative tier bytes — intra-shard compaction
+    # staging vs inter-shard wire (stats.ici_intra / stats.ici_inter)
+    r"(?:xw=(?P<xw_intra>\d+)/(?P<xw_inter>\d+) )?"
     # PR 5 fault-plane field (only emitted on faulty runs):
     # faults=<dropped>/<delayed>, cumulative
     r"(?:faults=(?P<faults_dropped>\d+)/(?P<faults_delayed>\d+) )?"
